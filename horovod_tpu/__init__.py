@@ -1,0 +1,47 @@
+"""horovod_tpu — a TPU-native distributed training framework.
+
+A ground-up re-design of the capabilities of Horovod v0.15.2
+(reference: kuroko1t/horovod) for TPUs: ranks are TPU chips in a
+``jax.sharding.Mesh``, the data plane is XLA collectives over ICI/DCN
+(not MPI/NCCL), and gradient reduction is compiled into the training
+step rather than negotiated tensor-by-tensor at runtime.
+
+Top-level API mirrors the reference's ``horovod.common`` basics
+(reference: horovod/common/__init__.py:51-154) plus the shared
+collective verbs. Framework frontends live in submodules:
+
+- :mod:`horovod_tpu.jax`    — flagship frontend (reference: horovod/tensorflow)
+- :mod:`horovod_tpu.torch`  — PyTorch frontend (reference: horovod/torch)
+- :mod:`horovod_tpu.keras`  — flax/optax trainer + callbacks (reference: horovod/keras)
+"""
+
+__version__ = "0.1.0"
+
+from horovod_tpu.common.topology import (  # noqa: F401
+    init,
+    shutdown,
+    is_initialized,
+    size,
+    rank,
+    local_size,
+    local_rank,
+    cross_size,
+    cross_rank,
+    num_processes,
+    process_index,
+    mesh,
+    devices,
+    device_rank_axis,
+    is_homogeneous,
+    mpi_threads_supported,
+)
+from horovod_tpu.ops.collectives import (  # noqa: F401
+    allreduce,
+    allgather,
+    broadcast,
+    reducescatter,
+    alltoall,
+    grouped_allreduce,
+    allreduce_pytree,
+    broadcast_pytree,
+)

@@ -1,0 +1,22 @@
+"""Shared basics for horovod_tpu (reference: horovod/common/__init__.py)."""
+
+from horovod_tpu.common.topology import (  # noqa: F401
+    init,
+    shutdown,
+    is_initialized,
+    size,
+    rank,
+    local_size,
+    local_rank,
+    cross_size,
+    cross_rank,
+    num_processes,
+    process_index,
+    mesh,
+    devices,
+    device_rank_axis,
+    is_homogeneous,
+    mpi_threads_supported,
+    HorovodInternalError,
+    NotInitializedError,
+)

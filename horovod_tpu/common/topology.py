@@ -1,0 +1,245 @@
+"""Device/process topology — the TPU-native replacement for MPI rank discovery.
+
+The reference derives ``rank/size/local_rank/local_size/cross_rank/cross_size``
+from ``MPI_COMM_WORLD`` plus a shared-memory split and a cross-node split
+(reference: horovod/common/operations.cc:1638-1705 and the C getters at
+operations.cc:2226-2262). On TPU there is no MPI: a *rank* is a TPU chip, the
+world is a ``jax.sharding.Mesh`` over all chips, the "local" communicator is
+the set of chips attached to one host process (ICI-connected within a slice),
+and the "cross" communicator is the across-host tier (DCN).
+
+Mapping (see SURVEY.md §2.3):
+
+==================  ==========================================================
+reference concept   TPU-native equivalent
+==================  ==========================================================
+MPI_COMM_WORLD      1-D ``Mesh(jax.devices(), ('hvd',))``
+rank                global id of this process's first device (device-level
+                    rank inside SPMD code comes from ``lax.axis_index``)
+size                total number of chips in the mesh
+local_comm          this process's ``jax.local_devices()``
+cross_comm          one representative chip per process (DCN tier)
+==================  ==========================================================
+
+Single-controller SPMD means one Python process may *speak for* several ranks
+(its local chips); host-side code therefore sees the process-level view while
+per-chip rank identity lives inside compiled programs.
+"""
+
+from __future__ import annotations
+
+import atexit
+import os
+import threading
+from typing import Optional, Sequence
+
+import numpy as np
+
+HVD_AXIS = "hvd"
+
+
+class HorovodInternalError(RuntimeError):
+    """Engine-surfaced error (reference: coordinator ERROR responses,
+    horovod/common/operations.cc:315-517)."""
+
+
+class NotInitializedError(ValueError):
+    """Raised by topology getters before init() (reference:
+    horovod/common/__init__.py:90-139 raises ValueError)."""
+
+    def __init__(self) -> None:
+        super().__init__(
+            "horovod_tpu has not been initialized; call horovod_tpu.init()."
+        )
+
+
+class _Topology:
+    """Singleton world state (reference: HorovodGlobalState,
+    horovod/common/operations.cc:108-247 — minus the comm thread, which on
+    TPU lives in the native engine, see horovod_tpu/core)."""
+
+    def __init__(self) -> None:
+        self.initialized = False
+        self.lock = threading.Lock()
+        self.mesh = None
+        self.devices: list = []
+        self.local_devices: list = []
+        self.size = 0
+        self.rank0 = 0  # global rank of this process's first local device
+        self.local_size = 0
+        self.cross_size = 0
+        self.cross_rank = 0
+        self.num_processes = 1
+        self.process_index = 0
+        self.homogeneous = True
+
+
+_state = _Topology()
+
+
+def _build_mesh(devs: Sequence) -> "object":
+    from jax.sharding import Mesh
+
+    return Mesh(np.asarray(devs), (HVD_AXIS,))
+
+
+def init(ranks: Optional[Sequence[int]] = None, devices: Optional[Sequence] = None):
+    """Initialize the world.
+
+    Args:
+      ranks: optional subset of global device indices to form the world from,
+        mirroring the reference's ``init(comm=[ranks])`` rank-subset support
+        (reference: horovod/common/__init__.py:58-84). Only valid
+        single-process.
+      devices: explicit device list (tests use this to shrink the world).
+
+    Idempotent like the reference's ``InitializeHorovodOnce``
+    (reference: horovod/common/operations.cc:2176-2194).
+    """
+    with _state.lock:
+        if _state.initialized:
+            return
+
+        import jax
+
+        # Multi-host: if the user (or launcher) provided coordination env,
+        # bring up the JAX distributed client so jax.devices() is global.
+        coord = os.environ.get("HVD_COORDINATOR_ADDRESS")
+        if coord and jax.process_count() == 1 and os.environ.get("HVD_NUM_PROCESSES"):
+            jax.distributed.initialize(
+                coordinator_address=coord,
+                num_processes=int(os.environ["HVD_NUM_PROCESSES"]),
+                process_id=int(os.environ.get("HVD_PROCESS_ID", "0")),
+            )
+
+        if devices is None:
+            devices = list(jax.devices())
+        if ranks is not None:
+            if jax.process_count() > 1:
+                raise ValueError("ranks= subset is only supported single-process")
+            devices = [devices[i] for i in ranks]
+
+        local = [d for d in devices if d.process_index == jax.process_index()]
+        if not local:
+            raise ValueError("this process owns no devices in the requested world")
+
+        _state.devices = list(devices)
+        _state.local_devices = local
+        _state.mesh = _build_mesh(devices)
+        _state.size = len(devices)
+        _state.local_size = len(local)
+        _state.num_processes = jax.process_count()
+        _state.process_index = jax.process_index()
+        # Global rank of the first local device: devices are mesh-ordered, so
+        # this is its index in the world list.
+        _state.rank0 = _state.devices.index(local[0])
+        _state.cross_size = _state.num_processes
+        _state.cross_rank = _state.process_index
+        counts = {}
+        for d in devices:
+            counts[d.process_index] = counts.get(d.process_index, 0) + 1
+        _state.homogeneous = len(set(counts.values())) == 1
+        _state.initialized = True
+
+
+def shutdown():
+    """Tear down the world (reference: horovod_shutdown,
+    horovod/common/operations.cc:2216-2224)."""
+    with _state.lock:
+        if not _state.initialized:
+            return
+        try:
+            from horovod_tpu.core import engine as _engine
+
+            _engine.shutdown_engine()
+        except Exception:
+            pass
+        try:
+            from horovod_tpu.ops import collectives as _coll
+
+            _coll._ranked_program.cache_clear()
+        except Exception:
+            pass
+        _state.initialized = False
+        _state.mesh = None
+        _state.devices = []
+        _state.local_devices = []
+
+
+atexit.register(shutdown)
+
+
+def is_initialized() -> bool:
+    return _state.initialized
+
+
+def _require_init() -> _Topology:
+    if not _state.initialized:
+        raise NotInitializedError()
+    return _state
+
+
+def size() -> int:
+    """Total number of ranks (chips) in the world."""
+    return _require_init().size
+
+
+def rank() -> int:
+    """Global rank of this process's first chip. Inside compiled SPMD code use
+    ``horovod_tpu.ops.axis_rank()`` for the per-chip rank."""
+    return _require_init().rank0
+
+
+def local_size() -> int:
+    return _require_init().local_size
+
+
+def local_rank() -> int:
+    """Rank within this host's chips for host-side code. A single controller
+    process speaks for all its local chips, so this is always 0 (the
+    per-chip value exists only inside SPMD programs)."""
+    _require_init()
+    return 0
+
+
+def cross_size() -> int:
+    return _require_init().cross_size
+
+
+def cross_rank() -> int:
+    return _require_init().cross_rank
+
+
+def num_processes() -> int:
+    return _require_init().num_processes
+
+
+def process_index() -> int:
+    return _require_init().process_index
+
+
+def mesh():
+    """The world ``jax.sharding.Mesh`` (1-D, axis name ``'hvd'``)."""
+    return _require_init().mesh
+
+
+def devices() -> list:
+    return list(_require_init().devices)
+
+
+def device_rank_axis() -> str:
+    """Name of the mesh axis that enumerates ranks."""
+    return HVD_AXIS
+
+
+def is_homogeneous() -> bool:
+    """Every process owns the same number of chips (reference:
+    horovod/common/operations.cc:1686-1705 homogeneity check)."""
+    return _require_init().homogeneous
+
+
+def mpi_threads_supported() -> bool:
+    """Reference API parity (horovod/common/operations.cc:2256-2262). There
+    is no MPI on TPU; host threads may always call into the engine."""
+    _require_init()
+    return True

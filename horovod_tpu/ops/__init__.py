@@ -1,0 +1,21 @@
+"""Collective ops — the XLA data plane (reference: horovod/common/operations.cc
+PerformOperation, :735-1531, re-designed as compiled SPMD collectives)."""
+
+from horovod_tpu.ops.collectives import (  # noqa: F401
+    HVD_AXIS,
+    axis_rank,
+    in_spmd,
+    allreduce,
+    allgather,
+    broadcast,
+    reducescatter,
+    alltoall,
+    grouped_allreduce,
+    allreduce_pytree,
+    broadcast_pytree,
+    ranked_allreduce,
+    ranked_allgather,
+    ranked_broadcast,
+    ranked_reducescatter,
+    ranked_alltoall,
+)

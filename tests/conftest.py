@@ -1,0 +1,31 @@
+"""Test harness: an 8-device virtual CPU mesh stands in for an 8-chip TPU
+slice (the reference's equivalent trick is `mpirun -np N` on one host —
+SURVEY.md §4).
+
+jax may already be imported by the interpreter's sitecustomize, so platform
+selection must go through jax.config (env vars would be too late); XLA_FLAGS
+still applies because the backend itself is not initialized until first use.
+"""
+
+import os
+
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def hvd():
+    import horovod_tpu as hvd
+
+    hvd.init()
+    return hvd

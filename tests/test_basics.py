@@ -1,0 +1,38 @@
+"""Topology basics (reference: horovod/common/__init__.py getters and
+test/test_tensorflow.py:44-54 rank/size tests)."""
+
+import pytest
+
+
+def test_not_initialized_raises():
+    from horovod_tpu.common.topology import NotInitializedError, is_initialized
+    import horovod_tpu as hvd
+
+    if not is_initialized():
+        with pytest.raises(NotInitializedError):
+            hvd.size()
+
+
+def test_init_size_rank(hvd):
+    assert hvd.is_initialized()
+    assert hvd.size() == 8
+    assert hvd.rank() == 0
+    assert hvd.local_size() == 8
+    assert hvd.local_rank() == 0
+    assert hvd.cross_size() == 1
+    assert hvd.cross_rank() == 0
+    assert hvd.num_processes() == 1
+    assert hvd.is_homogeneous()
+    assert hvd.mpi_threads_supported()
+
+
+def test_init_idempotent(hvd):
+    hvd.init()
+    assert hvd.size() == 8
+
+
+def test_mesh(hvd):
+    m = hvd.mesh()
+    assert m.devices.size == 8
+    assert m.axis_names == (hvd.device_rank_axis(),)
+    assert len(hvd.devices()) == 8
