@@ -1,0 +1,16 @@
+"""Async collective engine (reference: the C++ core in horovod/common/ —
+HorovodGlobalState + BackgroundThreadLoop, operations.cc:108-247,1604-2172).
+
+The SPMD compute path does not need this engine — collectives compile into
+the step. It exists for host-side async callers (the torch frontend's
+allreduce_async_/poll/synchronize surface) where framework threads enqueue
+tensors and a background dispatcher fuses and executes them.
+"""
+
+from horovod_tpu.core.engine import (  # noqa: F401
+    Engine,
+    EngineError,
+    DuplicateNameError,
+    get_engine,
+    shutdown_engine,
+)

@@ -1,0 +1,417 @@
+"""Background dispatch engine for async host-side collectives.
+
+Architecture mirrors the reference core (SURVEY.md §2.1 C1-C6): framework
+threads *enqueue* named tensors and get an integer handle; one background
+thread drains the queue each cycle, fuses compatible requests into flat
+buffers, executes them on the data plane, and completes handles
+(reference: operations.cc BackgroundThreadLoop/RunLoopOnce:1921-2172,
+EnqueueTensorAllreduce:2264-2300, HandleManager: torch/handle_manager.cc).
+
+TPU-native differences:
+- No rank-0 negotiation: within one controller, request order is the
+  program order; consistency checks (dtype/shape/op agreement for a name)
+  still run and surface the reference's ERROR semantics
+  (operations.cc:315-517).
+- The data plane is the XLA collective module (:mod:`horovod_tpu.ops`),
+  so "execute" stages host tensors onto the mesh — the same staging shape
+  as the reference's CudaOnCPU path (torch/mpi_ops_v2.cc:78-110).
+
+This Python engine is the semantic reference; the C++ `libhvdcore` engine
+(horovod_tpu/core/native) replaces the scheduler/table/fusion loop with the
+same observable behavior.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import queue
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Dict, Optional
+
+import numpy as np
+
+from horovod_tpu.core import timeline as tl
+
+LOG = logging.getLogger("horovod_tpu.engine")
+
+DEFAULT_CYCLE_TIME_S = 0.005  # reference: 5 ms, operations.cc:1747
+DEFAULT_FUSION_THRESHOLD = 64 * 1024 * 1024  # reference: 64 MB, operations.cc:1739
+STALL_WARNING_TIME_S = 60.0  # reference: operations.cc:253
+
+
+class EngineError(RuntimeError):
+    """Collective failed; surfaced at synchronize() like the reference's
+    ERROR response → exception path (test_torch.py:265-349)."""
+
+
+class DuplicateNameError(EngineError):
+    """Same tensor name enqueued twice before completion (reference:
+    operations.cc:265-268, 2293-2296)."""
+
+
+class ShutdownError(EngineError):
+    """Engine shut down with requests outstanding (reference:
+    SHUT_DOWN_ERROR, operations.cc:1833-1848)."""
+
+
+@dataclass
+class _Entry:
+    handle: int
+    name: str
+    op: str  # 'allreduce' | 'allgather' | 'broadcast'
+    tensor: np.ndarray
+    average: bool = False
+    root_rank: int = 0
+    prescale: float = 1.0
+    enqueued_at: float = field(default_factory=time.monotonic)
+
+
+class _Handle:
+    __slots__ = ("event", "result", "error")
+
+    def __init__(self):
+        self.event = threading.Event()
+        self.result = None
+        self.error: Optional[Exception] = None
+
+
+class JaxExecutor:
+    """Data plane: host numpy buffers → eager XLA collectives over the mesh
+    (reference analogue: PerformOperation's MPI/NCCL calls,
+    operations.cc:1401-1531)."""
+
+    @staticmethod
+    def _ctx(arr: np.ndarray):
+        # jax downcasts 64-bit dtypes unless x64 is enabled; host tensors
+        # (e.g. torch float64 hyperparameters) must round-trip exactly.
+        import contextlib
+
+        if arr.dtype.itemsize == 8 and arr.dtype.kind in "fiuc":
+            import jax
+
+            return jax.enable_x64()
+        return contextlib.nullcontext()
+
+    def allreduce(self, flat: np.ndarray, average: bool) -> np.ndarray:
+        import jax.numpy as jnp
+
+        from horovod_tpu.ops import collectives as C
+
+        with self._ctx(flat):
+            return np.asarray(C.allreduce(jnp.asarray(flat), average=average))
+
+    def allgather(self, tensor: np.ndarray) -> np.ndarray:
+        import jax.numpy as jnp
+
+        from horovod_tpu.ops import collectives as C
+
+        with self._ctx(tensor):
+            return np.asarray(C.allgather(jnp.asarray(tensor)))
+
+    def broadcast(self, tensor: np.ndarray, root_rank: int) -> np.ndarray:
+        import jax.numpy as jnp
+
+        from horovod_tpu.ops import collectives as C
+
+        with self._ctx(tensor):
+            return np.asarray(C.broadcast(jnp.asarray(tensor), root_rank))
+
+
+class Engine:
+    def __init__(
+        self,
+        executor=None,
+        cycle_time_s: Optional[float] = None,
+        fusion_threshold: Optional[int] = None,
+        stall_warning_s: float = STALL_WARNING_TIME_S,
+        timeline: Optional[tl.Timeline] = None,
+    ):
+        # Env knobs read once at engine start (reference:
+        # operations.cc:1732-1804).
+        if cycle_time_s is None:
+            ms = os.environ.get("HVD_CYCLE_TIME") or os.environ.get("HOROVOD_CYCLE_TIME")
+            cycle_time_s = float(ms) / 1000.0 if ms else DEFAULT_CYCLE_TIME_S
+        if fusion_threshold is None:
+            mb = os.environ.get("HVD_FUSION_THRESHOLD") or os.environ.get(
+                "HOROVOD_FUSION_THRESHOLD"
+            )
+            fusion_threshold = int(mb) if mb else DEFAULT_FUSION_THRESHOLD
+        self.cycle_time_s = cycle_time_s
+        # Fusion decisions are local to this controller. With multiple
+        # controller processes, local drain timing could fuse different
+        # batches on different processes and launch mismatched collective
+        # programs — the failure the reference's rank-0 negotiation exists
+        # to prevent (operations.cc:279-517). Until the native engine's
+        # negotiation lands, multi-process runs execute one deterministic
+        # collective per tensor (name-ordered within each cycle).
+        try:
+            from horovod_tpu.common import topology as _topo
+
+            if _topo.is_initialized() and _topo.num_processes() > 1:
+                fusion_threshold = 0
+        except Exception:
+            pass
+        self.fusion_threshold = fusion_threshold
+        self.stall_warning_s = stall_warning_s
+        self.stall_check_disabled = bool(
+            os.environ.get("HVD_STALL_CHECK_DISABLE")
+            or os.environ.get("HOROVOD_STALL_CHECK_DISABLE")
+        )
+        self.executor = executor or JaxExecutor()
+        self.timeline = timeline if timeline is not None else tl.from_env()
+
+        self._queue: "queue.Queue[_Entry]" = queue.Queue()
+        self._handles: Dict[int, _Handle] = {}
+        self._pending_names: Dict[str, _Entry] = {}
+        self._lock = threading.Lock()
+        self._next_handle = 0
+        self._shutdown = threading.Event()
+        self._last_stall_warn = 0.0
+        self._thread = threading.Thread(
+            target=self._loop, name="hvd-background", daemon=True
+        )
+        self._thread.start()
+        # Stall detection runs on its own watchdog thread: the dispatch
+        # thread may itself be blocked inside a hung collective — exactly
+        # the condition to report (reference rationale: operations.cc:
+        # 1535-1581; there the check rides the coordinator tick).
+        self._stall_thread = threading.Thread(
+            target=self._stall_loop, name="hvd-stall-watchdog", daemon=True
+        )
+        self._stall_thread.start()
+
+    # -- enqueue API (reference: EnqueueTensorAllreduce/Allgather/Broadcast,
+    # operations.cc:2264-2380) ------------------------------------------------
+
+    def _enqueue(self, entry: _Entry) -> int:
+        with self._lock:
+            if self._shutdown.is_set():
+                raise ShutdownError("engine is shut down")
+            if entry.name in self._pending_names:
+                raise DuplicateNameError(
+                    f"a collective named '{entry.name}' is already pending; "
+                    "names must be unique among in-flight tensors"
+                )
+            h = _Handle()
+            entry.handle = self._next_handle
+            self._next_handle += 1
+            self._handles[entry.handle] = h
+            self._pending_names[entry.name] = entry
+        self.timeline.start(entry.name, tl.QUEUE)
+        self._queue.put(entry)
+        return entry.handle
+
+    def allreduce_async(self, name: str, tensor: np.ndarray, average: bool,
+                        prescale: float = 1.0) -> int:
+        return self._enqueue(
+            _Entry(-1, name, "allreduce", np.ascontiguousarray(tensor),
+                   average=average, prescale=prescale)
+        )
+
+    def allgather_async(self, name: str, tensor: np.ndarray) -> int:
+        return self._enqueue(_Entry(-1, name, "allgather", np.ascontiguousarray(tensor)))
+
+    def broadcast_async(self, name: str, tensor: np.ndarray, root_rank: int) -> int:
+        return self._enqueue(
+            _Entry(-1, name, "broadcast", np.ascontiguousarray(tensor),
+                   root_rank=root_rank)
+        )
+
+    # -- completion API (reference: handle_manager.cc + mpi_ops_v2.cc poll/
+    # wait_and_clear:228-338) -------------------------------------------------
+
+    def poll(self, handle: int) -> bool:
+        with self._lock:
+            h = self._handles.get(handle)
+        if h is None:
+            raise EngineError(f"unknown handle {handle}")
+        return h.event.is_set()
+
+    def synchronize(self, handle: int) -> np.ndarray:
+        with self._lock:
+            h = self._handles.get(handle)
+        if h is None:
+            raise EngineError(f"unknown handle {handle}")
+        h.event.wait()
+        with self._lock:
+            self._handles.pop(handle, None)
+        if h.error is not None:
+            raise h.error
+        return h.result
+
+    # -- background loop (reference: RunLoopOnce, operations.cc:1921-2172) ----
+
+    def _loop(self):
+        while not self._shutdown.is_set():
+            start = time.monotonic()
+            self._run_cycle()
+            elapsed = time.monotonic() - start
+            sleep = self.cycle_time_s - elapsed
+            if sleep > 0:
+                self._shutdown.wait(sleep)
+        # Fail whatever is left (reference: operations.cc:1833-1848).
+        self._drain_with_error(ShutdownError("Horovod engine has been shut down"))
+
+    def _drain(self):
+        out = []
+        while True:
+            try:
+                out.append(self._queue.get_nowait())
+            except queue.Empty:
+                return out
+
+    def _drain_with_error(self, err: Exception):
+        for e in self._drain():
+            self._complete(e, None, err)
+
+    def _run_cycle(self):
+        entries = self._drain()
+        if entries:
+            # Fuse allreduces per (dtype, average) in request order up to the
+            # threshold (reference: operations.cc:2035-2074); other ops run
+            # singly in order.
+            batch: list[_Entry] = []
+            batch_key = None
+            batch_bytes = 0
+            for e in entries:
+                if e.op == "allreduce":
+                    key = (e.tensor.dtype, e.average)
+                    if batch and (key != batch_key or
+                                  batch_bytes + e.tensor.nbytes > self.fusion_threshold):
+                        self._exec_allreduce_batch(batch)
+                        batch, batch_bytes = [], 0
+                    batch_key = key
+                    batch.append(e)
+                    batch_bytes += e.tensor.nbytes
+                else:
+                    if batch:
+                        self._exec_allreduce_batch(batch)
+                        batch, batch_bytes = [], 0
+                    self._exec_single(e)
+            if batch:
+                self._exec_allreduce_batch(batch)
+
+    def _exec_allreduce_batch(self, batch):
+        names = [e.name for e in batch]
+        try:
+            if len(batch) == 1:
+                e = batch[0]
+                self.timeline.start(e.name, tl.ALLREDUCE,
+                                    {"dtype": str(e.tensor.dtype),
+                                     "shape": list(e.tensor.shape)})
+                flat = e.tensor.reshape(-1)
+                if e.prescale != 1.0:
+                    flat = flat * e.prescale
+                out = self.executor.allreduce(flat, e.average)
+                self.timeline.end(e.name, tl.ALLREDUCE)
+                self._complete(e, out.reshape(e.tensor.shape), None)
+                return
+            for n in names:
+                self.timeline.start(n, tl.MEMCPY_IN_FUSION_BUFFER)
+            flat = np.concatenate(
+                [(e.tensor.reshape(-1) * e.prescale if e.prescale != 1.0
+                  else e.tensor.reshape(-1)) for e in batch]
+            )
+            for n in names:
+                self.timeline.end(n, tl.MEMCPY_IN_FUSION_BUFFER)
+                self.timeline.start(n, tl.ALLREDUCE)
+            out = self.executor.allreduce(flat, batch[0].average)
+            off = 0
+            for e in batch:
+                n = e.tensor.size
+                self.timeline.end(e.name, tl.ALLREDUCE)
+                self._complete(e, out[off: off + n].reshape(e.tensor.shape), None)
+                off += n
+        except Exception as exc:  # surfaced at synchronize()
+            for e in batch:
+                self._complete(e, None, EngineError(str(exc)))
+
+    def _exec_single(self, e: _Entry):
+        try:
+            if e.op == "allgather":
+                self.timeline.start(e.name, tl.ALLGATHER)
+                out = self.executor.allgather(e.tensor)
+                self.timeline.end(e.name, tl.ALLGATHER)
+            elif e.op == "broadcast":
+                self.timeline.start(e.name, tl.BROADCAST)
+                out = self.executor.broadcast(e.tensor, e.root_rank)
+                self.timeline.end(e.name, tl.BROADCAST)
+            else:
+                raise EngineError(f"unknown op {e.op}")
+            self._complete(e, out, None)
+        except Exception as exc:
+            self._complete(e, None, EngineError(str(exc)))
+
+    def _complete(self, e: _Entry, result, err: Optional[Exception]):
+        self.timeline.end(e.name, tl.QUEUE)
+        with self._lock:
+            self._pending_names.pop(e.name, None)
+            h = self._handles.get(e.handle)
+        if h is not None:
+            h.result = result
+            h.error = err
+            h.event.set()
+
+    def _stall_loop(self):
+        interval = max(self.stall_warning_s / 5.0, 0.01)
+        while not self._shutdown.wait(interval):
+            self._check_stalls()
+
+    def _check_stalls(self):
+        """Warn about tensors stuck in the table (reference:
+        CheckForStalledTensors, operations.cc:1535-1581)."""
+        if self.stall_check_disabled:
+            return
+        now = time.monotonic()
+        if now - self._last_stall_warn < self.stall_warning_s:
+            return
+        with self._lock:
+            stalled = [
+                (n, now - e.enqueued_at)
+                for n, e in self._pending_names.items()
+                if now - e.enqueued_at > self.stall_warning_s
+            ]
+        if stalled:
+            self._last_stall_warn = now
+            names = ", ".join(f"{n} ({int(age)}s)" for n, age in stalled)
+            LOG.warning(
+                "One or more tensors were submitted to be reduced/gathered/"
+                "broadcast but have not completed for over %ds: %s",
+                int(self.stall_warning_s), names,
+            )
+
+    def shutdown(self):
+        self._shutdown.set()
+        self._thread.join(timeout=5)
+        with self._lock:
+            handles = list(self._handles.values())
+            self._handles.clear()
+            self._pending_names.clear()
+        for h in handles:
+            if not h.event.is_set():
+                h.error = ShutdownError("Horovod engine has been shut down")
+                h.event.set()
+        self.timeline.close()
+
+
+_engine: Optional[Engine] = None
+_engine_lock = threading.Lock()
+
+
+def get_engine() -> Engine:
+    global _engine
+    with _engine_lock:
+        if _engine is None:
+            _engine = Engine()
+        return _engine
+
+
+def shutdown_engine():
+    global _engine
+    with _engine_lock:
+        if _engine is not None:
+            _engine.shutdown()
+            _engine = None

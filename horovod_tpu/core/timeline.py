@@ -1,0 +1,99 @@
+"""Chrome-tracing timeline (reference: horovod/common/timeline.{h,cc} —
+same phase vocabulary, same per-tensor lanes, same HOROVOD_TIMELINE
+activation; device-side spans come from the XLA profiler instead of CUDA
+events)."""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Optional
+
+# Activity names (reference: operations.h:29-50).
+QUEUE = "QUEUE"
+NEGOTIATE_ALLREDUCE = "NEGOTIATE_ALLREDUCE"
+NEGOTIATE_ALLGATHER = "NEGOTIATE_ALLGATHER"
+NEGOTIATE_BROADCAST = "NEGOTIATE_BROADCAST"
+WAIT_FOR_DATA = "WAIT_FOR_DATA"
+MEMCPY_IN_FUSION_BUFFER = "MEMCPY_IN_FUSION_BUFFER"
+ALLREDUCE = "ALLREDUCE"
+ALLGATHER = "ALLGATHER"
+BROADCAST = "BROADCAST"
+MEMCPY_OUT_FUSION_BUFFER = "MEMCPY_OUT_FUSION_BUFFER"
+
+_FLUSH_INTERVAL_S = 1.0  # reference: timeline.h:32
+
+
+class Timeline:
+    """Rank-0 chrome://tracing JSON writer. One "pid" lane per tensor name
+    (reference: timeline.cc:60-96 metadata events)."""
+
+    def __init__(self, path: Optional[str]):
+        self._path = path
+        self._lock = threading.RLock()
+        self._fh = None
+        self._pids = {}
+        self._last_flush = 0.0
+        if path:
+            self._fh = open(path, "w")
+            self._fh.write("[\n")
+            self._start = time.monotonic()
+
+    @property
+    def enabled(self) -> bool:
+        return self._fh is not None
+
+    def _ts_us(self) -> int:
+        return int((time.monotonic() - self._start) * 1e6)
+
+    def _pid(self, name: str) -> int:
+        if name not in self._pids:
+            pid = len(self._pids) + 1
+            self._pids[name] = pid
+            self._emit(
+                {"name": "process_name", "ph": "M", "pid": pid,
+                 "args": {"name": name}}
+            )
+        return self._pids[name]
+
+    def _emit(self, ev: dict):
+        self._fh.write(json.dumps(ev) + ",\n")
+        now = time.monotonic()
+        if now - self._last_flush > _FLUSH_INTERVAL_S:
+            self._fh.flush()
+            self._last_flush = now
+
+    def _event(self, phase: str, tensor: str, activity: str,
+               args: Optional[dict]):
+        if not self.enabled:
+            return
+        with self._lock:
+            if self._fh is None:  # closed between the check and the lock
+                return
+            ev = {"name": activity, "ph": phase, "pid": self._pid(tensor),
+                  "ts": self._ts_us()}
+            if args:
+                ev["args"] = args
+            self._emit(ev)
+
+    def start(self, tensor: str, activity: str, args: Optional[dict] = None):
+        self._event("B", tensor, activity, args)
+
+    def end(self, tensor: str, activity: str, args: Optional[dict] = None):
+        self._event("E", tensor, activity, args)
+
+    def close(self):
+        if not self.enabled:
+            return
+        with self._lock:
+            self._fh.write("{}]\n")
+            self._fh.close()
+            self._fh = None
+
+
+def from_env() -> Timeline:
+    """HOROVOD_TIMELINE=<file> activation (reference: operations.cc:1732-1736);
+    HVD_TIMELINE is the native spelling."""
+    return Timeline(os.environ.get("HVD_TIMELINE") or os.environ.get("HOROVOD_TIMELINE"))

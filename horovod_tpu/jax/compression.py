@@ -1,0 +1,74 @@
+"""Gradient compression (reference: horovod/tensorflow/compression.py and
+horovod/torch/compression.py — same Compressor/none/fp16 surface).
+
+TPU-first difference: bf16 is the hardware-native reduced precision (full
+float32 range, MXU-native), so a ``bf16`` compressor is provided alongside
+``fp16`` and is the recommended default for wire compression.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+class Compressor:
+    """Interface: compress before the collective, decompress after
+    (reference: compression.py:20-31)."""
+
+    @staticmethod
+    def compress(tensor):
+        """Returns (compressed_tensor, ctx) where ctx carries what
+        decompress needs."""
+        raise NotImplementedError
+
+    @staticmethod
+    def decompress(tensor, ctx):
+        raise NotImplementedError
+
+
+class NoneCompressor(Compressor):
+    """Identity (reference: compression.py:33-43)."""
+
+    @staticmethod
+    def compress(tensor):
+        return tensor, None
+
+    @staticmethod
+    def decompress(tensor, ctx):
+        return tensor
+
+
+class _CastCompressor(Compressor):
+    wire_dtype: jnp.dtype = None
+
+    @classmethod
+    def compress(cls, tensor):
+        dtype = tensor.dtype
+        if jnp.issubdtype(dtype, jnp.floating) and dtype != cls.wire_dtype:
+            return tensor.astype(cls.wire_dtype), dtype
+        return tensor, None
+
+    @classmethod
+    def decompress(cls, tensor, ctx):
+        return tensor if ctx is None else tensor.astype(ctx)
+
+
+class FP16Compressor(_CastCompressor):
+    """Cast float tensors to float16 for the wire (reference:
+    compression.py:46-64)."""
+
+    wire_dtype = jnp.float16
+
+
+class BF16Compressor(_CastCompressor):
+    """Cast float tensors to bfloat16 — TPU-native reduced precision."""
+
+    wire_dtype = jnp.bfloat16
+
+
+class Compression:
+    """Option pack (reference: compression.py:67-74)."""
+
+    none = NoneCompressor
+    fp16 = FP16Compressor
+    bf16 = BF16Compressor

@@ -1,0 +1,230 @@
+"""PyTorch frontend (reference: horovod/torch/__init__.py).
+
+Torch compute stays on host CPU (no torch-TPU backend exists in this stack);
+collectives run through the async engine onto the XLA mesh. The training
+integration is identical to the reference's: per-parameter hooks fire
+asynchronous allreduces as gradients materialize, and ``step()`` drains them.
+"""
+
+from __future__ import annotations
+
+from typing import Iterator, Optional, Tuple
+
+import torch
+
+from horovod_tpu.common.topology import (  # noqa: F401
+    init,
+    shutdown,
+    is_initialized,
+    size,
+    rank,
+    local_size,
+    local_rank,
+    cross_size,
+    cross_rank,
+    num_processes,
+    process_index,
+    mpi_threads_supported,
+)
+from horovod_tpu.core.engine import DuplicateNameError, EngineError  # noqa: F401
+from horovod_tpu.torch.compression import Compression  # noqa: F401
+from horovod_tpu.torch.mpi_ops import (  # noqa: F401
+    allreduce,
+    allreduce_,
+    allreduce_async,
+    allreduce_async_,
+    allgather,
+    allgather_async,
+    broadcast,
+    broadcast_,
+    broadcast_async,
+    broadcast_async_,
+    poll,
+    synchronize,
+)
+
+
+class _DistributedOptimizer(torch.optim.Optimizer):
+    """Mixed into the user's optimizer class dynamically (reference:
+    horovod/torch/__init__.py:42-182). Gradient hooks use torch's
+    post-accumulate-grad hook — the modern form of the reference's
+    grad-accumulator expand_as trick (reference: :80-89)."""
+
+    def __init__(self, params, named_parameters, compression,
+                 backward_passes_per_step=1):
+        super(self.__class__, self).__init__(params)
+        self._compression = compression
+        if named_parameters is not None:
+            named_parameters = list(named_parameters)
+        else:
+            named_parameters = [
+                (f"allreduce.noname.{i}", v)
+                for i, v in enumerate(
+                    p for group in self.param_groups for p in group["params"]
+                )
+            ]
+        if len({name for name, _ in named_parameters}) < len(named_parameters):
+            raise ValueError("namespace of named_parameters is not unique")
+        all_params = {
+            id(p) for group in self.param_groups for p in group["params"]
+        }
+        unnamed = all_params - {id(p) for _, p in named_parameters}
+        if unnamed:
+            raise ValueError(
+                "named_parameters was specified but did not cover all "
+                f"optimizer parameters ({len(unnamed)} missing)"
+            )
+        self._parameter_names = {id(p): name for name, p in named_parameters}
+        self.backward_passes_per_step = backward_passes_per_step
+        self._allreduce_delay = {}
+        self._handles = {}
+        self._hook_handles = []
+        self._register_hooks()
+
+    def set_backward_passes_per_step(self, passes: int):
+        """Reference: torch/__init__.py:75-78."""
+        self.backward_passes_per_step = passes
+        for p in self._allreduce_delay:
+            self._allreduce_delay[p] = passes
+
+    def _register_hooks(self):
+        for group in self.param_groups:
+            for p in group["params"]:
+                if p.requires_grad:
+                    self._allreduce_delay[id(p)] = self.backward_passes_per_step
+                    self._hook_handles.append(
+                        p.register_post_accumulate_grad_hook(self._make_hook())
+                    )
+
+    def _make_hook(self):
+        def hook(p):
+            if id(p) in self._handles:
+                raise AssertionError(
+                    "Gradient was computed more than backward_passes_per_step "
+                    "times before step(); increase backward_passes_per_step "
+                    "or call synchronize()"
+                )
+            self._allreduce_delay[id(p)] -= 1
+            if self._allreduce_delay[id(p)] == 0:
+                self._handles[id(p)] = (p, self._allreduce_grad_async(p))
+
+        return hook
+
+    def _allreduce_grad_async(self, p):
+        name = self._parameter_names[id(p)]
+        compressed, ctx = self._compression.compress(p.grad)
+        handle = allreduce_async_(compressed, average=True, name=name)
+        return handle, compressed, ctx
+
+    def synchronize(self):
+        """Drain outstanding gradient reductions (reference:
+        torch/__init__.py:117-136)."""
+        for group in self.param_groups:
+            for p in group["params"]:
+                if p.requires_grad and id(p) not in self._handles \
+                        and p.grad is not None:
+                    # Parameter whose hook did not fire this step (e.g. after
+                    # manual backward wiring): reduce it now.
+                    self._handles[id(p)] = (p, self._allreduce_grad_async(p))
+        for pid, (p, (handle, compressed, ctx)) in list(self._handles.items()):
+            out = synchronize(handle)
+            self._allreduce_delay[pid] = self.backward_passes_per_step
+            p.grad.copy_(self._compression.decompress(out, ctx).to(p.grad.dtype))
+        self._handles.clear()
+
+    def step(self, closure=None):
+        self.synchronize()
+        return super(self.__class__, self).step(closure)
+
+
+def DistributedOptimizer(optimizer: torch.optim.Optimizer,
+                         named_parameters: Optional[Iterator[Tuple[str, torch.Tensor]]] = None,
+                         compression=Compression.none,
+                         backward_passes_per_step: int = 1):
+    """Wrap a torch optimizer with distributed gradient averaging
+    (reference: horovod/torch/__init__.py:139-182 — same dynamic-subclass
+    construction so isinstance(user_optimizer_cls) keeps working)."""
+    cls = type(optimizer.__class__.__name__, (optimizer.__class__,),
+               dict(_DistributedOptimizer.__dict__))
+    return cls(optimizer.param_groups, named_parameters, compression,
+               backward_passes_per_step)
+
+
+def broadcast_parameters(params, root_rank: int = 0):
+    """Broadcast a state_dict or list of (name, tensor) from root
+    (reference: horovod/torch/__init__.py:185-214)."""
+    if isinstance(params, dict):
+        items = sorted(params.items())
+    else:
+        items = list(params)
+        for it in items:
+            if not (isinstance(it, tuple) and len(it) == 2
+                    and isinstance(it[0], str)):
+                raise ValueError(
+                    "params must be a state_dict or an iterable of "
+                    "(name, tensor) pairs (e.g. model.named_parameters()); "
+                    f"got item of type {type(it).__name__}"
+                )
+    handles = []
+    for name, p in items:
+        if p is None:
+            continue
+        if torch.is_tensor(p):
+            handles.append(broadcast_async_(p, root_rank, name=name))
+        else:
+            raise ValueError(
+                f"cannot broadcast non-tensor value for '{name}' "
+                f"(type {type(p).__name__})"
+            )
+    for h in handles:
+        synchronize(h)
+
+
+def broadcast_optimizer_state(optimizer: torch.optim.Optimizer,
+                              root_rank: int = 0):
+    """Broadcast optimizer state from root (reference:
+    horovod/torch/__init__.py:217-333). Scalar hyperparameters are
+    tensor-ized for the wire and reconstructed with their original python
+    types, as in the reference's callback scheme."""
+    if isinstance(optimizer, torch.optim.LBFGS):
+        raise ValueError("cannot broadcast torch.optim.LBFGS state")
+    state_dict = optimizer.state_dict()
+
+    callbacks = []
+    handles = []
+
+    def _broadcast_value(container, key, value, name):
+        if torch.is_tensor(value):
+            handles.append(broadcast_async_(value, root_rank, name=name))
+            return
+        if isinstance(value, bool):
+            t = torch.tensor(int(value), dtype=torch.int64)
+            restore = lambda x: bool(x.item())  # noqa: E731
+        elif isinstance(value, int):
+            t = torch.tensor(value, dtype=torch.int64)
+            restore = lambda x: int(x.item())  # noqa: E731
+        elif isinstance(value, float):
+            t = torch.tensor(value, dtype=torch.float64)
+            restore = lambda x: float(x.item())  # noqa: E731
+        else:
+            return  # non-numeric options (None, str) assumed identical
+        h = broadcast_async_(t, root_rank, name=name)
+        handles.append(h)
+        callbacks.append(lambda c=container, k=key, x=t, r=restore: c.__setitem__(k, r(x)))
+
+    for index, group in enumerate(state_dict["param_groups"]):
+        for option_key, option_value in group.items():
+            if option_key == "params":
+                continue
+            _broadcast_value(group, option_key, option_value,
+                             f"optimizer.group.{index}.{option_key}")
+    for pid, param_state in state_dict["state"].items():
+        for name, value in param_state.items():
+            _broadcast_value(param_state, name, value,
+                             f"optimizer.state.{pid}.{name}")
+
+    for h in handles:
+        synchronize(h)
+    for cb in callbacks:
+        cb()
+    optimizer.load_state_dict(state_dict)

@@ -1,0 +1,216 @@
+"""Torch collective ops through the async engine (reference:
+horovod/torch/mpi_ops.py — same sync/async/in-place surface, same handle
+poll/synchronize model, same autograd gradient registrations).
+
+Torch has no TPU backend here; tensors live on host and collectives stage
+through the XLA mesh — the same architecture as the reference's CudaOnCPU
+staging path (reference: torch/mpi_ops_v2.cc:78-110), with the engine's
+background thread playing the role of the C++ comm thread.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+import numpy as np
+import torch
+
+from horovod_tpu.common.topology import rank, size
+from horovod_tpu.core import get_engine
+from horovod_tpu.torch.compression import Compression
+
+# Keep tensor references alive while the engine owns the request, exactly
+# like the reference's _handle_map (reference: torch/mpi_ops.py:51-54).
+_handle_map = {}
+_handle_lock = threading.Lock()
+_name_counter = 0
+
+
+def _auto_name(prefix: str, name: Optional[str]) -> str:
+    global _name_counter
+    if name is not None:
+        return name
+    with _handle_lock:
+        _name_counter += 1
+        return f"{prefix}.noname.{_name_counter}"
+
+
+def _np_of(tensor: torch.Tensor) -> np.ndarray:
+    if tensor.dtype == torch.bfloat16:
+        # numpy has no bf16; ride ml_dtypes so the wire stays bf16.
+        import ml_dtypes
+
+        return (
+            tensor.detach().cpu().contiguous().to(torch.float32).numpy()
+            .astype(ml_dtypes.bfloat16)
+        )
+    return tensor.detach().cpu().contiguous().numpy()
+
+
+def _torch_of(result: np.ndarray, like: Optional[torch.Tensor]) -> torch.Tensor:
+    import ml_dtypes
+
+    if result.dtype == ml_dtypes.bfloat16:
+        t = torch.from_numpy(np.array(result, np.float32)).to(torch.bfloat16)
+    else:
+        # np.array copies: collective results are read-only views of device
+        # buffers, and torch requires writable memory.
+        t = torch.from_numpy(np.array(result))
+    if like is not None and t.dtype != like.dtype and like.dtype == torch.bfloat16:
+        t = t.to(like.dtype)
+    return t
+
+
+def _register(handle: int, inputs, output: Optional[torch.Tensor]):
+    with _handle_lock:
+        _handle_map[handle] = (inputs, output)
+
+
+def poll(handle: int) -> bool:
+    """True once the collective finished; synchronize() will not block
+    (reference: torch/mpi_ops.py:406-421)."""
+    return get_engine().poll(handle)
+
+
+def synchronize(handle: int) -> torch.Tensor:
+    """Block until completion and return the output tensor (reference:
+    torch/mpi_ops.py:422-438). In-place variants copy into the input."""
+    with _handle_lock:
+        inputs, output = _handle_map.pop(handle, (None, None))
+    result = get_engine().synchronize(handle)  # raises EngineError on failure
+    like = inputs if isinstance(inputs, torch.Tensor) else None
+    t = _torch_of(result, like)
+    if output is not None:
+        # Raw storage write, like the reference's C++ adapters (autograd
+        # must not see the in-place copy on leaf Parameters).
+        with torch.no_grad():
+            if output.shape != t.shape:
+                output.resize_(t.shape)
+            output.copy_(t.to(output.dtype))
+        return output
+    return t
+
+
+# ---------------------------------------------------------------------------
+# allreduce
+# ---------------------------------------------------------------------------
+
+def allreduce_async(tensor: torch.Tensor, average: bool = True,
+                    name: Optional[str] = None) -> int:
+    out = torch.empty_like(tensor)
+    h = get_engine().allreduce_async(
+        _auto_name("allreduce", name), _np_of(tensor), average
+    )
+    _register(h, tensor, out)
+    return h
+
+
+def allreduce_async_(tensor: torch.Tensor, average: bool = True,
+                     name: Optional[str] = None) -> int:
+    h = get_engine().allreduce_async(
+        _auto_name("allreduce", name), _np_of(tensor), average
+    )
+    _register(h, tensor, tensor)
+    return h
+
+
+class HorovodAllreduce(torch.autograd.Function):
+    @staticmethod
+    def forward(ctx, tensor, average, name):
+        ctx.average = average
+        return synchronize(allreduce_async(tensor, average, name))
+
+    @staticmethod
+    def backward(ctx, grad_output):
+        return allreduce(grad_output, ctx.average), None, None
+
+
+def allreduce(tensor: torch.Tensor, average: bool = True,
+              name: Optional[str] = None, compression=Compression.none) -> torch.Tensor:
+    compressed, ctx = compression.compress(tensor)
+    out = HorovodAllreduce.apply(compressed, average, name)
+    return compression.decompress(out, ctx)
+
+
+def allreduce_(tensor: torch.Tensor, average: bool = True,
+               name: Optional[str] = None) -> torch.Tensor:
+    return synchronize(allreduce_async_(tensor, average, name))
+
+
+# ---------------------------------------------------------------------------
+# allgather
+# ---------------------------------------------------------------------------
+
+def allgather_async(tensor: torch.Tensor, name: Optional[str] = None) -> int:
+    h = get_engine().allgather_async(_auto_name("allgather", name), _np_of(tensor))
+    _register(h, tensor, None)
+    return h
+
+
+class HorovodAllgather(torch.autograd.Function):
+    @staticmethod
+    def forward(ctx, tensor, name):
+        ctx.dim = tensor.shape[0]
+        return synchronize(allgather_async(tensor, name))
+
+    @staticmethod
+    def backward(ctx, grad_output):
+        # Sum the gathered gradient across ranks, then slice this rank's rows
+        # (reference: torch/mpi_ops.py:246-254).
+        grad_reduced = allreduce(grad_output, average=False)
+        dims = allgather(torch.tensor([ctx.dim], dtype=torch.int32)).view(size())
+        r = rank()
+        offset = int(dims.narrow(0, 0, r).sum().item()) if r != 0 else 0
+        return grad_reduced.narrow(0, offset, ctx.dim), None
+
+
+def allgather(tensor: torch.Tensor, name: Optional[str] = None) -> torch.Tensor:
+    return HorovodAllgather.apply(tensor, name)
+
+
+# ---------------------------------------------------------------------------
+# broadcast
+# ---------------------------------------------------------------------------
+
+def broadcast_async(tensor: torch.Tensor, root_rank: int,
+                    name: Optional[str] = None) -> int:
+    out = torch.empty_like(tensor)
+    h = get_engine().broadcast_async(
+        _auto_name("broadcast", name), _np_of(tensor), root_rank
+    )
+    _register(h, tensor, out)
+    return h
+
+
+def broadcast_async_(tensor: torch.Tensor, root_rank: int,
+                     name: Optional[str] = None) -> int:
+    h = get_engine().broadcast_async(
+        _auto_name("broadcast", name), _np_of(tensor), root_rank
+    )
+    _register(h, tensor, tensor)
+    return h
+
+
+class HorovodBroadcast(torch.autograd.Function):
+    @staticmethod
+    def forward(ctx, tensor, root_rank, name):
+        ctx.root_rank = root_rank
+        return synchronize(broadcast_async(tensor, root_rank, name))
+
+    @staticmethod
+    def backward(ctx, grad_output):
+        grad_reduced = allreduce(grad_output, average=False)
+        if rank() != ctx.root_rank:
+            grad_reduced = grad_reduced * 0
+        return grad_reduced, None, None
+
+
+def broadcast(tensor: torch.Tensor, root_rank: int,
+              name: Optional[str] = None) -> torch.Tensor:
+    return HorovodBroadcast.apply(tensor, root_rank, name)
+
+
+def broadcast_(tensor: torch.Tensor, root_rank: int,
+               name: Optional[str] = None) -> torch.Tensor:
+    return synchronize(broadcast_async_(tensor, root_rank, name))
