@@ -1,0 +1,48 @@
+"""MNIST models matching the reference examples' architectures.
+
+``MnistConvNet`` is the 2-layer convnet of the reference's TF/Keras/torch
+MNIST examples — conv5x5(32) → pool → conv5x5(64) → pool → dense(1024) →
+dropout → dense(10) (reference: examples/tensorflow_mnist.py:30-63,
+examples/keras_mnist.py, examples/pytorch_mnist.py).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class MnistConvNet(nn.Module):
+    num_classes: int = 10
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        if x.ndim == 2:  # flat (B, 784) as the reference feeds it
+            x = x.reshape((-1, 28, 28, 1))
+        x = jnp.asarray(x, self.dtype)
+        x = nn.relu(nn.Conv(32, (5, 5), padding="SAME")(x))
+        x = nn.max_pool(x, (2, 2), strides=(2, 2), padding="SAME")
+        x = nn.relu(nn.Conv(64, (5, 5), padding="SAME")(x))
+        x = nn.max_pool(x, (2, 2), strides=(2, 2), padding="SAME")
+        x = x.reshape((x.shape[0], -1))
+        x = nn.relu(nn.Dense(1024)(x))
+        x = nn.Dropout(0.5, deterministic=not train)(x)
+        return nn.Dense(self.num_classes)(x)
+
+
+class MnistMLP(nn.Module):
+    """Small dense net — the smoke-test model for optimizer integration
+    tests (the role test_keras.py's 2-layer Dense model plays in the
+    reference, test/test_keras.py:41-77)."""
+
+    num_classes: int = 10
+    hidden: int = 128
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        x = x.reshape((x.shape[0], -1))
+        x = nn.relu(nn.Dense(self.hidden)(x))
+        return nn.Dense(self.num_classes)(x)

@@ -1,0 +1,134 @@
+"""Transformer encoder/LM — the tensor-fusion and long-context stress model.
+
+BASELINE.json names BERT-base pretraining as the fusion stress config (many
+large gradient buckets). The reference has no transformer; this one is
+TPU-designed: bf16 compute, f32 params, attention is *pluggable* so the
+sequence-parallel implementations in :mod:`horovod_tpu.parallel` (ring
+attention over ``ppermute``, Ulysses ``all_to_all``) slot in without model
+changes, and all control flow is static for XLA.
+
+Dimensions follow BERT-base (L=12, H=768, A=12) — every matmul dimension a
+multiple of 128, i.e. MXU-tile aligned.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+# attention_fn signature: (q, k, v, bias) -> out, with q/k/v shaped
+# (batch, seq, heads, head_dim). Default is plain softmax attention; the
+# parallel package provides ring/Ulysses implementations.
+AttentionFn = Callable[..., jnp.ndarray]
+
+
+def dot_product_attention(q, k, v, bias=None):
+    """Plain softmax attention, f32 accumulation on the MXU."""
+    scale = q.shape[-1] ** -0.5
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q * scale, k,
+                        preferred_element_type=jnp.float32)
+    if bias is not None:
+        logits = logits + bias
+    probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+
+
+def causal_attention(q, k, v, bias=None):
+    """Causal-masked attention for LM training."""
+    qlen, klen = q.shape[1], k.shape[1]
+    mask = jnp.tril(jnp.ones((qlen, klen), jnp.bool_))
+    causal_bias = jnp.where(mask, 0.0, -1e9)[None, None]
+    if bias is not None:
+        causal_bias = causal_bias + bias
+    return dot_product_attention(q, k, v, causal_bias)
+
+
+@dataclasses.dataclass(frozen=True)
+class TransformerConfig:
+    vocab_size: int = 30522  # BERT wordpiece vocab
+    num_layers: int = 12
+    num_heads: int = 12
+    hidden_dim: int = 768
+    mlp_dim: int = 3072
+    max_len: int = 512
+    dropout_rate: float = 0.1
+    dtype: Any = jnp.bfloat16
+    causal: bool = False
+    attention_fn: Optional[AttentionFn] = None
+    remat: bool = False  # jax.checkpoint each layer: FLOPs for HBM
+
+    @property
+    def head_dim(self) -> int:
+        return self.hidden_dim // self.num_heads
+
+
+class MultiHeadAttention(nn.Module):
+    cfg: TransformerConfig
+
+    @nn.compact
+    def __call__(self, x, mask_bias=None):
+        cfg = self.cfg
+        dense = lambda name: nn.DenseGeneral(  # noqa: E731
+            (cfg.num_heads, cfg.head_dim), dtype=cfg.dtype, name=name)
+        q, k, v = dense("query")(x), dense("key")(x), dense("value")(x)
+        attn = cfg.attention_fn or (
+            causal_attention if cfg.causal else dot_product_attention)
+        out = attn(q, k, v, mask_bias)
+        return nn.DenseGeneral(cfg.hidden_dim, axis=(-2, -1),
+                               dtype=cfg.dtype, name="out")(out)
+
+
+class EncoderLayer(nn.Module):
+    cfg: TransformerConfig
+
+    @nn.compact
+    def __call__(self, x, mask_bias=None, deterministic=True):
+        cfg = self.cfg
+        h = nn.LayerNorm(dtype=cfg.dtype)(x)
+        h = MultiHeadAttention(cfg)(h, mask_bias)
+        h = nn.Dropout(cfg.dropout_rate, deterministic=deterministic)(h)
+        x = x + h
+        h = nn.LayerNorm(dtype=cfg.dtype)(x)
+        h = nn.Dense(cfg.mlp_dim, dtype=cfg.dtype)(h)
+        h = nn.gelu(h)
+        h = nn.Dense(cfg.hidden_dim, dtype=cfg.dtype)(h)
+        h = nn.Dropout(cfg.dropout_rate, deterministic=deterministic)(h)
+        return x + h
+
+
+class TransformerLM(nn.Module):
+    """Token-in, logits-out transformer (pre-norm). With ``cfg.causal`` it
+    is a GPT-style LM; without, a BERT-style masked-LM encoder."""
+
+    cfg: TransformerConfig
+
+    @nn.compact
+    def __call__(self, tokens, deterministic: bool = True):
+        cfg = self.cfg
+        if tokens.shape[-1] > cfg.max_len:
+            # Out-of-range gathers are silently clamped under jit; fail
+            # loudly at trace time instead.
+            raise ValueError(
+                f"sequence length {tokens.shape[-1]} exceeds max_len "
+                f"{cfg.max_len}")
+        x = nn.Embed(cfg.vocab_size, cfg.hidden_dim, dtype=cfg.dtype,
+                     name="tok_embed")(tokens)
+        pos = jnp.arange(tokens.shape[-1])[None]
+        x = x + nn.Embed(cfg.max_len, cfg.hidden_dim, dtype=cfg.dtype,
+                         name="pos_embed")(pos)
+        layer = EncoderLayer
+        if cfg.remat:
+            layer = nn.remat(EncoderLayer, static_argnums=(3,))
+        for i in range(cfg.num_layers):
+            x = layer(cfg, name=f"layer_{i}")(x, None, deterministic)
+        x = nn.LayerNorm(dtype=cfg.dtype, name="final_norm")(x)
+        # Untied output head, f32 logits.
+        return nn.Dense(cfg.vocab_size, dtype=jnp.float32, name="lm_head")(x)
+
+
+def BertBase(**overrides) -> TransformerLM:
+    return TransformerLM(TransformerConfig(**overrides))

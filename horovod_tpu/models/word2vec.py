@@ -1,0 +1,63 @@
+"""Skip-gram word2vec with sampled-softmax (NEG) loss.
+
+The reference's word2vec example trains a 128-d embedding over a 50k vocab
+with NCE loss and allgathers nothing — its gradients are the sparse-
+embedding stress case (reference: examples/tensorflow_word2vec.py; sparse
+path: horovod/tensorflow/__init__.py:73-84). On TPU the embedding gradient
+is dense (scatter-add into the table happens on-chip), so the sparse
+IndexedSlices machinery is unnecessary on the hot path — but the JAX
+frontend's BCOO sparse allreduce covers the API.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+
+class Word2Vec(nn.Module):
+    vocab_size: int = 50000
+    embedding_dim: int = 128
+    dtype: Any = jnp.float32
+
+    def setup(self):
+        # U[-1, 1) as in the reference example
+        # (examples/tensorflow_word2vec.py:157); flax's uniform() is [0, s).
+        def _symmetric_uniform(key, shape, dtype):
+            return jax.random.uniform(key, shape, dtype, -1.0, 1.0)
+
+        self.embeddings = nn.Embed(self.vocab_size, self.embedding_dim,
+                                   embedding_init=_symmetric_uniform,
+                                   dtype=self.dtype)
+        self.nce_weight = self.param(
+            "nce_weight",
+            nn.initializers.truncated_normal(1.0 / self.embedding_dim ** 0.5),
+            (self.vocab_size, self.embedding_dim), self.dtype)
+        self.nce_bias = self.param("nce_bias", nn.initializers.zeros,
+                                   (self.vocab_size,), self.dtype)
+
+    def __call__(self, center: jnp.ndarray) -> jnp.ndarray:
+        """Embed center words: (B,) int32 -> (B, D)."""
+        return self.embeddings(center)
+
+    def neg_loss(self, center, context, negatives):
+        """Negative-sampling loss.
+
+        Args:
+          center: (B,) center word ids.
+          context: (B,) true context ids.
+          negatives: (B, K) sampled negative ids.
+        """
+        v = self.embeddings(center)  # (B, D)
+        u_pos = self.nce_weight[context]  # (B, D)
+        b_pos = self.nce_bias[context]
+        u_neg = self.nce_weight[negatives]  # (B, K, D)
+        b_neg = self.nce_bias[negatives]
+        pos = jnp.sum(v * u_pos, axis=-1) + b_pos  # (B,)
+        neg = jnp.einsum("bd,bkd->bk", v, u_neg) + b_neg  # (B, K)
+        loss_pos = -jax.nn.log_sigmoid(pos)
+        loss_neg = -jnp.sum(jax.nn.log_sigmoid(-neg), axis=-1)
+        return jnp.mean(loss_pos + loss_neg)

@@ -1,0 +1,151 @@
+"""Model zoo shape/grad sanity (the reference has no model tests — its
+examples are the coverage; here models are first-party so they get real
+tests)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from horovod_tpu import models
+
+
+def _init_and_apply(model, x, train=False):
+    rng = jax.random.PRNGKey(0)
+    variables = model.init({"params": rng, "dropout": rng}, x, train)
+    out = model.apply(variables, x, train,
+                      rngs={"dropout": rng} if train else None,
+                      mutable=["batch_stats"] if train else False)
+    return variables, out
+
+
+def test_mnist_cnn_shapes():
+    m = models.MnistConvNet()
+    x = jnp.zeros((4, 784))
+    _, out = _init_and_apply(m, x)
+    assert out.shape == (4, 10)
+
+
+def test_mnist_mlp_shapes():
+    m = models.MnistMLP()
+    _, out = _init_and_apply(m, jnp.zeros((2, 28, 28, 1)))
+    assert out.shape == (2, 10)
+
+
+@pytest.mark.parametrize("name,blocks", [("resnet18", 8), ("resnet50", 16)])
+def test_resnet_shapes(name, blocks):
+    m = models.get_model(name, num_classes=10, dtype=jnp.float32)
+    x = jnp.zeros((2, 64, 64, 3))
+    variables, out = _init_and_apply(m, x)
+    assert out[0].shape == (2, 10) if isinstance(out, tuple) else out.shape == (2, 10)
+
+
+def test_resnet50_param_count():
+    """ResNet-50 ImageNet has ~25.6M params; a structural checksum."""
+    m = models.ResNet50(num_classes=1000, dtype=jnp.float32)
+    variables = m.init(jax.random.PRNGKey(0), jnp.zeros((1, 224, 224, 3)),
+                      False)
+    n = sum(int(np.prod(p.shape)) for p in
+            jax.tree_util.tree_leaves(variables["params"]))
+    assert 25.4e6 < n < 25.8e6, n
+
+
+def test_resnet_train_updates_batch_stats():
+    m = models.ResNet18(num_classes=10, dtype=jnp.float32)
+    x = jnp.ones((2, 32, 32, 3))
+    rng = jax.random.PRNGKey(0)
+    variables = m.init(rng, x, True)
+    out, mutated = m.apply(variables, x, True, mutable=["batch_stats"])
+    assert out.shape == (2, 10)
+    before = jax.tree_util.tree_leaves(variables["batch_stats"])
+    after = jax.tree_util.tree_leaves(mutated["batch_stats"])
+    assert any(not np.allclose(b, a) for b, a in zip(before, after))
+
+
+def test_vgg16_param_count():
+    m = models.VGG16(num_classes=1000, dtype=jnp.float32)
+    variables = m.init(jax.random.PRNGKey(0), jnp.zeros((1, 224, 224, 3)),
+                      False)
+    n = sum(int(np.prod(p.shape)) for p in
+            jax.tree_util.tree_leaves(variables["params"]))
+    assert 138e6 < n < 139e6, n  # the communication-bound headline model
+
+
+def test_word2vec_loss_decreases():
+    m = models.Word2Vec(vocab_size=100, embedding_dim=16)
+    rng = jax.random.PRNGKey(0)
+    center = jnp.array([1, 2, 3, 4])
+    context = jnp.array([2, 3, 4, 5])
+    negs = jax.random.randint(rng, (4, 5), 0, 100)
+    variables = m.init(rng, center)
+
+    def loss_fn(params):
+        return m.apply({"params": params}, center, context, negs,
+                       method=m.neg_loss)
+
+    params = variables["params"]
+    l0 = loss_fn(params)
+    g = jax.grad(loss_fn)(params)
+    params = jax.tree_util.tree_map(lambda p, gr: p - 0.5 * gr, params, g)
+    l1 = loss_fn(params)
+    assert l1 < l0
+
+
+def test_transformer_lm_forward_and_grad():
+    cfg = models.TransformerConfig(
+        vocab_size=128, num_layers=2, num_heads=2, hidden_dim=32,
+        mlp_dim=64, max_len=16, dtype=jnp.float32, causal=True)
+    m = models.TransformerLM(cfg)
+    tokens = jnp.array([[1, 2, 3, 4, 5, 6, 7, 8]])
+    variables = m.init(jax.random.PRNGKey(0), tokens)
+    logits = m.apply(variables, tokens)
+    assert logits.shape == (1, 8, 128)
+
+    def loss_fn(params):
+        lg = m.apply({"params": params}, tokens)
+        tgt = jnp.roll(tokens, -1, axis=1)
+        return jnp.mean(
+            -jax.nn.log_softmax(lg)[0, jnp.arange(8), tgt[0]])
+
+    g = jax.grad(loss_fn)(variables["params"])
+    assert all(np.all(np.isfinite(x)) for x in jax.tree_util.tree_leaves(g))
+
+
+def test_transformer_causality():
+    """Changing a future token must not change past logits."""
+    cfg = models.TransformerConfig(
+        vocab_size=64, num_layers=1, num_heads=2, hidden_dim=16,
+        mlp_dim=32, max_len=8, dtype=jnp.float32, causal=True,
+        dropout_rate=0.0)
+    m = models.TransformerLM(cfg)
+    t1 = jnp.array([[1, 2, 3, 4]])
+    t2 = jnp.array([[1, 2, 3, 9]])
+    variables = m.init(jax.random.PRNGKey(0), t1)
+    l1 = m.apply(variables, t1)
+    l2 = m.apply(variables, t2)
+    np.testing.assert_allclose(l1[0, :3], l2[0, :3], atol=1e-5)
+
+
+def test_bert_base_param_count():
+    """BERT-base ~110M params (within tolerance; untied LM head adds ~23M)."""
+    m = models.BertBase(dtype=jnp.float32, num_layers=2)
+    tokens = jnp.zeros((1, 16), jnp.int32)
+    variables = m.init(jax.random.PRNGKey(0), tokens)
+    n = sum(int(np.prod(p.shape)) for p in
+            jax.tree_util.tree_leaves(variables["params"]))
+    # 2 layers: embeddings ~23.8M + 2*7.1M + head ~23.5M
+    assert 55e6 < n < 75e6, n
+
+
+def test_transformer_rejects_overlong_sequence():
+    cfg = models.TransformerConfig(
+        vocab_size=32, num_layers=1, num_heads=2, hidden_dim=16,
+        mlp_dim=32, max_len=8, dtype=jnp.float32)
+    m = models.TransformerLM(cfg)
+    with pytest.raises(ValueError, match="max_len"):
+        m.init(jax.random.PRNGKey(0), jnp.zeros((1, 16), jnp.int32))
+
+
+def test_get_model_unknown():
+    with pytest.raises(ValueError):
+        models.get_model("alexnet")
