@@ -1,0 +1,33 @@
+"""Parallelism strategies over TPU device meshes.
+
+The reference is data-parallel only (SURVEY.md §2.3) with one distributed
+trick: 2-level hierarchical collectives splitting intra-node (NCCL) from
+inter-node (MPI) traffic (reference: horovod/common/operations.cc:1194-1346,
+875-1010). On TPU the same two tiers are ICI (within a slice) and DCN
+(across slices); :mod:`horovod_tpu.parallel.hierarchical` implements the
+composition natively.
+
+Beyond reference parity, a TPU framework must scale model *and* sequence
+dimensions, so this package also provides tensor parallelism, sequence/
+context parallelism (ring attention, Ulysses all-to-all), and pipeline
+parallelism — all expressed as shardings + XLA collectives over a hybrid
+``jax.sharding.Mesh``.
+"""
+
+from horovod_tpu.parallel.mesh import (  # noqa: F401
+    hybrid_mesh,
+    two_tier_mesh,
+    MeshAxes,
+)
+from horovod_tpu.parallel.hierarchical import (  # noqa: F401
+    hierarchical_allreduce,
+    hierarchical_allgather,
+)
+from horovod_tpu.parallel.ring_attention import ring_attention  # noqa: F401
+from horovod_tpu.parallel.ulysses import ulysses_attention  # noqa: F401
+from horovod_tpu.parallel.tensor_parallel import (  # noqa: F401
+    ColumnParallelDense,
+    RowParallelDense,
+    ParallelMLP,
+)
+from horovod_tpu.parallel.pipeline import pipeline_apply  # noqa: F401

@@ -1,0 +1,77 @@
+"""Pipeline parallelism: GPipe schedule over a ``pp`` mesh axis.
+
+Each chip owns one stage's parameters; activations flow stage-to-stage via
+neighbour ``ppermute`` (ICI point-to-point) while ``M`` microbatches fill
+the pipe. The schedule is the classic GPipe fill-drain: ``M + P - 1`` ticks,
+bubble fraction ``(P-1)/(M+P-1)``.
+
+No reference equivalent (SURVEY.md §2.3). Implemented as a single
+``lax.fori_loop`` inside SPMD code so XLA overlaps each tick's compute with
+the activation shift.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def pipeline_apply(
+    stage_fn: Callable,
+    stage_params,
+    x_microbatches,
+    axis_name: str = "pp",
+):
+    """Run ``stage_fn`` over ``P`` pipeline stages for ``M`` microbatches.
+
+    Args:
+      stage_fn: ``(params, activation) -> activation``; this chip's stage.
+        Activation shape must be invariant across stages.
+      stage_params: this chip's stage parameters (under ``shard_map``, pass
+        a pytree whose leaves were sharded over ``axis_name``).
+      x_microbatches: (M, ...) microbatched input. Only stage 0 reads it.
+
+    Returns:
+      (M, ...) outputs, valid on every chip (the last stage's results are
+      broadcast back over the pp axis).
+    """
+    p = lax.psum(1, axis_name)
+    idx = lax.axis_index(axis_name)
+    m = x_microbatches.shape[0]
+    act_shape = x_microbatches.shape[1:]
+
+    perm = [(j, (j + 1) % p) for j in range(p)]
+    zero = jnp.zeros(act_shape, x_microbatches.dtype)
+
+    def tick(t, carry):
+        outputs, current = carry
+        # Stage 0 ingests microbatch t (or junk past the end, masked later).
+        feed = x_microbatches[jnp.minimum(t, m - 1)]
+        current = jnp.where(idx == 0, feed, current)
+        y = stage_fn(stage_params, current)
+        # The last stage finished microbatch t-(P-1) this tick.
+        done = t - (p - 1)
+        slot = jnp.clip(done, 0, m - 1)
+        take = jnp.logical_and(idx == p - 1, done >= 0)
+        outputs = lax.dynamic_update_index_in_dim(
+            outputs,
+            jnp.where(take, y, outputs[slot]).astype(outputs.dtype),
+            slot, axis=0)
+        return outputs, lax.ppermute(y, axis_name, perm)
+
+    outputs0 = jnp.zeros((m,) + act_shape, x_microbatches.dtype)
+    outputs, _ = lax.fori_loop(0, m + p - 1, tick, (outputs0, zero))
+    # Broadcast final outputs from the last stage to all pp ranks so the
+    # loss is computable everywhere (one psum of the microbatch outputs).
+    outputs = jnp.where(idx == p - 1, outputs, jnp.zeros_like(outputs))
+    return lax.psum(outputs, axis_name)
+
+
+def stack_stage_params(per_stage_params):
+    """Stack a list of per-stage parameter pytrees into one pytree with a
+    leading stage axis — shard that axis over ``pp`` and unstack inside
+    shard_map with ``jax.tree.map(lambda x: x[0], ...)``."""
+    return jax.tree.map(lambda *xs: jnp.stack(xs), *per_stage_params)

@@ -1,0 +1,96 @@
+"""Megatron-style tensor parallelism as flax modules inside SPMD code.
+
+Column-parallel Dense shards the output features (no communication: the
+activation becomes feature-sharded); row-parallel Dense shards the input
+features and allreduces the partial products. A column→row pair (the
+standard MLP/attention pattern) costs exactly one ``psum`` on the forward
+pass, and XLA inserts the mirror-image collectives for the backward pass.
+
+No reference equivalent (data-parallel only, SURVEY.md §2.3) — this is TPU
+scale-out scope. Modules must be applied inside ``shard_map`` with
+``axis_name`` bound; parameter shapes are the per-chip shards, so the same
+module works for any tp degree without padding logic (feature counts must
+divide evenly — MXU tiling wants that anyway).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import flax.linen as nn
+import jax.numpy as jnp
+from jax import lax
+
+
+def _axis_size(axis_name: str) -> int:
+    # psum of the literal 1 is folded to a static int at trace time.
+    return lax.psum(1, axis_name)
+
+
+class ColumnParallelDense(nn.Module):
+    """y = x @ W[:, shard]: output features sharded over ``axis_name``."""
+
+    features: int  # global output features
+    axis_name: str = "tp"
+    use_bias: bool = True
+    dtype: Any = jnp.bfloat16
+    kernel_init: Callable = nn.initializers.lecun_normal()
+
+    @nn.compact
+    def __call__(self, x):
+        size = _axis_size(self.axis_name)
+        if self.features % size != 0:
+            raise ValueError(
+                f"features {self.features} not divisible by tp={size}")
+        local = self.features // size
+        kernel = self.param("kernel", self.kernel_init,
+                            (x.shape[-1], local), jnp.float32)
+        y = jnp.dot(x.astype(self.dtype), kernel.astype(self.dtype))
+        if self.use_bias:
+            bias = self.param("bias", nn.initializers.zeros, (local,),
+                              jnp.float32)
+            y = y + bias.astype(self.dtype)
+        return y
+
+
+class RowParallelDense(nn.Module):
+    """y = psum_tp(x_shard @ W[shard, :]): input features sharded, output
+    replicated across the tp axis."""
+
+    features: int
+    axis_name: str = "tp"
+    use_bias: bool = True
+    dtype: Any = jnp.bfloat16
+    kernel_init: Callable = nn.initializers.lecun_normal()
+
+    @nn.compact
+    def __call__(self, x):
+        kernel = self.param("kernel", self.kernel_init,
+                            (x.shape[-1], self.features), jnp.float32)
+        y = jnp.dot(x.astype(self.dtype), kernel.astype(self.dtype))
+        y = lax.psum(y, self.axis_name)
+        if self.use_bias:
+            # Bias added once, after the reduction.
+            bias = self.param("bias", nn.initializers.zeros,
+                              (self.features,), jnp.float32)
+            y = y + bias.astype(self.dtype)
+        return y
+
+
+class ParallelMLP(nn.Module):
+    """Transformer MLP block, tensor-parallel: column(4H) → act → row(H),
+    one forward psum."""
+
+    hidden_dim: int
+    mlp_dim: int
+    axis_name: str = "tp"
+    dtype: Any = jnp.bfloat16
+    act: Callable = nn.gelu
+
+    @nn.compact
+    def __call__(self, x):
+        h = ColumnParallelDense(self.mlp_dim, self.axis_name,
+                                dtype=self.dtype, name="wi")(x)
+        h = self.act(h)
+        return RowParallelDense(self.hidden_dim, self.axis_name,
+                                dtype=self.dtype, name="wo")(h)

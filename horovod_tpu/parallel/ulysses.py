@@ -1,0 +1,60 @@
+"""Ulysses-style sequence parallelism: all-to-all head/sequence re-shard.
+
+The alternative context-parallel scheme to ring attention: instead of
+rotating K/V, re-shard — each chip trades its sequence shard of *all* heads
+for the *full* sequence of ``heads/P`` heads (one ``all_to_all``), runs
+ordinary attention on its heads, then re-shards back. Communication is
+2 all-to-alls of activation size regardless of sequence length, which on a
+TPU mesh rides ICI natively (no reference equivalent; SURVEY.md §5 notes
+long-context is absent there).
+
+Requires ``num_heads % axis_size == 0``. Shapes as in ring_attention:
+(batch, seq_local, heads, head_dim) sequence-sharded over ``axis_name``.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+from jax import lax
+
+from horovod_tpu.models.transformer import dot_product_attention
+
+
+def _seq_to_heads(x, axis_name):
+    # (b, s/P, h, d) -> (b, s, h/P, d): scatter heads, gather sequence.
+    return lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1,
+                          tiled=True)
+
+
+def _heads_to_seq(x, axis_name):
+    # (b, s, h/P, d) -> (b, s/P, h, d)
+    return lax.all_to_all(x, axis_name, split_axis=1, concat_axis=2,
+                          tiled=True)
+
+
+def ulysses_attention(q, k, v, axis_name: str,
+                      attention_fn: Optional[Callable] = None,
+                      bias=None):
+    """Exact attention over a sequence sharded on ``axis_name`` via head
+    re-sharding. ``attention_fn`` defaults to plain softmax attention and
+    may be any (q, k, v, bias) -> out kernel (e.g. a pallas flash kernel) —
+    it sees the full sequence and a head subset.
+
+    ``bias``, if given, uses the same layout as :func:`ring_attention`'s:
+    this chip's (b, heads, sq_local, seq_global) slice, query-sharded over
+    ``axis_name``. It is re-sharded to (b, heads/P, seq_global, seq_global)
+    alongside q/k/v.
+    """
+    h = q.shape[2]
+    size = lax.psum(1, axis_name)
+    if h % size != 0:
+        raise ValueError(
+            f"ulysses needs heads ({h}) divisible by axis size ({size})")
+    fn = attention_fn or dot_product_attention
+    q, k, v = (_seq_to_heads(t, axis_name) for t in (q, k, v))
+    if bias is not None:
+        bias = lax.all_to_all(bias, axis_name, split_axis=1, concat_axis=2,
+                              tiled=True)
+    out = fn(q, k, v, bias)
+    return _heads_to_seq(out, axis_name)

@@ -1,0 +1,268 @@
+"""Parallelism strategies on the virtual 8-device mesh: hierarchical
+collectives vs flat equivalents, ring/Ulysses attention vs single-device
+attention, TP layers vs dense reference, pipeline vs sequential stages."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+try:
+    from jax import shard_map
+except ImportError:
+    from jax.experimental.shard_map import shard_map
+
+from horovod_tpu import parallel
+from horovod_tpu.models.transformer import (
+    causal_attention,
+    dot_product_attention,
+)
+
+
+def _smap(fn, mesh, in_specs, out_specs):
+    return shard_map(fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                     check_vma=False)
+
+
+@pytest.fixture(scope="module")
+def devs():
+    d = jax.devices()
+    assert len(d) == 8, "conftest must provide 8 virtual devices"
+    return d
+
+
+# -- hierarchical collectives ------------------------------------------------
+
+def test_hierarchical_allreduce_matches_flat(devs):
+    mesh = parallel.hybrid_mesh({"dcn": 2, "ici": 4}, devs)
+    x = np.random.RandomState(0).randn(8, 5, 3).astype(np.float32)
+
+    def body(xs):
+        return parallel.hierarchical_allreduce(xs[0], "ici", "dcn")[None]
+
+    spec = P(("dcn", "ici"))
+    out = _smap(body, mesh, spec, spec)(x)
+    expect = x.sum(axis=0)
+    for row in np.asarray(out).reshape(8, 5, 3):
+        np.testing.assert_allclose(row, expect, rtol=1e-5)
+
+
+def test_hierarchical_allreduce_average_and_padding(devs):
+    mesh = parallel.hybrid_mesh({"dcn": 4, "ici": 2}, devs)
+    # 7 elements: not divisible by ici=2, exercises the pad path
+    # (reference analogue: FUSION_BUFFER_ATOMIC_UNIT, operations.h:52-54).
+    x = np.random.RandomState(1).randn(8, 7).astype(np.float32)
+
+    def body(xs):
+        return parallel.hierarchical_allreduce(
+            xs[0], "ici", "dcn", average=True)[None]
+
+    spec = P(("dcn", "ici"))
+    out = _smap(body, mesh, spec, spec)(x)
+    for row in np.asarray(out).reshape(8, 7):
+        np.testing.assert_allclose(row, x.mean(axis=0), rtol=1e-5)
+
+
+def test_hierarchical_allgather_rank_order(devs):
+    mesh = parallel.hybrid_mesh({"dcn": 2, "ici": 4}, devs)
+    x = np.arange(16, dtype=np.float32).reshape(8, 2)  # rank r: [2r, 2r+1]
+
+    def body(xs):
+        return parallel.hierarchical_allgather(xs[0], "ici", "dcn")[None]
+
+    spec = P(("dcn", "ici"))
+    out = _smap(body, mesh, spec, spec)(x)
+    got = np.asarray(out).reshape(8, 16)
+    for row in got:
+        np.testing.assert_array_equal(row, np.arange(16))
+
+
+# -- ring attention ----------------------------------------------------------
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_ring_attention_exact(devs, causal):
+    mesh = parallel.hybrid_mesh({"sp": 8}, devs)
+    rng = np.random.RandomState(2)
+    b, s, h, d = 2, 32, 2, 4
+    q, k, v = (rng.randn(b, s, h, d).astype(np.float32) for _ in range(3))
+    ref = (causal_attention if causal else dot_product_attention)(
+        jnp.asarray(q), jnp.asarray(k), jnp.asarray(v))
+
+    def body(q, k, v):
+        return parallel.ring_attention(q, k, v, "sp", causal=causal)
+
+    spec = P(None, "sp", None, None)
+    out = _smap(body, mesh, (spec, spec, spec), spec)(q, k, v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-4, atol=2e-5)
+
+
+def test_ring_attention_with_bias(devs):
+    mesh = parallel.hybrid_mesh({"sp": 4}, devs[:4])
+    rng = np.random.RandomState(3)
+    b, s, h, d = 1, 16, 2, 4
+    q, k, v = (rng.randn(b, s, h, d).astype(np.float32) for _ in range(3))
+    bias = rng.randn(b, h, s, s).astype(np.float32)
+    ref = dot_product_attention(jnp.asarray(q), jnp.asarray(k),
+                                jnp.asarray(v), jnp.asarray(bias))
+
+    def body(q, k, v, bias):
+        return parallel.ring_attention(q, k, v, "sp", bias=bias)
+
+    spec = P(None, "sp", None, None)
+    bspec = P(None, None, "sp", None)  # bias sharded on the *query* dim
+    out = _smap(body, mesh, (spec, spec, spec, bspec), spec)(q, k, v, bias)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-4, atol=2e-5)
+
+
+# -- Ulysses -----------------------------------------------------------------
+
+def test_ulysses_attention_exact(devs):
+    mesh = parallel.hybrid_mesh({"sp": 8}, devs)
+    rng = np.random.RandomState(4)
+    b, s, h, d = 2, 32, 8, 4
+    q, k, v = (rng.randn(b, s, h, d).astype(np.float32) for _ in range(3))
+    ref = dot_product_attention(jnp.asarray(q), jnp.asarray(k),
+                                jnp.asarray(v))
+
+    def body(q, k, v):
+        return parallel.ulysses_attention(q, k, v, "sp")
+
+    spec = P(None, "sp", None, None)
+    out = _smap(body, mesh, (spec, spec, spec), spec)(q, k, v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_ulysses_attention_with_bias(devs):
+    mesh = parallel.hybrid_mesh({"sp": 4}, devs[:4])
+    rng = np.random.RandomState(7)
+    b, s, h, d = 1, 16, 4, 4
+    q, k, v = (rng.randn(b, s, h, d).astype(np.float32) for _ in range(3))
+    bias = rng.randn(b, h, s, s).astype(np.float32)
+    ref = dot_product_attention(jnp.asarray(q), jnp.asarray(k),
+                                jnp.asarray(v), jnp.asarray(bias))
+
+    def body(q, k, v, bias):
+        return parallel.ulysses_attention(q, k, v, "sp", bias=bias)
+
+    spec = P(None, "sp", None, None)
+    bspec = P(None, None, "sp", None)  # same layout as ring_attention's
+    out = _smap(body, mesh, (spec, spec, spec, bspec), spec)(q, k, v, bias)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_ulysses_rejects_indivisible_heads(devs):
+    mesh = parallel.hybrid_mesh({"sp": 8}, devs)
+    x = np.zeros((1, 8, 4, 2), np.float32)  # 4 heads, 8-way sp
+
+    def body(q):
+        return parallel.ulysses_attention(q, q, q, "sp")
+
+    spec = P(None, "sp", None, None)
+    with pytest.raises(ValueError, match="divisible"):
+        _smap(body, mesh, spec, spec)(x)
+
+
+# -- tensor parallel ---------------------------------------------------------
+
+def test_parallel_mlp_matches_dense(devs):
+    mesh = parallel.hybrid_mesh({"tp": 8}, devs)
+    rng = np.random.RandomState(5)
+    hid, mlp = 16, 32
+    x = rng.randn(4, hid).astype(np.float32)
+    w1 = rng.randn(hid, mlp).astype(np.float32)
+    b1 = rng.randn(mlp).astype(np.float32)
+    w2 = rng.randn(mlp, hid).astype(np.float32)
+    b2 = rng.randn(hid).astype(np.float32)
+    import flax.linen as nn
+
+    ref = np.asarray(nn.gelu(jnp.asarray(x) @ w1 + b1) @ w2 + b2)
+
+    mlp_mod = parallel.ParallelMLP(hidden_dim=hid, mlp_dim=mlp,
+                                   dtype=jnp.float32)
+
+    def body(x, w1, b1, w2, b2):
+        params = {"wi": {"kernel": w1, "bias": b1},
+                  "wo": {"kernel": w2, "bias": b2}}
+        return mlp_mod.apply({"params": params}, x)
+
+    out = _smap(
+        body, mesh,
+        (P(), P(None, "tp"), P("tp"), P("tp", None), P()),
+        P(),
+    )(x, w1, b1, w2, b2)
+    np.testing.assert_allclose(np.asarray(out), ref, rtol=1e-4, atol=1e-4)
+
+
+def test_column_parallel_rejects_indivisible(devs):
+    mesh = parallel.hybrid_mesh({"tp": 8}, devs)
+    mod = parallel.ColumnParallelDense(12, dtype=jnp.float32)  # 12 % 8 != 0
+
+    def body(x):
+        return mod.init(jax.random.PRNGKey(0), x)["params"]["kernel"]
+
+    with pytest.raises(ValueError, match="divisible"):
+        _smap(body, mesh, P(), P(None, "tp"))(np.zeros((2, 4), np.float32))
+
+
+# -- pipeline ----------------------------------------------------------------
+
+@pytest.mark.parametrize("m", [4, 8])
+def test_pipeline_matches_sequential(devs, m):
+    p = 4
+    mesh = parallel.hybrid_mesh({"pp": p}, devs[:p])
+    rng = np.random.RandomState(6)
+    # Stage s: x -> tanh(x @ W_s + b_s)
+    ws = rng.randn(p, 6, 6).astype(np.float32) * 0.5
+    bs = rng.randn(p, 6).astype(np.float32) * 0.1
+    x = rng.randn(m, 3, 6).astype(np.float32)  # m microbatches of (3, 6)
+
+    expect = x.copy()
+    for s in range(p):
+        expect = np.tanh(expect @ ws[s] + bs[s])
+
+    def stage_fn(params, a):
+        w, b = params
+        return jnp.tanh(a @ w + b)
+
+    def body(ws, bs, x):
+        return parallel.pipeline_apply(stage_fn, (ws[0], bs[0]), x, "pp")
+
+    out = _smap(
+        body, mesh, (P("pp"), P("pp"), P()), P()
+    )(ws, bs, x)
+    np.testing.assert_allclose(np.asarray(out), expect, rtol=1e-5, atol=1e-5)
+
+
+# -- hybrid 4D step ----------------------------------------------------------
+
+def test_hybrid_4d_step_trains(devs):
+    """One dp×pp×tp×sp step must run and reduce the loss."""
+    from horovod_tpu.parallel import hybrid
+
+    l0, l1 = hybrid.dryrun(8, devs)
+    assert l1 < l0, (l0, l1)
+
+
+def test_hybrid_partition_axes():
+    from horovod_tpu.parallel.hybrid import partition_axes
+
+    assert partition_axes(8) == {"dp": 1, "pp": 2, "tp": 2, "sp": 2}
+    assert partition_axes(16) == {"dp": 2, "pp": 2, "tp": 2, "sp": 2}
+    assert partition_axes(1) == {"dp": 1, "pp": 1, "tp": 1, "sp": 1}
+    assert partition_axes(6) == {"dp": 3, "pp": 2, "tp": 1, "sp": 1}
+
+
+def test_mesh_validation(devs):
+    with pytest.raises(ValueError, match="devices"):
+        parallel.hybrid_mesh({"dp": 3}, devs)
+
+
+def test_two_tier_mesh_single_host(devs):
+    mesh = parallel.two_tier_mesh(devs)
+    assert mesh.axis_names == ("dcn", "ici")
+    assert mesh.devices.shape == (1, 8)
