@@ -1,0 +1,136 @@
+#!/usr/bin/env python
+"""Synthetic ResNet-50 training benchmark — the driver's headline metric.
+
+Methodology mirrors the reference's synthetic benchmark (reference:
+examples/tensorflow_synthetic_benchmark.py:17-28,77-106): random data,
+``DistributedOptimizer`` training step, N warmup batches, then
+``num_iters x num_batches_per_iter`` timed steps, reporting images/sec per
+chip as mean ± 1.96σ.
+
+Prints exactly one JSON line:
+  {"metric": ..., "value": N, "unit": "images/sec/chip", "vs_baseline": N}
+
+vs_baseline compares against the only absolute throughput figure published in
+the reference tree: 1656.82 images/sec on 16 GPUs (ResNet-101,
+docs/benchmarks.md:33-38) → 103.55 images/sec per device.
+"""
+
+import argparse
+import json
+import sys
+import time
+
+BASELINE_IMG_PER_SEC_PER_DEVICE = 1656.82 / 16  # reference docs/benchmarks.md:33-38
+
+
+def main():
+    p = argparse.ArgumentParser(description="horovod_tpu synthetic benchmark")
+    p.add_argument("--model", default="resnet50")
+    p.add_argument("--batch-size", type=int, default=32,
+                   help="per-chip batch size (reference default 32)")
+    p.add_argument("--image-size", type=int, default=224)
+    p.add_argument("--num-warmup-batches", type=int, default=10)
+    p.add_argument("--num-batches-per-iter", type=int, default=10)
+    p.add_argument("--num-iters", type=int, default=10)
+    p.add_argument("--fp16-allreduce", action="store_true",
+                   help="bf16 gradient compression on the wire")
+    args = p.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+    from jax.sharding import PartitionSpec as P
+
+    import horovod_tpu as hvd
+    import horovod_tpu.jax as hvd_jax
+    from horovod_tpu import models
+
+    hvd.init()
+    nchips = hvd.size()
+
+    model = models.get_model(args.model)
+    compression = (hvd_jax.Compression.fp16 if args.fp16_allreduce
+                   else hvd_jax.Compression.none)
+    opt = hvd_jax.DistributedOptimizer(
+        optax.sgd(0.01, momentum=0.9), compression=compression)
+
+    rng = jax.random.PRNGKey(0)
+    images_host = np.random.uniform(
+        size=(args.batch_size, args.image_size, args.image_size, 3)
+    ).astype(np.float32)
+    labels_host = np.random.randint(0, 1000, size=(args.batch_size,))
+
+    variables = model.init(rng, jnp.asarray(images_host), False)
+    params, batch_stats = variables["params"], variables.get("batch_stats", {})
+    opt_state = opt.init(params)
+    # Startup sync, as every reference example does before training
+    # (reference: BroadcastGlobalVariablesHook).
+    params = hvd_jax.broadcast_parameters(params, root_rank=0)
+
+    def loss_fn(params, batch_stats, images, labels):
+        logits, mutated = model.apply(
+            {"params": params, "batch_stats": batch_stats}, images, True,
+            mutable=["batch_stats"])
+        loss = optax.softmax_cross_entropy_with_integer_labels(
+            logits, labels).mean()
+        return loss, mutated["batch_stats"]
+
+    @hvd_jax.jit(
+        in_specs=(P(), P(), P(), P(hvd_jax.HVD_AXIS), P(hvd_jax.HVD_AXIS)),
+        out_specs=(P(), P(), P(), P()),
+        donate_argnums=(0, 1, 2),
+    )
+    def train_step(params, batch_stats, opt_state, images, labels):
+        (loss, new_bs), grads = jax.value_and_grad(
+            loss_fn, has_aux=True)(params, batch_stats, images, labels)
+        updates, opt_state = opt.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, new_bs, opt_state, hvd_jax.allreduce(loss)
+
+    # Each chip sees the full per-chip batch: global batch = B * size.
+    mesh = hvd.mesh()
+    from jax.sharding import NamedSharding
+
+    def chip_batch(x):
+        shards = [jax.device_put(x, d) for d in jax.local_devices()
+                  if d in mesh.devices.flat]
+        global_shape = (x.shape[0] * nchips,) + x.shape[1:]
+        return jax.make_array_from_single_device_arrays(
+            global_shape, NamedSharding(mesh, P(hvd_jax.HVD_AXIS)), shards)
+
+    images = chip_batch(images_host)
+    labels = chip_batch(labels_host)
+
+    def run_batches(n):
+        nonlocal params, batch_stats, opt_state
+        loss = None
+        for _ in range(n):
+            params, batch_stats, opt_state, loss = train_step(
+                params, batch_stats, opt_state, images, labels)
+        jax.block_until_ready(loss)
+
+    run_batches(args.num_warmup_batches)
+
+    rates = []
+    for _ in range(args.num_iters):
+        t0 = time.perf_counter()
+        run_batches(args.num_batches_per_iter)
+        dt = time.perf_counter() - t0
+        rates.append(args.batch_size * args.num_batches_per_iter / dt)
+
+    per_chip = float(np.mean(rates))
+    result = {
+        "metric": f"{args.model}_train_images_per_sec_per_chip"
+                  f"_bs{args.batch_size}",
+        "value": round(per_chip, 2),
+        "unit": "images/sec/chip",
+        "vs_baseline": round(per_chip / BASELINE_IMG_PER_SEC_PER_DEVICE, 3),
+    }
+    print(json.dumps(result))
+    print(f"# {nchips} chip(s), ±{1.96 * float(np.std(rates)):.1f} img/sec, "
+          f"platform={jax.devices()[0].platform}", file=sys.stderr)
+
+
+if __name__ == "__main__":
+    main()
