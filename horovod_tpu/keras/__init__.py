@@ -1,0 +1,303 @@
+"""High-level trainer frontend — the role horovod.keras plays in the
+reference (reference: horovod/keras/__init__.py, horovod/_keras/__init__.py).
+
+Keras itself is not the compute stack on TPU; the equivalent surface is a
+compiled flax/optax ``Trainer`` with the same integration points the
+reference patches into Keras: a distributed optimizer wrapping gradient
+reduction (reference: _keras/__init__.py:20-70 create_distributed_optimizer),
+the callback suite (:mod:`horovod_tpu.keras.callbacks`), and
+``load_model``/``save_model`` that round-trip the *wrapped* optimizer state
+(reference: _keras/__init__.py:93-109).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Callable, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from horovod_tpu.common.topology import (  # noqa: F401
+    init, shutdown, is_initialized, size, rank, local_size, local_rank,
+    cross_size, cross_rank, mesh,
+)
+from horovod_tpu.jax import (
+    DistributedOptimizer,  # noqa: F401 — same wrapper (reference binds P9 to keras)
+    Compression,  # noqa: F401
+    allreduce_pytree,
+    broadcast_pytree,
+    jit as _hvd_jit,
+)
+from horovod_tpu.jax import allreduce as _allreduce
+from horovod_tpu.keras import callbacks  # noqa: F401
+from horovod_tpu.ops.collectives import HVD_AXIS
+from horovod_tpu.utils import checkpoint as _ckpt
+
+
+def _default_loss(logits, labels):
+    return optax.softmax_cross_entropy_with_integer_labels(
+        logits, labels).mean()
+
+
+class Trainer:
+    """Compiled data-parallel fit/evaluate loop over the world mesh.
+
+    The training step (forward, backward, fused gradient allreduce,
+    optimizer update) is one XLA program; callbacks run host-side between
+    steps, mirroring Keras's contract in the reference.
+    """
+
+    def __init__(
+        self,
+        model,
+        optimizer: optax.GradientTransformation,
+        loss_fn: Callable = _default_loss,
+        metrics: Sequence[str] = ("accuracy",),
+        distributed: bool = True,
+        compression=Compression.none,
+        rng: int = 0,
+    ):
+        self.model = model
+        if distributed:
+            optimizer = DistributedOptimizer(optimizer,
+                                             compression=compression)
+        self.optimizer = optimizer
+        self.loss_fn = loss_fn
+        self.metrics = tuple(metrics)
+        self.rng = jax.random.PRNGKey(rng)
+        self.params = None
+        self.batch_stats = {}
+        self.opt_state = None
+        self.lr_scale = 1.0
+        self.steps_per_epoch: Optional[int] = None
+        self._train_step = None
+        self._eval_step = None
+        self._epoch = 0
+
+    # -- state ---------------------------------------------------------------
+
+    def build(self, x_sample):
+        """Initialize parameters from one (host) batch sample."""
+        if self.params is not None:
+            return
+        self.rng, key = jax.random.split(self.rng)
+        variables = self.model.init(
+            {"params": key, "dropout": key}, jnp.asarray(x_sample), False)
+        self.params = variables["params"]
+        self.batch_stats = dict(variables.get("batch_stats", {}))
+        self.opt_state = self.optimizer.init(self.params)
+
+    def broadcast_state(self, root_rank: int = 0):
+        """Reference: BroadcastGlobalVariablesCallback on_train_begin."""
+        self.params = broadcast_pytree(self.params, root_rank)
+        if self.batch_stats:
+            self.batch_stats = broadcast_pytree(self.batch_stats, root_rank)
+        self.opt_state = broadcast_pytree(self.opt_state, root_rank)
+
+    def set_lr_scale(self, scale: float, momentum_correction: bool = False):
+        """Scale the effective learning rate (callbacks drive this). With
+        ``momentum_correction``, SGD momentum buffers are rescaled by
+        ``new/old`` (Goyal et al.; reference: _keras/callbacks.py:104-113)."""
+        old, self.lr_scale = self.lr_scale, float(scale)
+        if momentum_correction and old != self.lr_scale and old != 0:
+            factor = self.lr_scale / old
+            self.opt_state = jax.tree_util.tree_map(
+                lambda s: (s._replace(
+                    trace=jax.tree_util.tree_map(
+                        lambda t: t * factor, s.trace))
+                    if isinstance(s, optax.TraceState) else s),
+                self.opt_state,
+                is_leaf=lambda s: isinstance(s, optax.TraceState))
+
+    # -- compiled steps ------------------------------------------------------
+
+    def _build_steps(self):
+        model, loss_fn, opt = self.model, self.loss_fn, self.optimizer
+        want_acc = "accuracy" in self.metrics
+
+        def forward(params, batch_stats, x, y, train, dropout_key):
+            variables = {"params": params}
+            if batch_stats:
+                variables["batch_stats"] = batch_stats
+            kwargs = {"rngs": {"dropout": dropout_key}} if train else {}
+            if batch_stats and train:
+                logits, mutated = model.apply(variables, x, train,
+                                              mutable=["batch_stats"],
+                                              **kwargs)
+                new_bs = mutated["batch_stats"]
+            else:
+                logits = model.apply(variables, x, train, **kwargs)
+                new_bs = batch_stats
+            return loss_fn(logits, y), (logits, new_bs)
+
+        def metrics_of(loss, logits, y):
+            logs = {"loss": _allreduce(loss)}
+            if want_acc:
+                acc = jnp.mean(jnp.argmax(logits, -1) == y)
+                logs["accuracy"] = _allreduce(acc)
+            return logs
+
+        @_hvd_jit(in_specs=(P(), P(), P(), P(HVD_AXIS), P(HVD_AXIS), P(),
+                            P()),
+                  out_specs=(P(), P(), P(), P()))
+        def train_step(params, batch_stats, opt_state, x, y, lr_scale,
+                       dropout_key):
+            (loss, (logits, new_bs)), grads = jax.value_and_grad(
+                forward, has_aux=True)(params, batch_stats, x, y, True,
+                                       dropout_key)
+            updates, opt_state = opt.update(grads, opt_state, params)
+            updates = jax.tree_util.tree_map(lambda u: u * lr_scale, updates)
+            params = optax.apply_updates(params, updates)
+            return params, new_bs, opt_state, metrics_of(loss, logits, y)
+
+        @_hvd_jit(in_specs=(P(), P(), P(HVD_AXIS), P(HVD_AXIS)),
+                  out_specs=P())
+        def eval_step(params, batch_stats, x, y):
+            loss, (logits, _) = forward(params, batch_stats, x, y, False,
+                                        jax.random.PRNGKey(0))
+            return metrics_of(loss, logits, y)
+
+        self._train_step, self._eval_step = train_step, eval_step
+
+    # -- data plumbing -------------------------------------------------------
+
+    def _shard(self, arr):
+        """Place this controller's host batch over its local chips, forming
+        the (global_batch, ...) mesh-sharded array."""
+        m = mesh()
+        nloc = local_size()
+        per = arr.shape[0] // nloc
+        shards = [
+            jax.device_put(arr[i * per:(i + 1) * per], d)
+            for i, d in enumerate(m.local_mesh.devices.flat)
+        ]
+        shape = (per * size(),) + arr.shape[1:]
+        return jax.make_array_from_single_device_arrays(
+            shape, NamedSharding(m, P(HVD_AXIS)), shards)
+
+    def _batches(self, x, y, batch_size, shuffle, seed):
+        n_local = batch_size * local_size()
+        steps = len(x) // n_local
+        idx = np.arange(steps * n_local)
+        if shuffle:
+            np.random.default_rng(seed).shuffle(idx)
+        for s in range(steps):
+            sel = idx[s * n_local:(s + 1) * n_local]
+            yield self._shard(x[sel]), self._shard(y[sel])
+
+    # -- public API ----------------------------------------------------------
+
+    def fit(self, x, y, batch_size: int = 32, epochs: int = 1,
+            callbacks: Sequence = (), validation_data=None,
+            initial_epoch: int = 0, shuffle: bool = True,
+            verbose: int = 0) -> dict:
+        """Train; returns a history dict of per-epoch logs. ``x``/``y`` are
+        this process's host arrays; ``batch_size`` is per chip (global
+        batch = batch_size * size), matching the reference examples'
+        convention."""
+        x, y = np.asarray(x), np.asarray(y)
+        self.build(x[:batch_size * max(local_size(), 1)])
+        if self._train_step is None:
+            self._build_steps()
+        self.steps_per_epoch = len(x) // (batch_size * local_size())
+        for cb in callbacks:
+            cb.set_trainer(self)
+        history: dict = {}
+        for cb in callbacks:
+            cb.on_train_begin()
+        for epoch in range(initial_epoch, epochs):
+            self._epoch = epoch
+            for cb in callbacks:
+                cb.on_epoch_begin(epoch)
+            logs = {}
+            for b, (xb, yb) in enumerate(
+                    self._batches(x, y, batch_size, shuffle, seed=epoch)):
+                for cb in callbacks:
+                    cb.on_batch_begin(b)
+                self.rng, dk = jax.random.split(self.rng)
+                self.params, self.batch_stats, self.opt_state, logs = \
+                    self._train_step(self.params, self.batch_stats,
+                                     self.opt_state, xb, yb,
+                                     jnp.float32(self.lr_scale), dk)
+                logs = {k: float(v) for k, v in logs.items()}
+                for cb in callbacks:
+                    cb.on_batch_end(b, logs)
+            if validation_data is not None:
+                val = self.evaluate(*validation_data, batch_size=batch_size)
+                logs.update({f"val_{k}": v for k, v in val.items()})
+            for cb in callbacks:
+                cb.on_epoch_end(epoch, logs)
+            for k, v in logs.items():
+                history.setdefault(k, []).append(v)
+            if verbose:
+                print(f"epoch {epoch}: " +
+                      " ".join(f"{k}={v:.4f}" for k, v in logs.items()))
+        for cb in callbacks:
+            cb.on_train_end()
+        return history
+
+    def evaluate(self, x, y, batch_size: int = 32) -> dict:
+        x, y = np.asarray(x), np.asarray(y)
+        self.build(x[:batch_size * max(local_size(), 1)])
+        if self._eval_step is None:
+            self._build_steps()
+        totals: dict = {}
+        steps = 0
+        for xb, yb in self._batches(x, y, batch_size, False, 0):
+            logs = self._eval_step(self.params, self.batch_stats, xb, yb)
+            for k, v in logs.items():
+                totals[k] = totals.get(k, 0.0) + float(v)
+            steps += 1
+        return {k: v / max(steps, 1) for k, v in totals.items()}
+
+    def predict(self, x, batch_size: int = 32):
+        x = np.asarray(x)
+        outs = [np.asarray(self.model.apply(
+            {"params": self.params, **({"batch_stats": self.batch_stats}
+                                       if self.batch_stats else {})},
+            jnp.asarray(x[i:i + batch_size]), False))
+            for i in range(0, len(x), batch_size)]
+        return np.concatenate(outs) if outs else np.zeros((0,))
+
+    # -- persistence (reference: hvd.load_model, _keras/__init__.py:93-109) --
+
+    def state_dict(self) -> dict:
+        return {"params": self.params, "batch_stats": self.batch_stats,
+                "opt_state": self.opt_state, "epoch": self._epoch,
+                "lr_scale": self.lr_scale}
+
+    def save(self, directory: str, step: Optional[int] = None):
+        """Write a checkpoint (process 0 only; atomic)."""
+        return _ckpt.save_checkpoint(
+            directory, self.state_dict(),
+            self._epoch if step is None else step)
+
+    def load(self, path: str, x_sample, root_rank: int = 0):
+        """Restore params + *wrapped* optimizer state and broadcast from
+        root so all ranks resume identically."""
+        self.build(x_sample)
+        restored = _ckpt.load_checkpoint(path, self.state_dict(),
+                                         root_rank=root_rank)
+        self.params = restored["params"]
+        self.batch_stats = restored["batch_stats"]
+        self.opt_state = restored["opt_state"]
+        self._epoch = int(restored["epoch"])
+        self.lr_scale = float(restored["lr_scale"])
+        return self
+
+
+def save_model(trainer: Trainer, directory: str,
+               step: Optional[int] = None):
+    return trainer.save(directory, step)
+
+
+def load_model(path: str, model, optimizer, x_sample, **trainer_kwargs):
+    """Reconstruct a Trainer with a distributed-wrapped optimizer from a
+    checkpoint — the reference's ``hvd.load_model`` wraps the deserialized
+    optimizer in the same way (reference: _keras/__init__.py:93-109)."""
+    t = Trainer(model, optimizer, **trainer_kwargs)
+    return t.load(path, x_sample)

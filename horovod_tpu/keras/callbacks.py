@@ -1,0 +1,140 @@
+"""Training callbacks with the reference's Keras-callback semantics.
+
+Reference: horovod/_keras/callbacks.py (impls) re-exported by
+horovod/keras/callbacks.py and horovod/tensorflow/keras/callbacks.py. They
+run inside :class:`horovod_tpu.keras.Trainer`'s fit loop, which provides the
+same hook points as Keras (`on_train_begin`, `on_epoch_begin`,
+`on_batch_begin/end`, `on_epoch_end`).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional, Union
+
+from horovod_tpu.common import topology as _topo
+from horovod_tpu.utils.metrics import MetricAverage
+
+
+class Callback:
+    """Hook container; the trainer assigns itself to ``self.trainer``."""
+
+    trainer = None
+
+    def set_trainer(self, trainer):
+        self.trainer = trainer
+
+    def on_train_begin(self, logs=None): ...
+    def on_train_end(self, logs=None): ...
+    def on_epoch_begin(self, epoch, logs=None): ...
+    def on_epoch_end(self, epoch, logs=None): ...
+    def on_batch_begin(self, batch, logs=None): ...
+    def on_batch_end(self, batch, logs=None): ...
+
+
+class BroadcastGlobalVariablesCallback(Callback):
+    """Broadcast initial model + optimizer state from ``root_rank`` at the
+    start of training (reference: _keras/callbacks.py:20-30; the TF hook
+    equivalent is BroadcastGlobalVariablesHook,
+    horovod/tensorflow/__init__.py:118-149)."""
+
+    def __init__(self, root_rank: int = 0):
+        self.root_rank = root_rank
+
+    def on_train_begin(self, logs=None):
+        self.trainer.broadcast_state(self.root_rank)
+
+
+class MetricAverageCallback(Callback):
+    """Allreduce-average epoch-end metrics over ranks so logged values are
+    global (reference: _keras/callbacks.py:33-67)."""
+
+    def on_epoch_end(self, epoch, logs=None):
+        if logs:
+            logs.update(MetricAverage(logs))
+
+
+class LearningRateScheduleCallback(Callback):
+    """Multiply the learning rate by ``multiplier(epoch)`` inside
+    [start_epoch, end_epoch) (reference: _keras/callbacks.py:70-146).
+
+    ``staircase=True`` adjusts once per epoch; ``False`` interpolates per
+    batch using ``steps_per_epoch`` (autodetected from the trainer).
+    ``momentum_correction`` rescales SGD momentum buffers by
+    ``new_lr/old_lr`` when the rate changes (Goyal et al. 2017 — the
+    reference does this by briefly scaling the momentum *coefficient*,
+    which is the same first-order correction).
+    """
+
+    def __init__(self, multiplier: Union[float, Callable[[float], float]],
+                 start_epoch: int = 0, end_epoch: Optional[int] = None,
+                 staircase: bool = True, momentum_correction: bool = True,
+                 steps_per_epoch: Optional[int] = None):
+        self.start_epoch = start_epoch
+        self.end_epoch = end_epoch
+        self.staircase = staircase
+        self.momentum_correction = momentum_correction
+        self.steps_per_epoch = steps_per_epoch
+        self.current_epoch = 0
+        if not callable(multiplier):
+            self.staircase = True
+            self.multiplier = lambda epoch: multiplier
+        else:
+            self.multiplier = multiplier
+
+    def on_train_begin(self, logs=None):
+        if not self.staircase and not self.steps_per_epoch:
+            self.steps_per_epoch = self.trainer.steps_per_epoch
+            if not self.steps_per_epoch:
+                raise ValueError(
+                    "could not autodetect steps_per_epoch; pass it to "
+                    f"{type(self).__name__}()")
+
+    def on_epoch_begin(self, epoch, logs=None):
+        self.current_epoch = epoch
+
+    def _adjust(self, epoch: float):
+        old = self.trainer.lr_scale
+        new = float(self.multiplier(epoch))
+        self.trainer.set_lr_scale(
+            new, momentum_correction=self.momentum_correction)
+        return old, new
+
+    def on_batch_begin(self, batch, logs=None):
+        if self.current_epoch < self.start_epoch or (
+                self.end_epoch is not None
+                and self.current_epoch >= self.end_epoch):
+            return
+        if self.staircase and batch == 0:
+            self._adjust(self.current_epoch)
+        elif not self.staircase:
+            self._adjust(self.current_epoch + batch / self.steps_per_epoch)
+
+    def on_epoch_end(self, epoch, logs=None):
+        if logs is not None:
+            logs["lr"] = self.trainer.lr_scale
+
+
+class LearningRateWarmupCallback(LearningRateScheduleCallback):
+    """Gradual warmup from lr/size to lr over ``warmup_epochs`` — the
+    formula of the reference (_keras/callbacks.py:149-168, after Goyal et
+    al.): ``1/size * (epoch*(size-1)/warmup + 1)``."""
+
+    def __init__(self, warmup_epochs: int = 5,
+                 momentum_correction: bool = True,
+                 steps_per_epoch: Optional[int] = None, verbose: int = 0):
+        def multiplier(epoch):
+            size = _topo.size()
+            epoch += 1.0 / self.steps_per_epoch
+            return 1.0 / size * (epoch * (size - 1) / warmup_epochs + 1)
+
+        super().__init__(multiplier, start_epoch=0, end_epoch=warmup_epochs,
+                         staircase=False,
+                         momentum_correction=momentum_correction,
+                         steps_per_epoch=steps_per_epoch)
+        self.verbose = verbose
+
+    def on_epoch_end(self, epoch, logs=None):
+        super().on_epoch_end(epoch, logs)
+        if epoch == self.end_epoch - 1 and self.verbose:
+            print(f"\nEpoch {epoch + 1}: finished gradual learning rate "
+                  f"warmup to scale {self.trainer.lr_scale:g}.")

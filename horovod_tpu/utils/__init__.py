@@ -1,0 +1,8 @@
+"""Shared utilities: checkpointing, metrics."""
+
+from horovod_tpu.utils.checkpoint import (  # noqa: F401
+    save_checkpoint,
+    load_checkpoint,
+    latest_checkpoint,
+)
+from horovod_tpu.utils.metrics import Metric, MetricAverage  # noqa: F401

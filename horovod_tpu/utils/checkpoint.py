@@ -1,0 +1,70 @@
+"""Distributed-consistent checkpointing.
+
+The reference delegates checkpoint IO to the framework and contributes the
+*consistency* protocol: rank 0 writes, everyone restores, restored state is
+broadcast so ranks agree (reference: examples/pytorch_imagenet_resnet50.py:
+70-80,135-143, horovod/torch/__init__.py:217-333, SURVEY.md §5). Same
+protocol here over flax msgpack serialization: ``save_checkpoint`` writes on
+process 0 only; ``load_checkpoint`` reads everywhere and broadcasts the
+result from root so a restored run starts bitwise-identical on every rank.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+from typing import Any, Optional
+
+import flax.serialization
+
+from horovod_tpu.common import topology as _topo
+
+
+def _ckpt_path(directory: str, step: int, prefix: str) -> str:
+    return os.path.join(directory, f"{prefix}{step}.msgpack")
+
+
+def save_checkpoint(directory: str, target: Any, step: int,
+                    prefix: str = "checkpoint_") -> Optional[str]:
+    """Serialize ``target`` (any flax-serializable pytree) on process 0.
+    Returns the path written, or None on non-root processes."""
+    st = _topo._require_init()
+    if st.process_index != 0:
+        return None
+    os.makedirs(directory, exist_ok=True)
+    path = _ckpt_path(directory, step, prefix)
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
+        f.write(flax.serialization.to_bytes(target))
+    os.replace(tmp, path)  # atomic: no torn checkpoints on preemption
+    return path
+
+
+def latest_checkpoint(directory: str,
+                      prefix: str = "checkpoint_") -> Optional[str]:
+    """Newest checkpoint path by step number, or None (the resume-from-epoch
+    scan of the reference examples, pytorch_imagenet_resnet50.py:70-80)."""
+    if not os.path.isdir(directory):
+        return None
+    pat = re.compile(re.escape(prefix) + r"(\d+)\.msgpack$")
+    best = None
+    for name in os.listdir(directory):
+        m = pat.match(name)
+        if m:
+            step = int(m.group(1))
+            if best is None or step > best[0]:
+                best = (step, os.path.join(directory, name))
+    return best[1] if best else None
+
+
+def load_checkpoint(path: str, target: Any, broadcast: bool = True,
+                    root_rank: int = 0) -> Any:
+    """Restore ``target``-shaped state from ``path``; broadcast from
+    ``root_rank`` so all ranks agree even if local files diverged."""
+    with open(path, "rb") as f:
+        restored = flax.serialization.from_bytes(target, f.read())
+    if broadcast and _topo._require_init().size > 1:
+        from horovod_tpu.ops.collectives import broadcast_pytree
+
+        restored = broadcast_pytree(restored, root_rank=root_rank)
+    return restored

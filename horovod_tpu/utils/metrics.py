@@ -1,0 +1,46 @@
+"""Distributed metric averaging.
+
+The reference provides this twice: a Keras callback allreducing epoch-end
+metrics (reference: horovod/_keras/callbacks.py:33-67) and a hand-rolled
+``Metric`` class in the examples (reference:
+examples/pytorch_imagenet_resnet50.py:255-268). Both shapes are here.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from horovod_tpu.ops import collectives as _C
+
+
+class Metric:
+    """Running average whose value is allreduce-averaged across ranks
+    (reference: examples/pytorch_imagenet_resnet50.py:255-268)."""
+
+    def __init__(self, name: str):
+        self.name = name
+        self.sum = 0.0
+        self.n = 0
+
+    def update(self, value):
+        self.sum += float(value)
+        self.n += 1
+
+    @property
+    def avg(self) -> float:
+        if self.n == 0:
+            return 0.0
+        local = self.sum / self.n
+        return float(_C.allreduce(jnp.asarray(local), average=True))
+
+
+def MetricAverage(values: dict) -> dict:
+    """Allreduce-average a dict of scalars across ranks in one fused
+    collective (reference: _keras/callbacks.py:52-67 does it one allreduce
+    per metric)."""
+    if not values:
+        return {}
+    keys = sorted(values)
+    stacked = jnp.asarray([float(values[k]) for k in keys], jnp.float32)
+    avg = _C.allreduce(stacked, average=True)
+    return {k: float(avg[i]) for i, k in enumerate(keys)}
