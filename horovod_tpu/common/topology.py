@@ -140,6 +140,17 @@ def init(ranks: Optional[Sequence[int]] = None, devices: Optional[Sequence] = No
             counts[d.process_index] = counts.get(d.process_index, 0) + 1
         _state.homogeneous = len(set(counts.values())) == 1
         _state.initialized = True
+    # If an engine was constructed before init() (legal: enqueue works
+    # pre-init), re-apply its params so the multi-controller fusion guard
+    # sees the now-known topology.
+    try:
+        from horovod_tpu.core import engine as _eng
+
+        if _eng._engine is not None:
+            _eng._engine.set_params(
+                fusion_threshold=_eng._engine.fusion_threshold)
+    except Exception:
+        pass
 
 
 def shutdown():

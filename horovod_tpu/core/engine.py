@@ -120,6 +120,61 @@ class JaxExecutor:
             return np.asarray(C.broadcast(jnp.asarray(tensor), root_rank))
 
 
+def _multi_controller() -> bool:
+    """True when more than one controller process is active. Fusion
+    decisions are local to a controller; with several controllers, local
+    drain timing could fuse different batches on different processes and
+    launch mismatched collective programs — the failure the reference's
+    rank-0 negotiation exists to prevent (operations.cc:279-517). Until
+    negotiation lands, multi-process runs execute one deterministic
+    collective per tensor."""
+    try:
+        from horovod_tpu.common import topology as _topo
+
+        return _topo.is_initialized() and _topo.num_processes() > 1
+    except Exception:
+        return False
+
+
+def config_from_env(cycle_time_s: Optional[float],
+                    fusion_threshold: Optional[int],
+                    stall_warning_s: float):
+    """Shared env-knob parsing for both engine implementations (reference:
+    operations.cc:1732-1804). Returns (cycle_time_s, fusion_threshold,
+    stall_warning_s)."""
+    if cycle_time_s is None:
+        ms = os.environ.get("HVD_CYCLE_TIME") or os.environ.get(
+            "HOROVOD_CYCLE_TIME")
+        cycle_time_s = float(ms) / 1000.0 if ms else DEFAULT_CYCLE_TIME_S
+    if fusion_threshold is None:
+        b = os.environ.get("HVD_FUSION_THRESHOLD") or os.environ.get(
+            "HOROVOD_FUSION_THRESHOLD")
+        fusion_threshold = int(b) if b else DEFAULT_FUSION_THRESHOLD
+    if _multi_controller():
+        fusion_threshold = 0
+    if os.environ.get("HVD_STALL_CHECK_DISABLE") or os.environ.get(
+            "HOROVOD_STALL_CHECK_DISABLE"):
+        stall_warning_s = 0.0
+    return cycle_time_s, fusion_threshold, stall_warning_s
+
+
+def make_autotuner(engine):
+    """Shared autotuner construction (reference: HOROVOD_AUTOTUNE,
+    operations.cc:1797-1804). Returns a ParameterManager or None; tuning
+    is gated to single-controller worlds (see _multi_controller). Failures
+    are reported, not silently swallowed, and never take the engine down."""
+    from horovod_tpu.tune import ParameterManager, autotune_enabled
+
+    if not autotune_enabled() or _multi_controller():
+        return None
+    try:
+        return ParameterManager(engine)
+    except Exception as exc:
+        LOG.warning("HVD_AUTOTUNE requested but the autotuner failed to "
+                    "start (%s); continuing without autotuning", exc)
+        return None
+
+
 class Engine:
     def __init__(
         self,
@@ -129,40 +184,13 @@ class Engine:
         stall_warning_s: float = STALL_WARNING_TIME_S,
         timeline: Optional[tl.Timeline] = None,
     ):
-        # Env knobs read once at engine start (reference:
-        # operations.cc:1732-1804).
-        if cycle_time_s is None:
-            ms = os.environ.get("HVD_CYCLE_TIME") or os.environ.get("HOROVOD_CYCLE_TIME")
-            cycle_time_s = float(ms) / 1000.0 if ms else DEFAULT_CYCLE_TIME_S
-        if fusion_threshold is None:
-            mb = os.environ.get("HVD_FUSION_THRESHOLD") or os.environ.get(
-                "HOROVOD_FUSION_THRESHOLD"
-            )
-            fusion_threshold = int(mb) if mb else DEFAULT_FUSION_THRESHOLD
-        self.cycle_time_s = cycle_time_s
-        # Fusion decisions are local to this controller. With multiple
-        # controller processes, local drain timing could fuse different
-        # batches on different processes and launch mismatched collective
-        # programs — the failure the reference's rank-0 negotiation exists
-        # to prevent (operations.cc:279-517). Until the native engine's
-        # negotiation lands, multi-process runs execute one deterministic
-        # collective per tensor (name-ordered within each cycle).
-        try:
-            from horovod_tpu.common import topology as _topo
-
-            if _topo.is_initialized() and _topo.num_processes() > 1:
-                fusion_threshold = 0
-        except Exception:
-            pass
-        self.fusion_threshold = fusion_threshold
-        self.stall_warning_s = stall_warning_s
-        self.stall_check_disabled = bool(
-            os.environ.get("HVD_STALL_CHECK_DISABLE")
-            or os.environ.get("HOROVOD_STALL_CHECK_DISABLE")
-        )
+        self.cycle_time_s, self.fusion_threshold, stall_warning_s = \
+            config_from_env(cycle_time_s, fusion_threshold, stall_warning_s)
+        self.stall_warning_s = stall_warning_s or STALL_WARNING_TIME_S
+        self.stall_check_disabled = stall_warning_s == 0.0
         self.executor = executor or JaxExecutor()
         self.timeline = timeline if timeline is not None else tl.from_env()
-
+        self._param_manager = make_autotuner(self)
         self._queue: "queue.Queue[_Entry]" = queue.Queue()
         self._handles: Dict[int, _Handle] = {}
         self._pending_names: Dict[str, _Entry] = {}
@@ -267,8 +295,24 @@ class Engine:
         for e in self._drain():
             self._complete(e, None, err)
 
+    def set_params(self, cycle_time_s: Optional[float] = None,
+                   fusion_threshold: Optional[int] = None):
+        """Live parameter updates (the autotuner drives this)."""
+        if cycle_time_s is not None and cycle_time_s > 0:
+            self.cycle_time_s = cycle_time_s
+        if fusion_threshold is not None and fusion_threshold >= 0:
+            # The multi-controller invariant holds even if topology came up
+            # after engine construction: fusion stays off.
+            self.fusion_threshold = 0 if _multi_controller() \
+                else fusion_threshold
+
     def _run_cycle(self):
         entries = self._drain()
+        if entries and self._param_manager is not None:
+            # One update per engine cycle with that cycle's traffic — the
+            # manager's scoring window contract (parameter_manager.cc
+            # scores bytes per cycle tick).
+            self._param_manager.update(sum(e.tensor.nbytes for e in entries))
         if entries:
             # Fuse allreduces per (dtype, average) in request order up to the
             # threshold (reference: operations.cc:2035-2074); other ops run
@@ -401,11 +445,27 @@ _engine: Optional[Engine] = None
 _engine_lock = threading.Lock()
 
 
-def get_engine() -> Engine:
+def _make_engine():
+    """HVD_ENGINE selects the implementation: 'native' (default — the C++
+    libhvdcore scheduler) or 'python' (this module's reference engine).
+    Falls back to Python if the native build is unavailable."""
+    choice = os.environ.get("HVD_ENGINE", "native").lower()
+    if choice == "native":
+        try:
+            from horovod_tpu.core.native_engine import NativeEngine
+
+            return NativeEngine()
+        except Exception as exc:  # no toolchain — degrade, loudly
+            LOG.warning("native engine unavailable (%s); "
+                        "falling back to the python engine", exc)
+    return Engine()
+
+
+def get_engine():
     global _engine
     with _engine_lock:
         if _engine is None:
-            _engine = Engine()
+            _engine = _make_engine()
         return _engine
 
 

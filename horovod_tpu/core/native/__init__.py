@@ -1,0 +1,120 @@
+"""ctypes binding + build for libhvdcore (the native engine).
+
+Mirrors the reference's loader role (reference: horovod/common/__init__.py:
+51-56 loads the C library RTLD_GLOBAL; setup.py builds it). Here the
+library is a single translation unit built on demand with g++ — no MPI, no
+framework headers — so it compiles anywhere in seconds and is cached next
+to the source.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_DIR, "hvdcore.cc")
+_LIB = os.path.join(_DIR, "libhvdcore.so")
+
+_lock = threading.Lock()
+_lib = None
+
+
+class NativeBuildError(RuntimeError):
+    pass
+
+
+def build_library(force: bool = False) -> str:
+    """Compile libhvdcore.so if missing or stale. Returns the path."""
+    with _lock:
+        if (not force and os.path.exists(_LIB)
+                and os.path.getmtime(_LIB) >= os.path.getmtime(_SRC)):
+            return _LIB
+        # pid-suffixed temp: concurrent processes (multi-controller first
+        # run on a shared filesystem) must not compile into the same file;
+        # os.replace makes the final publish atomic whoever wins.
+        tmp = f"{_LIB}.tmp.{os.getpid()}.so"
+        cmd = ["g++", "-O2", "-g", "-std=c++17", "-fPIC", "-shared",
+               "-pthread", "-Wall", _SRC, "-o", tmp]
+        proc = subprocess.run(cmd, capture_output=True, text=True)
+        if proc.returncode != 0:
+            raise NativeBuildError(
+                f"failed to build libhvdcore: {proc.stderr[-2000:]}")
+        os.replace(tmp, _LIB)
+        return _LIB
+
+
+class HvdRequest(ctypes.Structure):
+    _fields_ = [
+        ("op", ctypes.c_int),
+        ("dtype_num", ctypes.c_int),
+        ("itemsize", ctypes.c_int),
+        ("average", ctypes.c_int),
+        ("root_rank", ctypes.c_int),
+        ("prescale", ctypes.c_double),
+        ("names", ctypes.c_char_p),
+        ("data", ctypes.c_void_p),
+        ("count", ctypes.c_longlong),
+        ("ndim", ctypes.c_int),
+        ("shape", ctypes.c_longlong * 8),
+    ]
+
+
+class HvdResult(ctypes.Structure):
+    _fields_ = [
+        ("data", ctypes.c_void_p),
+        ("nbytes", ctypes.c_longlong),
+        ("ndim", ctypes.c_int),
+        ("shape", ctypes.c_longlong * 8),
+        ("error", ctypes.c_char * 256),
+    ]
+
+
+EXEC_FN = ctypes.CFUNCTYPE(ctypes.c_int, ctypes.c_void_p,
+                           ctypes.POINTER(HvdRequest),
+                           ctypes.POINTER(HvdResult))
+
+
+def load_library():
+    """Build if needed, load, and declare signatures. Cached."""
+    global _lib
+    if _lib is not None:
+        return _lib
+    path = build_library()
+    lib = ctypes.CDLL(path)
+    lib.hvd_engine_create.restype = ctypes.c_void_p
+    lib.hvd_engine_create.argtypes = [ctypes.c_double, ctypes.c_longlong,
+                                      ctypes.c_double, ctypes.c_char_p]
+    lib.hvd_engine_set_executor.argtypes = [ctypes.c_void_p, EXEC_FN,
+                                            ctypes.c_void_p]
+    lib.hvd_engine_set_params.argtypes = [ctypes.c_void_p, ctypes.c_double,
+                                          ctypes.c_longlong]
+    lib.hvd_alloc.restype = ctypes.c_void_p
+    lib.hvd_alloc.argtypes = [ctypes.c_longlong]
+    lib.hvd_engine_enqueue.restype = ctypes.c_longlong
+    lib.hvd_engine_enqueue.argtypes = [
+        ctypes.c_void_p, ctypes.c_int, ctypes.c_char_p, ctypes.c_int,
+        ctypes.c_int, ctypes.c_void_p, ctypes.POINTER(ctypes.c_longlong),
+        ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_double,
+        ctypes.c_char_p]
+    lib.hvd_engine_poll.restype = ctypes.c_int
+    lib.hvd_engine_poll.argtypes = [ctypes.c_void_p, ctypes.c_longlong]
+    lib.hvd_engine_wait_meta.restype = ctypes.c_int
+    lib.hvd_engine_wait_meta.argtypes = [
+        ctypes.c_void_p, ctypes.c_longlong,
+        ctypes.POINTER(ctypes.c_longlong), ctypes.POINTER(ctypes.c_int),
+        ctypes.POINTER(ctypes.c_longlong), ctypes.c_char_p]
+    lib.hvd_engine_copy_result.restype = ctypes.c_int
+    lib.hvd_engine_copy_result.argtypes = [
+        ctypes.c_void_p, ctypes.c_longlong, ctypes.c_void_p,
+        ctypes.c_longlong]
+    lib.hvd_engine_drop.argtypes = [ctypes.c_void_p, ctypes.c_longlong]
+    lib.hvd_engine_pending.restype = ctypes.c_longlong
+    lib.hvd_engine_pending.argtypes = [ctypes.c_void_p]
+    lib.hvd_engine_shutdown.argtypes = [ctypes.c_void_p]
+    lib.hvd_engine_join.argtypes = [ctypes.c_void_p]
+    lib.hvd_engine_destroy.argtypes = [ctypes.c_void_p]
+    _lib = lib
+    return lib

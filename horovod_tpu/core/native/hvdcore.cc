@@ -1,0 +1,664 @@
+// libhvdcore — native background collective engine for horovod_tpu.
+//
+// TPU-native re-design of the reference's C++ core (reference:
+// horovod/common/operations.cc): one background thread owns the request
+// queue and tensor table, drains it every cycle, fuses compatible
+// allreduces into flat buffers up to a threshold, executes them through a
+// registered executor callback (the XLA data plane lives on the Python
+// side), and completes integer handles that framework threads wait on
+// (reference: torch/handle_manager.cc).
+//
+// What is intentionally ABSENT vs the reference: the rank-0 MPI
+// negotiation protocol (operations.cc:279-517). A single controller
+// process observes its own program order, and SPMD determinism makes
+// cross-rank agreement structural rather than negotiated; the duplicate-
+// name and shutdown-error semantics are preserved (operations.cc:265-268,
+// 1833-1848).
+//
+// Also here, matching reference subsystems:
+//  - stall watchdog (CheckForStalledTensors, operations.cc:1535-1581)
+//  - chrome-tracing timeline writer (common/timeline.cc)
+
+#include <atomic>
+#include <chrono>
+#include <condition_variable>
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <deque>
+#include <fstream>
+#include <map>
+#include <memory>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <unordered_map>
+#include <unordered_set>
+#include <vector>
+
+namespace {
+
+using Clock = std::chrono::steady_clock;
+
+double SecondsSince(Clock::time_point t0) {
+  return std::chrono::duration<double>(Clock::now() - t0).count();
+}
+
+// ---------------------------------------------------------------------------
+// C ABI shared with Python (ctypes)
+// ---------------------------------------------------------------------------
+
+extern "C" {
+
+// HVD_TICK is engine→executor only: end-of-cycle notification carrying the
+// cycle's total traffic in `count` (bytes), so the Python-side autotuner
+// scores per engine cycle exactly as the reference's ParameterManager does.
+enum HvdOp {
+  HVD_ALLREDUCE = 0,
+  HVD_ALLGATHER = 1,
+  HVD_BROADCAST = 2,
+  HVD_TICK = 3
+};
+
+struct hvd_request {
+  int op;
+  int dtype_num;   // numpy dtype .num — opaque to C++, round-tripped
+  int itemsize;
+  int average;
+  int root_rank;
+  double prescale;
+  const char* names;  // ';'-joined tensor names of the fused batch
+  void* data;         // fused input buffer
+  long long count;    // elements in data
+  // For non-fusable ops the original shape rides along:
+  int ndim;
+  long long shape[8];
+};
+
+struct hvd_result {
+  // Callback contract: for same-size results (allreduce, broadcast) write
+  // in place and set data = req->data. For size-changing results
+  // (allgather) set data to a buffer from hvd_alloc(); the engine frees it
+  // after copying out. Anything else would dangle once the Python callback
+  // frame drops its references.
+  void* data;
+  long long nbytes;
+  int ndim;
+  long long shape[8];
+  char error[256];
+};
+
+typedef int (*hvd_exec_fn)(void* ctx, hvd_request* req, hvd_result* res);
+
+void* hvd_alloc(long long nbytes) { return malloc((size_t)nbytes); }
+
+}  // extern "C"
+
+// ---------------------------------------------------------------------------
+// Timeline (reference: common/timeline.cc — rank-0 chrome tracing JSON)
+// ---------------------------------------------------------------------------
+
+class Timeline {
+ public:
+  void Initialize(const std::string& path) {
+    if (path.empty()) return;
+    std::lock_guard<std::mutex> g(mu_);
+    file_.open(path);
+    if (file_.good()) {
+      file_ << "[\n";
+      active_ = true;
+      start_ = Clock::now();
+    }
+  }
+
+  bool Active() const { return active_; }
+
+  // Phase span per tensor lane (reference uses one chrome "pid" per tensor
+  // name — timeline.cc:60-96).
+  void Begin(const std::string& name, const char* phase) {
+    Emit(name, phase, 'B');
+  }
+  void End(const std::string& name, const char* phase) {
+    Emit(name, phase, 'E');
+  }
+
+  void Close() {
+    std::lock_guard<std::mutex> g(mu_);
+    if (!active_) return;
+    file_ << "]\n";
+    file_.close();
+    active_ = false;
+  }
+
+ private:
+  void Sep() {
+    if (first_) {
+      first_ = false;
+    } else {
+      file_ << ",\n";
+    }
+  }
+
+  void Emit(const std::string& name, const char* phase, char ph) {
+    if (!active_) return;
+    std::lock_guard<std::mutex> g(mu_);
+    if (!active_) return;
+    long long ts = (long long)(SecondsSince(start_) * 1e6);
+    int pid;
+    auto it = lanes_.find(name);
+    if (it == lanes_.end()) {
+      pid = (int)lanes_.size() + 1;
+      lanes_[name] = pid;
+      Sep();
+      file_ << "{\"name\":\"process_name\",\"ph\":\"M\",\"pid\":" << pid
+            << ",\"args\":{\"name\":\"" << name << "\"}}";
+    } else {
+      pid = it->second;
+    }
+    Sep();
+    file_ << "{\"name\":\"" << phase << "\",\"ph\":\"" << ph
+          << "\",\"pid\":" << pid << ",\"ts\":" << ts << "}";
+    // 1 s flush horizon like the reference (timeline.h:32).
+    if (SecondsSince(last_flush_) > 1.0) {
+      file_.flush();
+      last_flush_ = Clock::now();
+    }
+  }
+
+  std::mutex mu_;
+  std::ofstream file_;
+  std::unordered_map<std::string, int> lanes_;
+  Clock::time_point start_, last_flush_ = Clock::now();
+  bool active_ = false;
+  bool first_ = true;
+};
+
+// ---------------------------------------------------------------------------
+// Engine
+// ---------------------------------------------------------------------------
+
+struct Entry {
+  long long handle;
+  std::string name;
+  int op;
+  int dtype_num;
+  int itemsize;
+  int average;
+  int root_rank;
+  double prescale;
+  std::vector<char> data;
+  std::vector<long long> shape;
+  Clock::time_point enqueued;
+};
+
+struct HandleState {
+  bool done = false;
+  std::string error;
+  std::vector<char> result;
+  std::vector<long long> shape;
+};
+
+class Engine {
+ public:
+  Engine(double cycle_s, long long fusion_bytes, double stall_s,
+         const char* timeline_path)
+      : cycle_s_(cycle_s), fusion_bytes_(fusion_bytes), stall_s_(stall_s) {
+    if (timeline_path && timeline_path[0]) timeline_.Initialize(timeline_path);
+    loop_ = std::thread(&Engine::Loop, this);
+    watchdog_ = std::thread(&Engine::Watchdog, this);
+  }
+
+  ~Engine() {
+    Shutdown();
+    if (loop_.joinable()) loop_.join();
+    if (watchdog_.joinable()) watchdog_.join();
+    timeline_.Close();
+  }
+
+  void SetExecutor(hvd_exec_fn fn, void* ctx) {
+    std::lock_guard<std::mutex> g(mu_);
+    exec_fn_ = fn;
+    exec_ctx_ = ctx;
+  }
+
+  // Live-tunable engine parameters (the autotuner drives these; reference:
+  // ParameterManager::SetAutoTuning + readback, parameter_manager.cc).
+  void SetParams(double cycle_s, long long fusion_bytes) {
+    std::lock_guard<std::mutex> g(mu_);
+    if (cycle_s > 0) cycle_s_ = cycle_s;
+    if (fusion_bytes >= 0) fusion_bytes_ = fusion_bytes;
+  }
+
+  long long Enqueue(int op, const char* name, int dtype_num, int itemsize,
+                    const void* data, const long long* shape, int ndim,
+                    int average, int root_rank, double prescale, char* err) {
+    std::unique_lock<std::mutex> lk(mu_);
+    if (shutdown_) {
+      snprintf(err, 256, "Horovod engine has been shut down");
+      return -1;
+    }
+    std::string sname(name);
+    if (pending_names_.count(sname)) {  // NOLINT — map keyed by name
+      // Reference: duplicate in-flight names rejected
+      // (operations.cc:265-268, 2293-2296).
+      snprintf(err, 256,
+               "a collective named '%s' is already pending; names must be "
+               "unique among in-flight tensors", name);
+      return -1;
+    }
+    Entry e;
+    e.handle = next_handle_++;
+    e.name = std::move(sname);
+    e.op = op;
+    e.dtype_num = dtype_num;
+    e.itemsize = itemsize;
+    e.average = average;
+    e.root_rank = root_rank;
+    e.prescale = prescale;
+    long long count = 1;
+    for (int i = 0; i < ndim; ++i) count *= shape[i];
+    e.data.resize((size_t)(count * itemsize));
+    memcpy(e.data.data(), data, e.data.size());
+    e.shape.assign(shape, shape + ndim);
+    e.enqueued = Clock::now();
+    pending_names_[e.name] = e.enqueued;
+    handles_[e.handle] = std::make_shared<HandleState>();
+    long long h = e.handle;
+    if (timeline_.Active()) timeline_.Begin(e.name, "QUEUE");
+    queue_.push_back(std::move(e));
+    lk.unlock();
+    cv_.notify_all();
+    return h;
+  }
+
+  // -1 unknown, 0 pending, 1 done.
+  int Poll(long long handle) {
+    std::lock_guard<std::mutex> g(mu_);
+    auto it = handles_.find(handle);
+    if (it == handles_.end()) return -1;
+    return it->second->done ? 1 : 0;
+  }
+
+  // Blocks until completion. 0 ok, 1 collective error, -1 unknown handle.
+  int WaitMeta(long long handle, long long* nbytes, int* ndim,
+               long long* shape8, char* err) {
+    std::shared_ptr<HandleState> hs;
+    {
+      std::unique_lock<std::mutex> lk(mu_);
+      auto it = handles_.find(handle);
+      if (it == handles_.end()) return -1;
+      hs = it->second;
+      cv_done_.wait(lk, [&] { return hs->done; });
+    }
+    if (!hs->error.empty()) {
+      snprintf(err, 256, "%s", hs->error.c_str());
+      return 1;
+    }
+    *nbytes = (long long)hs->result.size();
+    *ndim = (int)hs->shape.size();
+    for (size_t i = 0; i < hs->shape.size() && i < 8; ++i)
+      shape8[i] = hs->shape[i];
+    return 0;
+  }
+
+  // Copies result out and retires the handle. 0 ok, -1 unknown/short.
+  int CopyResult(long long handle, void* out, long long cap) {
+    std::shared_ptr<HandleState> hs;
+    {
+      std::lock_guard<std::mutex> g(mu_);
+      auto it = handles_.find(handle);
+      if (it == handles_.end()) return -1;
+      hs = it->second;
+      handles_.erase(it);
+    }
+    if (!hs->done || (long long)hs->result.size() > cap) return -1;
+    memcpy(out, hs->result.data(), hs->result.size());
+    return 0;
+  }
+
+  // Retires an errored/unneeded handle.
+  void Drop(long long handle) {
+    std::lock_guard<std::mutex> g(mu_);
+    handles_.erase(handle);
+  }
+
+  long long PendingCount() {
+    std::lock_guard<std::mutex> g(mu_);
+    return (long long)pending_names_.size();
+  }
+
+  void Shutdown() {
+    {
+      std::lock_guard<std::mutex> g(mu_);
+      if (shutdown_) return;
+      shutdown_ = true;
+    }
+    cv_.notify_all();
+  }
+
+  // Join worker threads after Shutdown. Separate from destruction so the
+  // Python side can quiesce the engine and then LEAK it: destroying a
+  // condition_variable while a synchronize() caller is still inside
+  // WaitMeta would be UB, and the binding cannot prove no such caller
+  // exists.
+  void Join() {
+    Shutdown();
+    if (loop_.joinable()) loop_.join();
+    if (watchdog_.joinable()) watchdog_.join();
+    timeline_.Close();  // workers joined: no further Emit is possible
+  }
+
+ private:
+  void Loop() {
+    while (true) {
+      std::deque<Entry> batch;
+      {
+        std::unique_lock<std::mutex> lk(mu_);
+        double cycle = cycle_s_;
+        cv_.wait_for(lk, std::chrono::duration<double>(cycle),
+                     [&] { return shutdown_ || !queue_.empty(); });
+        // On shutdown, leave queued entries for the failure drain below:
+        // executing them could call into Python during teardown.
+        if (shutdown_) break;
+        batch.swap(queue_);
+      }
+      RunCycle(batch);
+    }
+    // Fail whatever remains (reference: SHUT_DOWN_ERROR path,
+    // operations.cc:1833-1848).
+    std::deque<Entry> rest;
+    {
+      std::lock_guard<std::mutex> g(mu_);
+      rest.swap(queue_);
+    }
+    for (auto& e : rest)
+      Complete(e, nullptr, 0, nullptr, "Horovod engine has been shut down");
+  }
+
+  // Fuse allreduces per (dtype, average, prescale) in request order up to
+  // the threshold (reference: operations.cc:2035-2074); other ops run
+  // singly, in order.
+  void RunCycle(std::deque<Entry>& entries) {
+    long long fusion_limit;
+    {
+      std::lock_guard<std::mutex> g(mu_);
+      fusion_limit = fusion_bytes_;
+    }
+    std::vector<Entry*> fuse;
+    long long fuse_bytes = 0;
+    long long cycle_bytes = 0;
+    auto flush = [&] {
+      if (!fuse.empty()) ExecAllreduceBatch(fuse);
+      fuse.clear();
+      fuse_bytes = 0;
+    };
+    for (auto& e : entries) {
+      cycle_bytes += (long long)e.data.size();
+      if (e.op == HVD_ALLREDUCE) {
+        bool compatible =
+            fuse.empty() ||
+            (fuse[0]->dtype_num == e.dtype_num &&
+             fuse[0]->average == e.average &&
+             fuse[0]->prescale == e.prescale &&
+             fuse_bytes + (long long)e.data.size() <= fusion_limit);
+        if (!compatible) flush();
+        fuse.push_back(&e);
+        fuse_bytes += (long long)e.data.size();
+      } else {
+        flush();
+        ExecSingle(e);
+      }
+    }
+    flush();
+    if (!entries.empty()) {
+      hvd_request req{};
+      req.op = HVD_TICK;
+      req.names = "";
+      req.count = cycle_bytes;
+      hvd_result res{};
+      CallExecutor(&req, &res);  // best-effort; ignored on error
+    }
+  }
+
+  int CallExecutor(hvd_request* req, hvd_result* res) {
+    hvd_exec_fn fn;
+    void* ctx;
+    {
+      std::lock_guard<std::mutex> g(mu_);
+      fn = exec_fn_;
+      ctx = exec_ctx_;
+    }
+    if (!fn) {
+      snprintf(res->error, sizeof(res->error), "no executor registered");
+      return 1;
+    }
+    return fn(ctx, req, res);
+  }
+
+  void ExecAllreduceBatch(std::vector<Entry*>& batch) {
+    // Assemble fused buffer + names.
+    std::string names;
+    long long total = 0;
+    int itemsize = batch[0]->itemsize;
+    for (auto* e : batch) {
+      if (!names.empty()) names += ';';
+      names += e->name;
+      total += (long long)e->data.size() / itemsize;
+    }
+    std::vector<char> fused((size_t)(total * itemsize));
+    long long off = 0;
+    for (auto* e : batch) {
+      if (timeline_.Active() && batch.size() > 1)
+        timeline_.Begin(e->name, "MEMCPY_IN_FUSION_BUFFER");
+      memcpy(fused.data() + off, e->data.data(), e->data.size());
+      off += (long long)e->data.size();
+      if (timeline_.Active() && batch.size() > 1)
+        timeline_.End(e->name, "MEMCPY_IN_FUSION_BUFFER");
+    }
+    hvd_request req{};
+    req.op = HVD_ALLREDUCE;
+    req.dtype_num = batch[0]->dtype_num;
+    req.itemsize = itemsize;
+    req.average = batch[0]->average;
+    req.prescale = batch[0]->prescale;
+    req.names = names.c_str();
+    req.data = fused.data();
+    req.count = total;
+    req.ndim = 1;
+    req.shape[0] = total;
+    hvd_result res{};
+    if (timeline_.Active())
+      for (auto* e : batch) timeline_.Begin(e->name, "ALLREDUCE");
+    int rc = CallExecutor(&req, &res);
+    if (timeline_.Active())
+      for (auto* e : batch) timeline_.End(e->name, "ALLREDUCE");
+    if (rc != 0) {
+      for (auto* e : batch) Complete(*e, nullptr, 0, nullptr, res.error);
+      return;
+    }
+    if (res.nbytes != total * itemsize) {
+      for (auto* e : batch)
+        Complete(*e, nullptr, 0, nullptr,
+                 "executor returned wrong allreduce size");
+      return;
+    }
+    off = 0;
+    for (auto* e : batch) {
+      Complete(*e, (char*)res.data + off, (long long)e->data.size(),
+               &e->shape, nullptr);
+      off += (long long)e->data.size();
+    }
+    if (res.data && res.data != req.data) free(res.data);
+  }
+
+  void ExecSingle(Entry& e) {
+    hvd_request req{};
+    req.op = e.op;
+    req.dtype_num = e.dtype_num;
+    req.itemsize = e.itemsize;
+    req.average = e.average;
+    req.root_rank = e.root_rank;
+    req.prescale = e.prescale;
+    req.names = e.name.c_str();
+    req.data = e.data.data();
+    req.count = (long long)e.data.size() / e.itemsize;
+    req.ndim = (int)e.shape.size();
+    for (size_t i = 0; i < e.shape.size() && i < 8; ++i)
+      req.shape[i] = e.shape[i];
+    const char* phase = e.op == HVD_ALLGATHER ? "ALLGATHER" : "BROADCAST";
+    hvd_result res{};
+    if (timeline_.Active()) timeline_.Begin(e.name, phase);
+    int rc = CallExecutor(&req, &res);
+    if (timeline_.Active()) timeline_.End(e.name, phase);
+    if (rc != 0) {
+      Complete(e, nullptr, 0, nullptr, res.error);
+      return;
+    }
+    std::vector<long long> shape(res.shape, res.shape + res.ndim);
+    Complete(e, (char*)res.data, res.nbytes, &shape, nullptr);
+    if (res.data && res.data != req.data) free(res.data);
+  }
+
+  void Complete(Entry& e, const char* data, long long nbytes,
+                const std::vector<long long>* shape, const char* error) {
+    std::shared_ptr<HandleState> hs;
+    {
+      std::lock_guard<std::mutex> g(mu_);
+      pending_names_.erase(e.name);
+      auto it = handles_.find(e.handle);
+      if (it == handles_.end()) return;
+      hs = it->second;
+    }
+    if (error) {
+      hs->error = error;
+    } else {
+      hs->result.assign(data, data + nbytes);
+      if (shape) hs->shape = *shape;
+    }
+    if (timeline_.Active()) timeline_.End(e.name, "QUEUE");
+    {
+      std::lock_guard<std::mutex> g(mu_);
+      hs->done = true;
+    }
+    cv_done_.notify_all();
+  }
+
+  // Reference: CheckForStalledTensors warns every 60 s about tensors stuck
+  // in the table (operations.cc:1535-1581). Separate thread: the loop
+  // thread may itself be inside a hung collective.
+  void Watchdog() {
+    double interval = stall_s_ > 0 ? stall_s_ / 5.0 : 1.0;
+    Clock::time_point last_warn{};
+    while (true) {
+      {
+        std::unique_lock<std::mutex> lk(mu_);
+        if (cv_.wait_for(lk, std::chrono::duration<double>(interval),
+                         [&] { return shutdown_; }))
+          return;
+      }
+      if (stall_s_ <= 0) continue;
+      if (SecondsSince(last_warn) < stall_s_ && last_warn != Clock::time_point{})
+        continue;
+      std::string stalled;
+      {
+        // Scan every in-flight tensor (queued OR executing): the loop
+        // thread may be stuck inside a hung collective — exactly the
+        // condition to report.
+        std::lock_guard<std::mutex> g(mu_);
+        for (auto& kv : pending_names_) {
+          if (SecondsSince(kv.second) > stall_s_) {
+            if (!stalled.empty()) stalled += ", ";
+            stalled += kv.first;
+          }
+        }
+      }
+      if (!stalled.empty()) {
+        last_warn = Clock::now();
+        fprintf(stderr,
+                "WARNING: One or more tensors were submitted to be reduced, "
+                "gathered or broadcasted by subset of ranks and are waiting "
+                "for remainder of ranks for more than %.0f seconds. Stalled "
+                "ops: %s\n",
+                stall_s_, stalled.c_str());
+      }
+    }
+  }
+
+  double cycle_s_;
+  long long fusion_bytes_;
+  double stall_s_;
+  Timeline timeline_;
+
+  std::mutex mu_;
+  std::condition_variable cv_, cv_done_;
+  std::deque<Entry> queue_;
+  std::unordered_map<std::string, Clock::time_point> pending_names_;
+  std::unordered_map<long long, std::shared_ptr<HandleState>> handles_;
+  long long next_handle_ = 0;
+  bool shutdown_ = false;
+  hvd_exec_fn exec_fn_ = nullptr;
+  void* exec_ctx_ = nullptr;
+
+  std::thread loop_, watchdog_;
+};
+
+}  // namespace
+
+// ---------------------------------------------------------------------------
+// C API (the shape of the reference's C API, operations.h:75-125)
+// ---------------------------------------------------------------------------
+
+extern "C" {
+
+void* hvd_engine_create(double cycle_s, long long fusion_bytes,
+                        double stall_s, const char* timeline_path) {
+  return new Engine(cycle_s, fusion_bytes, stall_s, timeline_path);
+}
+
+void hvd_engine_set_executor(void* e, hvd_exec_fn fn, void* ctx) {
+  static_cast<Engine*>(e)->SetExecutor(fn, ctx);
+}
+
+void hvd_engine_set_params(void* e, double cycle_s, long long fusion_bytes) {
+  static_cast<Engine*>(e)->SetParams(cycle_s, fusion_bytes);
+}
+
+long long hvd_engine_enqueue(void* e, int op, const char* name, int dtype_num,
+                             int itemsize, const void* data,
+                             const long long* shape, int ndim, int average,
+                             int root_rank, double prescale, char* err) {
+  return static_cast<Engine*>(e)->Enqueue(op, name, dtype_num, itemsize, data,
+                                          shape, ndim, average, root_rank,
+                                          prescale, err);
+}
+
+int hvd_engine_poll(void* e, long long handle) {
+  return static_cast<Engine*>(e)->Poll(handle);
+}
+
+int hvd_engine_wait_meta(void* e, long long handle, long long* nbytes,
+                         int* ndim, long long* shape8, char* err) {
+  return static_cast<Engine*>(e)->WaitMeta(handle, nbytes, ndim, shape8, err);
+}
+
+int hvd_engine_copy_result(void* e, long long handle, void* out,
+                           long long cap) {
+  return static_cast<Engine*>(e)->CopyResult(handle, out, cap);
+}
+
+void hvd_engine_drop(void* e, long long handle) {
+  static_cast<Engine*>(e)->Drop(handle);
+}
+
+long long hvd_engine_pending(void* e) {
+  return static_cast<Engine*>(e)->PendingCount();
+}
+
+void hvd_engine_shutdown(void* e) { static_cast<Engine*>(e)->Shutdown(); }
+
+void hvd_engine_join(void* e) { static_cast<Engine*>(e)->Join(); }
+
+void hvd_engine_destroy(void* e) { delete static_cast<Engine*>(e); }
+
+}  // extern "C"

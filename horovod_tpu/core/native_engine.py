@@ -1,0 +1,234 @@
+"""NativeEngine — the C++ engine behind the same API as the Python Engine.
+
+The scheduler, tensor table, fusion loop, handle manager, stall watchdog and
+timeline live in C++ (libhvdcore, reference: horovod/common/operations.cc);
+the data plane is still XLA — the C++ loop calls back into
+:class:`horovod_tpu.core.engine.JaxExecutor` through a ctypes trampoline.
+This mirrors the reference's split where the C++ core calls into
+framework-owned allocators/streams through the abstract interfaces of
+common/common.h:77-110.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+from typing import Optional
+
+import numpy as np
+
+from horovod_tpu.core import native, timeline as tl
+from horovod_tpu.core.engine import (
+    STALL_WARNING_TIME_S,
+    DuplicateNameError,
+    EngineError,
+    JaxExecutor,
+    ShutdownError,
+    _multi_controller,
+    config_from_env,
+    make_autotuner,
+)
+
+# Engine wire dtypes (the role MPIDataType plays in the reference,
+# common/mpi_message.h:26-37).
+_DTYPES = [
+    np.dtype(np.float32), np.dtype(np.float64), np.dtype(np.float16),
+    np.dtype(np.int8), np.dtype(np.uint8), np.dtype(np.int16),
+    np.dtype(np.uint16), np.dtype(np.int32), np.dtype(np.uint32),
+    np.dtype(np.int64), np.dtype(np.uint64), np.dtype(np.bool_),
+    np.dtype(np.complex64), np.dtype(np.complex128),
+]
+try:  # bf16 — TPU's native dtype; numpy spells it via ml_dtypes
+    import ml_dtypes
+
+    _DTYPES.append(np.dtype(ml_dtypes.bfloat16))
+except ImportError:  # pragma: no cover
+    pass
+_DTYPE_CODE = {dt: i for i, dt in enumerate(_DTYPES)}
+
+_OPS = {"allreduce": 0, "allgather": 1, "broadcast": 2}
+
+
+def _make_callback(executor):
+    lib = native.load_library()
+    lib.hvd_alloc.restype = ctypes.c_void_p
+    lib.hvd_alloc.argtypes = [ctypes.c_longlong]
+
+    @native.EXEC_FN
+    def cb(ctx, req_p, res_p):
+        req, res = req_p.contents, res_p.contents
+        try:
+            if req.op == 3:  # TICK: end-of-cycle traffic report
+                pm = getattr(executor, "param_manager", None)
+                if pm is not None:
+                    pm.update(int(req.count))
+                return 0
+            dtype = _DTYPES[req.dtype_num]
+            nbytes = int(req.count) * int(req.itemsize)
+            buf = np.frombuffer(
+                (ctypes.c_char * nbytes).from_address(req.data),
+                dtype=dtype).copy()
+            if req.op == 0:  # allreduce (possibly fused)
+                if req.prescale != 1.0:
+                    buf = buf * req.prescale
+                out = executor.allreduce(buf, bool(req.average))
+                out = np.ascontiguousarray(out, dtype=dtype)
+                ctypes.memmove(req.data, out.ctypes.data, nbytes)
+                res.data, res.nbytes = req.data, nbytes
+                res.ndim, res.shape[0] = 1, req.count
+            elif req.op == 1:  # allgather: output is bigger — C-owned buf
+                shape = tuple(req.shape[i] for i in range(req.ndim))
+                out = executor.allgather(buf.reshape(shape))
+                out = np.ascontiguousarray(out, dtype=dtype)
+                ptr = lib.hvd_alloc(out.nbytes)
+                if not ptr:
+                    raise MemoryError("hvd_alloc failed")
+                ctypes.memmove(ptr, out.ctypes.data, out.nbytes)
+                res.data, res.nbytes = ptr, out.nbytes
+                res.ndim = out.ndim
+                for i, s in enumerate(out.shape):
+                    res.shape[i] = s
+            elif req.op == 2:  # broadcast: same shape, in place
+                shape = tuple(req.shape[i] for i in range(req.ndim))
+                out = executor.broadcast(buf.reshape(shape), int(req.root_rank))
+                out = np.ascontiguousarray(out, dtype=dtype)
+                ctypes.memmove(req.data, out.ctypes.data, nbytes)
+                res.data, res.nbytes = req.data, nbytes
+                res.ndim = out.ndim
+                for i, s in enumerate(out.shape):
+                    res.shape[i] = s
+            else:
+                raise ValueError(f"unknown op {req.op}")
+            return 0
+        except Exception as exc:  # surfaced at synchronize()
+            msg = str(exc).encode()[:255]
+            res.error = msg
+            return 1
+
+    return cb
+
+
+class NativeEngine:
+    """Same surface as :class:`horovod_tpu.core.engine.Engine`, backed by
+    libhvdcore."""
+
+    def __init__(self, executor=None, cycle_time_s: Optional[float] = None,
+                 fusion_threshold: Optional[int] = None,
+                 stall_warning_s: float = STALL_WARNING_TIME_S,
+                 timeline_path: Optional[str] = None):
+        self.cycle_time_s, self.fusion_threshold, stall_warning_s = \
+            config_from_env(cycle_time_s, fusion_threshold, stall_warning_s)
+        if timeline_path is None:
+            timeline_path = tl.timeline_path_from_env() or ""
+
+        self._lib = native.load_library()
+        self._executor = executor or JaxExecutor()
+        self._cb = _make_callback(self._executor)  # keep trampoline alive
+        self._ptr = self._lib.hvd_engine_create(
+            float(self.cycle_time_s), int(self.fusion_threshold),
+            float(stall_warning_s), timeline_path.encode())
+        self._lib.hvd_engine_set_executor(self._ptr, self._cb, None)
+        self._meta: dict = {}  # handle -> np.dtype (for result decode)
+
+        # Autotuner: the C++ loop reports per-cycle traffic through TICK
+        # callbacks; tuned values land back via hvd_engine_set_params.
+        self._param_manager = make_autotuner(self)
+        self._executor.param_manager = self._param_manager
+
+    def _enqueue(self, op: str, name: str, tensor: np.ndarray,
+                 average: bool = False, root_rank: int = 0,
+                 prescale: float = 1.0) -> int:
+        if self._ptr is None:
+            raise ShutdownError("engine is shut down")
+        tensor = np.ascontiguousarray(tensor)
+        if tensor.dtype not in _DTYPE_CODE:
+            raise EngineError(f"unsupported dtype {tensor.dtype}")
+        if tensor.ndim > 8:
+            raise EngineError("tensors with >8 dims are not supported")
+        err = ctypes.create_string_buffer(256)
+        shape = (ctypes.c_longlong * max(tensor.ndim, 1))(*tensor.shape)
+        h = self._lib.hvd_engine_enqueue(
+            self._ptr, _OPS[op], name.encode(), _DTYPE_CODE[tensor.dtype],
+            tensor.dtype.itemsize, tensor.ctypes.data, shape, tensor.ndim,
+            int(average), int(root_rank), float(prescale), err)
+        if h < 0:
+            msg = err.value.decode()
+            if "already pending" in msg:
+                raise DuplicateNameError(msg)
+            raise ShutdownError(msg)
+        self._meta[h] = tensor.dtype
+        return int(h)
+
+    def allreduce_async(self, name: str, tensor: np.ndarray, average: bool,
+                        prescale: float = 1.0) -> int:
+        return self._enqueue("allreduce", name, tensor, average=average,
+                             prescale=prescale)
+
+    def allgather_async(self, name: str, tensor: np.ndarray) -> int:
+        return self._enqueue("allgather", name, tensor)
+
+    def broadcast_async(self, name: str, tensor: np.ndarray,
+                        root_rank: int) -> int:
+        return self._enqueue("broadcast", name, tensor, root_rank=root_rank)
+
+    def poll(self, handle: int) -> bool:
+        st = self._lib.hvd_engine_poll(self._ptr, handle)
+        if st < 0:
+            raise EngineError(f"unknown handle {handle}")
+        return bool(st)
+
+    def synchronize(self, handle: int) -> np.ndarray:
+        nbytes = ctypes.c_longlong()
+        ndim = ctypes.c_int()
+        shape8 = (ctypes.c_longlong * 8)()
+        err = ctypes.create_string_buffer(256)
+        rc = self._lib.hvd_engine_wait_meta(
+            self._ptr, handle, ctypes.byref(nbytes), ctypes.byref(ndim),
+            shape8, err)
+        if rc < 0:
+            raise EngineError(f"unknown handle {handle}")
+        dtype = self._meta.pop(handle, np.dtype(np.float32))
+        if rc == 1:
+            self._lib.hvd_engine_drop(self._ptr, handle)
+            msg = err.value.decode()
+            if "shut down" in msg:
+                raise ShutdownError(msg)
+            raise EngineError(msg)
+        out = np.empty(int(nbytes.value), np.uint8)
+        rc = self._lib.hvd_engine_copy_result(
+            self._ptr, handle, out.ctypes.data, out.nbytes)
+        if rc != 0:
+            raise EngineError("result copy failed")
+        shape = tuple(shape8[i] for i in range(ndim.value))
+        return out.view(dtype).reshape(shape)
+
+    def set_params(self, cycle_time_s: Optional[float] = None,
+                   fusion_threshold: Optional[int] = None):
+        """Live parameter updates (the autotuner drives this)."""
+        if self._ptr is None:
+            return
+        if fusion_threshold is not None and _multi_controller():
+            # Multi-controller fusion stays off even if topology came up
+            # after engine construction (see engine.config_from_env).
+            fusion_threshold = 0
+        self._lib.hvd_engine_set_params(
+            self._ptr,
+            -1.0 if cycle_time_s is None else float(cycle_time_s),
+            -1 if fusion_threshold is None else int(fusion_threshold))
+        if cycle_time_s is not None and cycle_time_s > 0:
+            self.cycle_time_s = cycle_time_s
+        if fusion_threshold is not None and fusion_threshold >= 0:
+            self.fusion_threshold = fusion_threshold
+
+    def shutdown(self):
+        if self._ptr is None:
+            return
+        if self._param_manager is not None:
+            self._param_manager.close()
+        # Quiesce (fail outstanding work, wake waiters, join C++ threads)
+        # but deliberately LEAK the small C++ object: another thread may
+        # still be inside hvd_engine_wait_meta, and destroying a condition
+        # variable with blocked waiters is undefined behavior.
+        self._lib.hvd_engine_join(self._ptr)
+        self._ptr = None
+        self._meta.clear()

@@ -93,7 +93,11 @@ class Timeline:
             self._fh = None
 
 
-def from_env() -> Timeline:
+def timeline_path_from_env() -> Optional[str]:
     """HOROVOD_TIMELINE=<file> activation (reference: operations.cc:1732-1736);
     HVD_TIMELINE is the native spelling."""
-    return Timeline(os.environ.get("HVD_TIMELINE") or os.environ.get("HOROVOD_TIMELINE"))
+    return os.environ.get("HVD_TIMELINE") or os.environ.get("HOROVOD_TIMELINE")
+
+
+def from_env() -> Timeline:
+    return Timeline(timeline_path_from_env())

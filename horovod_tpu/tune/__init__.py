@@ -1,0 +1,13 @@
+"""Autotuning: Bayesian optimization of engine parameters.
+
+Reference: horovod/common/parameter_manager.{h,cc} (C9) +
+horovod/common/optim/ (C10). Enabled with HVD_AUTOTUNE=1 (reference:
+HOROVOD_AUTOTUNE, operations.cc:1797-1804); CSV log via HVD_AUTOTUNE_LOG.
+"""
+
+from horovod_tpu.tune.bayesian_optimization import BayesianOptimization  # noqa: F401
+from horovod_tpu.tune.gaussian_process import GaussianProcessRegressor  # noqa: F401
+from horovod_tpu.tune.parameter_manager import (  # noqa: F401
+    ParameterManager,
+    autotune_enabled,
+)

@@ -1,0 +1,66 @@
+"""Bayesian optimization with expected-improvement acquisition.
+
+Reference: horovod/common/optim/bayesian_optimization.{h,cc} — GP posterior
++ EI maximized by multi-restart L-BFGS. Deterministic given the seed so
+every controller process proposes identical parameters from identical
+samples (the reference instead has rank 0 tune and broadcast —
+parameter_manager.cc:203-236; determinism makes the broadcast redundant).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import numpy as np
+from scipy.optimize import minimize
+from scipy.stats import norm
+
+from horovod_tpu.tune.gaussian_process import GaussianProcessRegressor
+
+
+class BayesianOptimization:
+    def __init__(self, bounds: Sequence[Tuple[float, float]],
+                 xi: float = 0.01, seed: int = 0):
+        self.bounds = np.asarray(bounds, float)
+        self.xi = xi
+        self.gp = GaussianProcessRegressor()
+        self.xs: list = []
+        self.ys: list = []
+        self._rng = np.random.default_rng(seed)
+
+    @property
+    def dim(self) -> int:
+        return len(self.bounds)
+
+    def add_sample(self, x, y: float):
+        self.xs.append(np.asarray(x, float).ravel())
+        self.ys.append(float(y))
+        self.gp.fit(np.stack(self.xs), np.asarray(self.ys))
+
+    def best(self) -> Optional[np.ndarray]:
+        if not self.ys:
+            return None
+        return self.xs[int(np.argmax(self.ys))]
+
+    def _ei(self, x):
+        mu, sd = self.gp.predict(x)
+        f_best = max(self.ys)
+        z = (mu - f_best - self.xi) / sd
+        return (mu - f_best - self.xi) * norm.cdf(z) + sd * norm.pdf(z)
+
+    def next_sample(self, n_restarts: int = 10) -> np.ndarray:
+        """Maximize EI via multi-restart L-BFGS-B (reference:
+        bayesian_optimization.cc:92-104)."""
+        lo, hi = self.bounds[:, 0], self.bounds[:, 1]
+        if len(self.xs) < 2:
+            return self._rng.uniform(lo, hi)
+        best_x, best_v = None, np.inf
+        starts = self._rng.uniform(lo, hi, size=(n_restarts, self.dim))
+        for s in starts:
+            res = minimize(lambda x: -self._ei(x[None])[0], s,
+                           method="L-BFGS-B", bounds=self.bounds)
+            if res.fun < best_v:
+                best_v, best_x = res.fun, res.x
+        if best_x is None or not np.isfinite(best_v):
+            return self._rng.uniform(lo, hi)
+        return np.clip(best_x, lo, hi)

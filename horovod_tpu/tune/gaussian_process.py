@@ -1,0 +1,76 @@
+"""Gaussian-process regressor for the autotuner.
+
+Reference: horovod/common/optim/gaussian_process.{h,cc} — RBF kernel,
+hyperparameters fit by maximizing log-marginal likelihood with L-BFGS,
+Cholesky-factored posterior. Same math here on numpy/scipy instead of
+Eigen/LBFGSpp.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+from scipy.linalg import cho_factor, cho_solve
+from scipy.optimize import minimize
+
+
+class GaussianProcessRegressor:
+    """GP with RBF kernel k(a,b) = σ² exp(-‖a-b‖²/(2ℓ²)) + α·δ."""
+
+    def __init__(self, alpha: float = 1e-6):
+        self.alpha = alpha
+        self.length_scale = 1.0
+        self.sigma_f = 1.0
+        self._x = None
+        self._y = None
+        self._chol = None
+        self._y_mean = 0.0
+
+    def _kernel(self, a: np.ndarray, b: np.ndarray,
+                length_scale: float, sigma_f: float) -> np.ndarray:
+        d2 = ((a[:, None, :] - b[None, :, :]) ** 2).sum(-1)
+        return sigma_f ** 2 * np.exp(-0.5 * d2 / length_scale ** 2)
+
+    def _nll(self, theta, x, y):
+        ls, sf = np.exp(theta)
+        k = self._kernel(x, x, ls, sf) + self.alpha * np.eye(len(x))
+        try:
+            c, low = cho_factor(k)
+        except np.linalg.LinAlgError:
+            return 1e25
+        a = cho_solve((c, low), y)
+        return (0.5 * y @ a + np.log(np.diag(c)).sum()
+                + 0.5 * len(x) * np.log(2 * np.pi))
+
+    def fit(self, x: np.ndarray, y: np.ndarray):
+        """Fit hyperparameters by LML maximization (reference:
+        gaussian_process.cc:95-98 uses L-BFGS the same way)."""
+        x = np.atleast_2d(np.asarray(x, float))
+        y = np.asarray(y, float).ravel()
+        self._y_mean = float(y.mean()) if len(y) else 0.0
+        yc = y - self._y_mean
+        best = None
+        for ls0 in (0.1, 1.0, 3.0):
+            res = minimize(self._nll, np.log([ls0, max(yc.std(), 1e-3)]),
+                           args=(x, yc), method="L-BFGS-B",
+                           bounds=[(-5, 5), (-5, 5)])
+            if best is None or res.fun < best.fun:
+                best = res
+        self.length_scale, self.sigma_f = np.exp(best.x)
+        k = self._kernel(x, x, self.length_scale, self.sigma_f) \
+            + self.alpha * np.eye(len(x))
+        self._chol = cho_factor(k)
+        self._x, self._y = x, yc
+        return self
+
+    def predict(self, x: np.ndarray):
+        """Posterior mean and stddev at query points."""
+        x = np.atleast_2d(np.asarray(x, float))
+        if self._x is None:
+            return (np.full(len(x), self._y_mean),
+                    np.full(len(x), self.sigma_f))
+        ks = self._kernel(x, self._x, self.length_scale, self.sigma_f)
+        a = cho_solve(self._chol, self._y)
+        mu = ks @ a + self._y_mean
+        v = cho_solve(self._chol, ks.T)
+        var = self.sigma_f ** 2 - np.einsum("ij,ji->i", ks, v)
+        return mu, np.sqrt(np.clip(var, 1e-12, None))

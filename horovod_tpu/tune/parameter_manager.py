@@ -1,0 +1,141 @@
+"""Online autotuning of engine parameters.
+
+Reference: horovod/common/parameter_manager.{h,cc} — tunes
+{fusion_threshold ∈ [0,64] MB, cycle_time ∈ [1,100] ms} jointly with
+Bayesian optimization, maximizing throughput (bytes/µs), with 3 discarded
+warmup samples, scores taken as the median of 5 samples of 10 cycles each,
+and a CSV log (HOROVOD_AUTOTUNE_LOG). Same procedure here; the tuned values
+are pushed into the running engine via ``set_params``.
+
+The reference has rank 0 tune and broadcast a Params struct over MPI
+(parameter_manager.cc:203-236). Here the optimizer is deterministic given
+identical (x, y) histories; since multi-controller fusion is disabled until
+negotiation exists (engine guard), tuning runs on single-controller worlds
+where no sync is needed at all.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from typing import Optional
+
+import numpy as np
+
+from horovod_tpu.tune.bayesian_optimization import BayesianOptimization
+
+# Search space (reference: parameter_manager.cc:44-52).
+FUSION_MB_BOUNDS = (0.0, 64.0)
+CYCLE_MS_BOUNDS = (1.0, 100.0)
+
+WARMUPS = 3            # reference: parameter_manager.cc:27-30
+CYCLES_PER_SAMPLE = 10
+SAMPLES_PER_STEP = 5
+MAX_STEPS = 20
+
+
+class ParameterManager:
+    """Feed ``update(bytes)`` once per completed engine cycle; the manager
+    scores throughput, proposes new (fusion_threshold, cycle_time) via
+    Bayesian optimization, applies them to ``engine`` and eventually
+    settles on the best point seen."""
+
+    def __init__(self, engine=None, log_path: Optional[str] = None,
+                 warmups: int = WARMUPS,
+                 cycles_per_sample: int = CYCLES_PER_SAMPLE,
+                 samples_per_step: int = SAMPLES_PER_STEP,
+                 max_steps: int = MAX_STEPS, seed: int = 0):
+        self.engine = engine
+        self.bo = BayesianOptimization(
+            [FUSION_MB_BOUNDS, CYCLE_MS_BOUNDS], seed=seed)
+        self.warmups_left = warmups
+        self.cycles_per_sample = cycles_per_sample
+        self.samples_per_step = samples_per_step
+        self.max_steps = max_steps
+        self.active = True
+        self.current = np.array([
+            (FUSION_MB_BOUNDS[0] + FUSION_MB_BOUNDS[1]) / 2,
+            5.0,  # reference default 5 ms cycle
+        ])
+        self._cycle_count = 0
+        self._bytes = 0
+        self._t0 = time.monotonic()
+        self._scores: list = []
+        self._steps = 0
+        self._log = None
+        if log_path is None:
+            log_path = (os.environ.get("HVD_AUTOTUNE_LOG")
+                        or os.environ.get("HOROVOD_AUTOTUNE_LOG"))
+        if log_path:
+            self._log = open(log_path, "w")
+            self._log.write("fusion_mb,cycle_ms,score_bytes_per_us\n")
+        self._apply(self.current)
+
+    # -- plumbing -------------------------------------------------------------
+
+    def _apply(self, x):
+        self.current = np.asarray(x, float)
+        if self.engine is not None:
+            self.engine.set_params(
+                cycle_time_s=float(self.current[1]) / 1e3,
+                fusion_threshold=int(self.current[0] * 1024 * 1024))
+
+    def params(self) -> dict:
+        return {"fusion_threshold_mb": float(self.current[0]),
+                "cycle_time_ms": float(self.current[1]),
+                "active": self.active}
+
+    # -- scoring loop (reference: parameter_manager.cc:110-200) ---------------
+
+    def update(self, nbytes: int) -> bool:
+        """Record one engine cycle's traffic. Returns True when parameters
+        changed."""
+        if not self.active:
+            return False
+        self._bytes += int(nbytes)
+        self._cycle_count += 1
+        if self._cycle_count < self.cycles_per_sample:
+            return False
+        elapsed_us = max((time.monotonic() - self._t0) * 1e6, 1.0)
+        score = self._bytes / elapsed_us
+        self._cycle_count = 0
+        self._bytes = 0
+        self._t0 = time.monotonic()
+        if self.warmups_left > 0:
+            self.warmups_left -= 1
+            return False
+        self._scores.append(score)
+        if len(self._scores) < self.samples_per_step:
+            return False
+        med = float(np.median(self._scores))
+        self._scores.clear()
+        if self._log:
+            self._log.write(
+                f"{self.current[0]:.3f},{self.current[1]:.3f},{med:.6f}\n")
+            self._log.flush()
+        self.bo.add_sample(self.current, med)
+        self._steps += 1
+        if self._steps >= self.max_steps:
+            # Converged: lock in the best point seen (reference stops
+            # tuning once samples are exhausted).
+            self.active = False
+            self._apply(self.bo.best())
+            if self._log:
+                self._log.write(
+                    f"# converged: fusion_mb={self.current[0]:.3f} "
+                    f"cycle_ms={self.current[1]:.3f}\n")
+                self._log.flush()
+            return True
+        self._apply(self.bo.next_sample())
+        return True
+
+    def close(self):
+        if self._log:
+            self._log.close()
+            self._log = None
+
+
+def autotune_enabled() -> bool:
+    """HOROVOD_AUTOTUNE activation (reference: operations.cc:1797-1804)."""
+    return bool(os.environ.get("HVD_AUTOTUNE")
+                or os.environ.get("HOROVOD_AUTOTUNE"))

@@ -1,0 +1,226 @@
+"""The C++ engine (libhvdcore) must show the same observable behavior as the
+Python reference engine in test_engine.py — same fusion, error, duplicate-
+name, shutdown and timeline semantics (reference behaviors:
+operations.cc:265-268, 2035-2074, 1535-1581, 1833-1848; timeline.cc)."""
+
+import json
+import time
+
+import numpy as np
+import pytest
+
+from horovod_tpu.core import engine as eng
+from horovod_tpu.core.native_engine import NativeEngine
+
+
+class RecordingExecutor:
+    def __init__(self, world=8, delay=0.0):
+        self.world = world
+        self.delay = delay
+        self.calls = []
+
+    def allreduce(self, flat, average):
+        if self.delay:
+            time.sleep(self.delay)
+        self.calls.append(("allreduce", flat.size, average))
+        return flat if average else flat * self.world
+
+    def allgather(self, t):
+        self.calls.append(("allgather", t.size, None))
+        return np.tile(t, (self.world,) + (1,) * (t.ndim - 1))
+
+    def broadcast(self, t, root):
+        self.calls.append(("broadcast", t.size, root))
+        return t + 100.0 if t.dtype.kind == "f" else t
+
+
+def _mk(executor=None, **kw):
+    kw.setdefault("cycle_time_s", 0.002)
+    kw.setdefault("timeline_path", "")
+    return NativeEngine(executor=executor or RecordingExecutor(), **kw)
+
+
+def test_roundtrip_all_ops():
+    e = _mk()
+    try:
+        h = e.allreduce_async("r", np.ones((4,), np.float32), average=False)
+        np.testing.assert_allclose(e.synchronize(h), np.full((4,), 8.0))
+        h = e.allgather_async("g", np.arange(6, np.int64).reshape(2, 3)
+                              if False else
+                              np.arange(6, dtype=np.int64).reshape(2, 3))
+        out = e.synchronize(h)
+        assert out.shape == (16, 3) and out.dtype == np.int64
+        h = e.broadcast_async("b", np.zeros((3,), np.float64), 2)
+        np.testing.assert_allclose(e.synchronize(h), np.full((3,), 100.0))
+    finally:
+        e.shutdown()
+
+
+def test_dtype_roundtrip_exact():
+    """64-bit payloads must round-trip bit-exactly through the C buffer."""
+    e = _mk()
+    try:
+        x = np.array([1.5e300, -2.5e-300, 3.141592653589793], np.float64)
+        h = e.broadcast_async("f64", x, 0)
+        np.testing.assert_array_equal(e.synchronize(h), x + 100.0)
+        xi = np.array([2**62, -(2**61), 7], np.int64)
+        h = e.allreduce_async("i64", xi, average=True)
+        np.testing.assert_array_equal(e.synchronize(h), xi)
+    finally:
+        e.shutdown()
+
+
+def test_poll_then_synchronize():
+    e = _mk()
+    try:
+        h = e.allreduce_async("t", np.ones((2,), np.float32), average=True)
+        deadline = time.monotonic() + 2
+        while not e.poll(h):
+            assert time.monotonic() < deadline
+            time.sleep(0.001)
+        np.testing.assert_allclose(e.synchronize(h), np.ones((2,)))
+    finally:
+        e.shutdown()
+
+
+def test_duplicate_name_rejected():
+    ex = RecordingExecutor(delay=0.05)
+    e = _mk(ex, cycle_time_s=0.001)
+    try:
+        h1 = e.allreduce_async("same", np.ones((2,), np.float32), False)
+        with pytest.raises(eng.DuplicateNameError):
+            e.allreduce_async("same", np.ones((2,), np.float32), False)
+        e.synchronize(h1)
+        h2 = e.allreduce_async("same", np.ones((2,), np.float32), False)
+        e.synchronize(h2)
+    finally:
+        e.shutdown()
+
+
+def test_fusion_batches_same_dtype():
+    ex = RecordingExecutor()
+    e = _mk(ex, cycle_time_s=0.05)
+    try:
+        time.sleep(0.06)
+        handles = [
+            e.allreduce_async(f"t{i}", np.full((8,), float(i), np.float32),
+                              False)
+            for i in range(16)
+        ]
+        for i, h in enumerate(handles):
+            np.testing.assert_allclose(e.synchronize(h),
+                                       np.full((8,), 8.0 * i))
+        ar = [c for c in ex.calls if c[0] == "allreduce"]
+        assert len(ar) < 16, f"no fusion: {len(ar)} calls"
+    finally:
+        e.shutdown()
+
+
+def test_fusion_respects_threshold():
+    ex = RecordingExecutor()
+    e = _mk(ex, cycle_time_s=0.05, fusion_threshold=8 * 4)
+    try:
+        time.sleep(0.06)
+        handles = [
+            e.allreduce_async(f"t{i}", np.ones((8,), np.float32), False)
+            for i in range(4)
+        ]
+        for h in handles:
+            e.synchronize(h)
+        ar = [c for c in ex.calls if c[0] == "allreduce"]
+        assert all(c[1] <= 8 for c in ar)
+    finally:
+        e.shutdown()
+
+
+def test_mixed_dtypes_not_fused():
+    ex = RecordingExecutor()
+    e = _mk(ex, cycle_time_s=0.05)
+    try:
+        time.sleep(0.06)
+        h1 = e.allreduce_async("f", np.ones((4,), np.float32), False)
+        h2 = e.allreduce_async("i", np.ones((4,), np.int32), False)
+        e.synchronize(h1)
+        e.synchronize(h2)
+        ar = [c for c in ex.calls if c[0] == "allreduce"]
+        assert len(ar) == 2
+    finally:
+        e.shutdown()
+
+
+def test_prescale_applied():
+    ex = RecordingExecutor()
+    e = _mk(ex)
+    try:
+        h = e.allreduce_async("p", np.ones((4,), np.float32), False,
+                              prescale=0.5)
+        np.testing.assert_allclose(e.synchronize(h), np.full((4,), 4.0))
+    finally:
+        e.shutdown()
+
+
+def test_executor_error_surfaces():
+    class Boom(RecordingExecutor):
+        def allreduce(self, flat, average):
+            raise RuntimeError("wire fell out")
+
+    e = _mk(Boom())
+    try:
+        h = e.allreduce_async("t", np.ones((2,), np.float32), False)
+        with pytest.raises(eng.EngineError, match="wire fell out"):
+            e.synchronize(h)
+        # The engine survives an executor error.
+        h = e.broadcast_async("u", np.ones((2,), np.float32), 0)
+        e.synchronize(h)
+    finally:
+        e.shutdown()
+
+
+def test_unknown_handle():
+    e = _mk()
+    try:
+        with pytest.raises(eng.EngineError):
+            e.poll(12345)
+        with pytest.raises(eng.EngineError):
+            e.synchronize(12345)
+    finally:
+        e.shutdown()
+
+
+def test_enqueue_after_shutdown_raises():
+    e = _mk()
+    e.shutdown()
+    with pytest.raises(eng.ShutdownError):
+        e.allreduce_async("t", np.ones((2,), np.float32), False)
+
+
+def test_stall_warning_printed(capfd):
+    class Slow(RecordingExecutor):
+        def allreduce(self, flat, average):
+            time.sleep(0.5)
+            return flat
+
+    e = NativeEngine(executor=Slow(), cycle_time_s=0.001,
+                     stall_warning_s=0.05, timeline_path="")
+    try:
+        e.allreduce_async("stuck_tensor", np.ones((2,), np.float32), False)
+        time.sleep(0.3)
+        err = capfd.readouterr().err
+        assert "stuck_tensor" in err and "WARNING" in err
+    finally:
+        e.shutdown()
+
+
+def test_timeline_written(tmp_path):
+    path = tmp_path / "native_timeline.json"
+    e = _mk(timeline_path=str(path))
+    h = e.allreduce_async("tensor_a", np.ones((4,), np.float32), False)
+    e.synchronize(h)
+    h = e.broadcast_async("tensor_b", np.ones((4,), np.float32), 0)
+    e.synchronize(h)
+    e.shutdown()
+    events = json.loads(path.read_text())
+    names = {ev.get("name") for ev in events}
+    assert {"ALLREDUCE", "BROADCAST", "QUEUE"} <= names
+    lanes = {ev["args"]["name"] for ev in events if ev.get("ph") == "M"}
+    assert {"tensor_a", "tensor_b"} <= lanes

@@ -1,0 +1,164 @@
+"""Autotuner: GP regression quality, Bayesian optimization convergence,
+ParameterManager tuning loop (reference: parameter_manager.cc,
+optim/gaussian_process.cc, optim/bayesian_optimization.cc)."""
+
+import numpy as np
+import pytest
+
+from horovod_tpu.tune import (
+    BayesianOptimization,
+    GaussianProcessRegressor,
+    ParameterManager,
+)
+
+
+def test_gp_interpolates_training_points():
+    x = np.linspace(0, 1, 8)[:, None]
+    y = np.sin(2 * np.pi * x.ravel())
+    gp = GaussianProcessRegressor().fit(x, y)
+    mu, sd = gp.predict(x)
+    np.testing.assert_allclose(mu, y, atol=0.05)
+    assert np.all(sd < 0.2)
+
+
+def test_gp_uncertainty_grows_off_data():
+    x = np.array([[0.0], [0.1], [0.2]])
+    y = np.array([0.0, 0.1, 0.2])
+    gp = GaussianProcessRegressor().fit(x, y)
+    _, sd_near = gp.predict(np.array([[0.1]]))
+    _, sd_far = gp.predict(np.array([[3.0]]))
+    assert sd_far[0] > sd_near[0]
+
+
+def test_gp_predict_without_fit():
+    gp = GaussianProcessRegressor()
+    mu, sd = gp.predict(np.array([[0.5]]))
+    assert mu.shape == (1,) and sd.shape == (1,)
+
+
+def test_bayesian_optimization_finds_peak():
+    """Maximize -(x-0.3)^2 - (y-0.7)^2 on the unit square."""
+    bo = BayesianOptimization([(0.0, 1.0), (0.0, 1.0)], seed=1)
+
+    def f(p):
+        return -((p[0] - 0.3) ** 2) - (p[1] - 0.7) ** 2
+
+    for _ in range(25):
+        x = bo.next_sample()
+        bo.add_sample(x, f(x))
+    best = bo.best()
+    assert f(best) > -0.02, best
+
+
+def test_parameter_manager_tunes_and_converges():
+    class FakeEngine:
+        def __init__(self):
+            self.applied = []
+
+        def set_params(self, cycle_time_s=None, fusion_threshold=None):
+            self.applied.append((cycle_time_s, fusion_threshold))
+
+    eng = FakeEngine()
+    pm = ParameterManager(eng, warmups=1, cycles_per_sample=2,
+                          samples_per_step=2, max_steps=4, seed=0)
+    # Drive enough cycles: warmup (2 cycles) + 4 steps * 2 samples * 2 cycles
+    changes = 0
+    for _ in range(2 + 4 * 2 * 2 + 8):
+        if pm.update(1 << 20):
+            changes += 1
+        if not pm.active:
+            break
+    assert changes >= 2, "never proposed new parameters"
+    assert not pm.active, "did not converge"
+    # Converged params are inside the reference search space.
+    assert 0.0 <= pm.current[0] <= 64.0
+    assert 1.0 <= pm.current[1] <= 100.0
+    # Applied to the engine: cycle seconds, fusion bytes.
+    cyc, fus = eng.applied[-1]
+    assert cyc == pytest.approx(pm.current[1] / 1e3)
+    assert fus == int(pm.current[0] * 1024 * 1024)
+
+
+def test_parameter_manager_csv_log(tmp_path):
+    class FakeEngine:
+        def set_params(self, **kw): ...
+
+    log = tmp_path / "autotune.csv"
+    pm = ParameterManager(FakeEngine(), log_path=str(log), warmups=0,
+                          cycles_per_sample=1, samples_per_step=1,
+                          max_steps=2, seed=0)
+    for _ in range(6):
+        pm.update(1024)
+        if not pm.active:
+            break
+    pm.close()
+    lines = log.read_text().strip().splitlines()
+    assert lines[0] == "fusion_mb,cycle_ms,score_bytes_per_us"
+    assert len(lines) >= 3  # 2 samples + converged comment
+
+
+def test_native_engine_set_params_roundtrip():
+    from horovod_tpu.core.native_engine import NativeEngine
+
+    class NullExec:
+        def allreduce(self, flat, average):
+            return flat
+
+        def allgather(self, t):
+            return t
+
+        def broadcast(self, t, root):
+            return t
+
+    e = NativeEngine(executor=NullExec(), cycle_time_s=0.001)
+    try:
+        e.set_params(cycle_time_s=0.02, fusion_threshold=123456)
+        assert e.cycle_time_s == 0.02
+        assert e.fusion_threshold == 123456
+        h = e.allreduce_async("x", np.ones(3, np.float32), False)
+        e.synchronize(h)
+    finally:
+        e.shutdown()
+
+
+def test_native_engine_autotune_ticks(monkeypatch):
+    """HVD_AUTOTUNE on the native engine: C++ TICK callbacks must feed the
+    ParameterManager once per cycle."""
+    import time
+
+    from horovod_tpu.core.native_engine import NativeEngine
+
+    class NullExec:
+        def allreduce(self, flat, average):
+            return flat
+
+        def allgather(self, t):
+            return t
+
+        def broadcast(self, t, root):
+            return t
+
+    monkeypatch.setenv("HVD_AUTOTUNE", "1")
+    e = NativeEngine(executor=NullExec(), cycle_time_s=0.001)
+    try:
+        assert e._param_manager is not None
+        h = e.allreduce_async("a", np.ones(16, np.float32), False)
+        e.synchronize(h)
+        deadline = time.monotonic() + 2
+        pm = e._param_manager
+        while pm._cycle_count == 0 and pm._bytes == 0 \
+                and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert pm._cycle_count > 0 or pm._bytes > 0
+    finally:
+        e.shutdown()
+
+
+def test_autotune_env_gate(monkeypatch):
+    from horovod_tpu.tune import autotune_enabled
+
+    monkeypatch.delenv("HVD_AUTOTUNE", raising=False)
+    monkeypatch.delenv("HOROVOD_AUTOTUNE", raising=False)
+    assert not autotune_enabled()
+    monkeypatch.setenv("HVD_AUTOTUNE", "1")
+    assert autotune_enabled()
