@@ -1,0 +1,31 @@
+"""Shared example helpers: synthetic datasets (this environment has no
+network egress, so examples default to synthetic data the way the
+reference's synthetic benchmarks do — reference:
+examples/tensorflow_synthetic_benchmark.py:56-60)."""
+
+import numpy as np
+
+
+def synthetic_mnist(n=2048, seed=0):
+    """Learnable stand-in for MNIST: labels derive from a fixed random
+    projection of the pixels, so training curves are meaningful."""
+    rng = np.random.RandomState(seed)
+    x = rng.rand(n, 28, 28, 1).astype(np.float32)
+    w = rng.randn(784, 10).astype(np.float32)
+    y = np.argmax(x.reshape(n, -1) @ w, axis=1).astype(np.int32)
+    return (x[: n * 3 // 4], y[: n * 3 // 4]), (x[n * 3 // 4:], y[n * 3 // 4:])
+
+
+def synthetic_imagenet(n=256, size=224, seed=0):
+    rng = np.random.RandomState(seed)
+    x = rng.rand(n, size, size, 3).astype(np.float32)
+    y = rng.randint(0, 1000, size=(n,)).astype(np.int32)
+    return x, y
+
+
+def synthetic_text(n_tokens=65536, vocab=1000, seed=0):
+    """Zipf-ish token stream for word2vec / LM examples."""
+    rng = np.random.RandomState(seed)
+    p = 1.0 / np.arange(1, vocab + 1)
+    p /= p.sum()
+    return rng.choice(vocab, size=n_tokens, p=p).astype(np.int32)

@@ -1,0 +1,24 @@
+#!/usr/bin/env python
+"""Synthetic model benchmark — img/sec per chip, mean ± 1.96σ (reference:
+examples/tensorflow_synthetic_benchmark.py). ResNet-50 by default; any
+model in horovod_tpu.models via --model.
+
+Run: PYTHONPATH=. python examples/jax_synthetic_benchmark.py --model resnet50
+"""
+
+import argparse
+import subprocess
+import sys
+import os
+
+
+def main():
+    # bench.py at the repo root is the canonical implementation; this
+    # wrapper keeps the reference's examples/ entry point.
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    sys.exit(subprocess.call(
+        [sys.executable, os.path.join(root, "bench.py")] + sys.argv[1:]))
+
+
+if __name__ == "__main__":
+    main()

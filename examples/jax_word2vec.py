@@ -1,0 +1,93 @@
+#!/usr/bin/env python
+"""word2vec skip-gram with negative sampling (reference:
+examples/tensorflow_word2vec.py): each rank samples its own skip-gram
+batches from the token stream; gradients average across ranks.
+
+Run: PYTHONPATH=. python examples/jax_word2vec.py --steps 50
+"""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax.sharding import PartitionSpec as P
+
+import horovod_tpu as hvd
+import horovod_tpu.jax as hvd_jax
+from horovod_tpu.models import Word2Vec
+
+from common import synthetic_text
+
+
+def skipgram_batches(tokens, batch, window, k_neg, vocab, seed):
+    rng = np.random.RandomState(seed)
+    while True:
+        centers = rng.randint(window, len(tokens) - window, size=batch)
+        offs = rng.randint(1, window + 1, size=batch)
+        signs = rng.choice([-1, 1], size=batch)
+        ctx = tokens[centers + offs * signs]
+        negs = rng.randint(0, vocab, size=(batch, k_neg))
+        yield tokens[centers], ctx, negs.astype(np.int32)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--steps", type=int, default=100)
+    ap.add_argument("--batch-size", type=int, default=128,
+                    help="per-chip batch")
+    ap.add_argument("--embedding-dim", type=int, default=128)
+    ap.add_argument("--vocab", type=int, default=1000)
+    ap.add_argument("--window", type=int, default=2)
+    ap.add_argument("--negatives", type=int, default=5)
+    args = ap.parse_args()
+
+    hvd.init()
+    tokens = synthetic_text(vocab=args.vocab)
+    model = Word2Vec(vocab_size=args.vocab,
+                     embedding_dim=args.embedding_dim)
+    opt = hvd_jax.DistributedOptimizer(optax.adagrad(0.5))
+
+    variables = model.init(jax.random.PRNGKey(0),
+                           jnp.zeros((4,), jnp.int32))
+    params = hvd_jax.broadcast_parameters(variables["params"])
+    opt_state = opt.init(params)
+
+    def loss_fn(params, c, x, n):
+        return model.apply({"params": params}, c, x, n,
+                           method=model.neg_loss)
+
+    @hvd_jax.jit(in_specs=(P(), P(), P(hvd_jax.HVD_AXIS),
+                           P(hvd_jax.HVD_AXIS), P(hvd_jax.HVD_AXIS)),
+                 out_specs=(P(), P(), P()))
+    def step(params, opt_state, c, x, n):
+        loss, g = jax.value_and_grad(loss_fn)(params, c, x, n)
+        updates, opt_state = opt.update(g, opt_state, params)
+        return optax.apply_updates(params, updates), opt_state, \
+            hvd_jax.allreduce(loss)
+
+    gen = skipgram_batches(tokens, args.batch_size * hvd.local_size(),
+                           args.window, args.negatives, args.vocab,
+                           seed=hvd.rank())
+    first = last = None
+    for s in range(args.steps):
+        c, x, n = next(gen)
+        params, opt_state, loss = step(
+            params, opt_state, jnp.asarray(c), jnp.asarray(x),
+            jnp.asarray(n))
+        if s == 0:
+            first = float(loss)
+        last = float(loss)
+        if s % 20 == 0:
+            print(f"step {s}: loss={last:.4f}")
+    print(f"final loss: {last:.4f}")
+    assert last < first
+
+
+if __name__ == "__main__":
+    main()

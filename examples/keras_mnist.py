@@ -1,0 +1,52 @@
+#!/usr/bin/env python
+"""MNIST through the Trainer frontend (reference: examples/keras_mnist.py):
+DistributedOptimizer wrapping, broadcast callback, lr scaled by size.
+
+Run: PYTHONPATH=. python examples/keras_mnist.py --epochs 2
+"""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+import optax
+
+import horovod_tpu as hvd
+import horovod_tpu.keras as hvd_keras
+from horovod_tpu.keras.callbacks import (
+    BroadcastGlobalVariablesCallback,
+    MetricAverageCallback,
+)
+from horovod_tpu.models import MnistConvNet
+
+from common import synthetic_mnist
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--epochs", type=int, default=2)
+    ap.add_argument("--batch-size", type=int, default=32)
+    ap.add_argument("--lr", type=float, default=0.001)
+    args = ap.parse_args()
+
+    hvd.init()
+    (xtr, ytr), (xte, yte) = synthetic_mnist()
+
+    trainer = hvd_keras.Trainer(
+        MnistConvNet(),
+        optax.adam(args.lr * hvd.size()),  # reference: keras_mnist.py:41
+    )
+    hist = trainer.fit(
+        xtr, ytr, batch_size=args.batch_size, epochs=args.epochs,
+        callbacks=[BroadcastGlobalVariablesCallback(0),
+                   MetricAverageCallback()],
+        validation_data=(xte, yte), verbose=1)
+    if len(hist["loss"]) > 1:
+        assert hist["loss"][-1] < hist["loss"][0]
+    assert hist["val_loss"][-1] == hist["val_loss"][-1]  # finite, not NaN
+
+
+if __name__ == "__main__":
+    main()

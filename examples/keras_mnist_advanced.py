@@ -1,0 +1,79 @@
+#!/usr/bin/env python
+"""MNIST with the full callback suite + checkpoint/resume (reference:
+examples/keras_mnist_advanced.py): warmup, lr schedule with momentum
+correction, metric averaging, resume-from-latest-checkpoint with the
+restored epoch broadcast from rank 0.
+
+Run: PYTHONPATH=. python examples/keras_mnist_advanced.py --epochs 4
+"""
+
+import argparse
+import os
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+import optax
+
+import horovod_tpu as hvd
+import horovod_tpu.jax as hvd_jax
+import horovod_tpu.keras as hvd_keras
+from horovod_tpu.keras.callbacks import (
+    BroadcastGlobalVariablesCallback,
+    LearningRateScheduleCallback,
+    LearningRateWarmupCallback,
+    MetricAverageCallback,
+)
+from horovod_tpu.models import MnistConvNet
+from horovod_tpu.utils import latest_checkpoint
+
+from common import synthetic_mnist
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--epochs", type=int, default=4)
+    ap.add_argument("--batch-size", type=int, default=32)
+    ap.add_argument("--warmup-epochs", type=int, default=2)
+    ap.add_argument("--checkpoint-dir", default="")
+    args = ap.parse_args()
+
+    hvd.init()
+    ckpt_dir = args.checkpoint_dir or os.path.join(
+        tempfile.gettempdir(), "hvd_keras_advanced")
+    (xtr, ytr), (xte, yte) = synthetic_mnist()
+
+    trainer = hvd_keras.Trainer(
+        MnistConvNet(), optax.sgd(0.01 * hvd.size(), momentum=0.9))
+
+    # Resume: restored epoch decided by rank 0 and broadcast (reference:
+    # keras_imagenet_resnet50.py:73,102-103).
+    resume_epoch = 0
+    ckpt = latest_checkpoint(ckpt_dir)
+    if ckpt:
+        trainer.load(ckpt, xtr[:args.batch_size])
+        resume_epoch = int(hvd_jax.broadcast_object(
+            trainer._epoch + 1, root_rank=0))
+        print(f"resuming from epoch {resume_epoch}")
+
+    callbacks = [
+        BroadcastGlobalVariablesCallback(0),
+        MetricAverageCallback(),
+        LearningRateWarmupCallback(warmup_epochs=args.warmup_epochs,
+                                   verbose=1),
+        LearningRateScheduleCallback(
+            multiplier=lambda e: 0.5 ** max(0, e - args.warmup_epochs),
+            start_epoch=args.warmup_epochs),
+    ]
+    hist = trainer.fit(xtr, ytr, batch_size=args.batch_size,
+                       epochs=args.epochs, callbacks=callbacks,
+                       initial_epoch=resume_epoch,
+                       validation_data=(xte, yte), verbose=1)
+    trainer.save(ckpt_dir)
+    if hist.get("loss"):
+        assert hist["loss"][-1] < 2.5
+
+
+if __name__ == "__main__":
+    main()

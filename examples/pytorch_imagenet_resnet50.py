@@ -1,0 +1,104 @@
+#!/usr/bin/env python
+"""ResNet-50 ImageNet-style torch training (reference:
+examples/pytorch_imagenet_resnet50.py): gradient accumulation via
+batches-per-allreduce, warmup LR schedule, checkpoint/resume with the
+resume epoch decided on rank 0, distributed metric averaging.
+
+Run: PYTHONPATH=. python examples/pytorch_imagenet_resnet50.py --epochs 1 \
+         --steps 4
+"""
+
+import argparse
+import os
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+import numpy as np
+import torch
+import torch.nn.functional as F
+
+import horovod_tpu.torch as hvd
+import torchvision_stub
+from horovod_tpu.utils import Metric
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--epochs", type=int, default=1)
+    ap.add_argument("--batch-size", type=int, default=8)
+    ap.add_argument("--batches-per-allreduce", type=int, default=2,
+                    help="gradient accumulation (reference: :140-144)")
+    ap.add_argument("--base-lr", type=float, default=0.0125)
+    ap.add_argument("--warmup-epochs", type=int, default=5)
+    ap.add_argument("--steps", type=int, default=4)
+    ap.add_argument("--checkpoint-dir", default="")
+    args = ap.parse_args()
+
+    hvd.init()
+    ckpt_dir = args.checkpoint_dir or os.path.join(
+        tempfile.gettempdir(), "hvd_torch_r50")
+    os.makedirs(ckpt_dir, exist_ok=True)
+    ckpt_format = os.path.join(ckpt_dir, "checkpoint-{epoch}.pt")
+
+    # Resume from the latest checkpoint on rank 0; epoch broadcast to all
+    # (reference: pytorch_imagenet_resnet50.py:70-80,135-143).
+    resume_from_epoch = 0
+    for try_epoch in range(args.epochs, 0, -1):
+        if os.path.exists(ckpt_format.format(epoch=try_epoch)):
+            resume_from_epoch = try_epoch
+            break
+    resume_from_epoch = int(hvd.broadcast(
+        torch.tensor(resume_from_epoch), root_rank=0,
+        name="resume_from_epoch").item())
+
+    model = torchvision_stub.get_model("resnet50")
+    lr_scaler = args.batches_per_allreduce * hvd.size()
+    optimizer = torch.optim.SGD(model.parameters(),
+                                lr=args.base_lr * lr_scaler, momentum=0.9)
+    optimizer = hvd.DistributedOptimizer(
+        optimizer, named_parameters=model.named_parameters(),
+        backward_passes_per_step=args.batches_per_allreduce)
+
+    if resume_from_epoch > 0 and hvd.rank() == 0:
+        ckpt = torch.load(ckpt_format.format(epoch=resume_from_epoch))
+        model.load_state_dict(ckpt["model"])
+    hvd.broadcast_parameters(model.state_dict(), root_rank=0)
+    hvd.broadcast_optimizer_state(optimizer, root_rank=0)
+
+    data = torch.randn(args.batch_size, 3, 64, 64)
+    target = torch.randint(0, 1000, (args.batch_size,))
+
+    def adjust_lr(epoch, batch, steps):
+        # Reference warmup formula (pytorch_imagenet_resnet50.py:178-190).
+        if epoch < args.warmup_epochs:
+            ep = epoch + float(batch + 1) / steps
+            adj = 1.0 / hvd.size() * (
+                ep * (hvd.size() - 1) / args.warmup_epochs + 1)
+        else:
+            adj = 0.1 ** ((epoch - args.warmup_epochs) // 30 + 0)
+            adj = max(adj, 1e-3)
+        for g in optimizer.param_groups:
+            g["lr"] = args.base_lr * lr_scaler * adj
+
+    model.train()
+    for epoch in range(resume_from_epoch, args.epochs):
+        train_loss = Metric("train_loss")
+        for b in range(args.steps):
+            adjust_lr(epoch, b, args.steps)
+            optimizer.zero_grad()
+            for _ in range(args.batches_per_allreduce):
+                loss = F.cross_entropy(model(data), target)
+                train_loss.update(loss.item())
+                (loss / args.batches_per_allreduce).backward()
+            optimizer.step()
+        print(f"epoch {epoch}: train_loss={train_loss.avg:.4f} "
+              f"(averaged over {hvd.size()} ranks)")
+        if hvd.rank() == 0:
+            torch.save({"model": model.state_dict()},
+                       ckpt_format.format(epoch=epoch + 1))
+
+
+if __name__ == "__main__":
+    main()

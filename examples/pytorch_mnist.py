@@ -1,0 +1,88 @@
+#!/usr/bin/env python
+"""MNIST with the PyTorch frontend (reference: examples/pytorch_mnist.py):
+hvd.DistributedOptimizer hooks, broadcast of parameters and optimizer
+state. Torch computes on CPU; collectives ride the XLA engine.
+
+Run: PYTHONPATH=. python examples/pytorch_mnist.py --epochs 1
+"""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+import numpy as np
+import torch
+import torch.nn as nn
+import torch.nn.functional as F
+
+import horovod_tpu.torch as hvd
+
+from common import synthetic_mnist
+
+
+class Net(nn.Module):
+    """The reference example's model (pytorch_mnist.py:23-39)."""
+
+    def __init__(self):
+        super().__init__()
+        self.conv1 = nn.Conv2d(1, 10, kernel_size=5)
+        self.conv2 = nn.Conv2d(10, 20, kernel_size=5)
+        self.conv2_drop = nn.Dropout2d()
+        self.fc1 = nn.Linear(320, 50)
+        self.fc2 = nn.Linear(50, 10)
+
+    def forward(self, x):
+        x = F.relu(F.max_pool2d(self.conv1(x), 2))
+        x = F.relu(F.max_pool2d(self.conv2_drop(self.conv2(x)), 2))
+        x = x.reshape(-1, 320)
+        x = F.relu(self.fc1(x))
+        x = F.dropout(x, training=self.training)
+        return F.log_softmax(self.fc2(x), dim=1)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--epochs", type=int, default=1)
+    ap.add_argument("--batch-size", type=int, default=64)
+    ap.add_argument("--lr", type=float, default=0.01)
+    ap.add_argument("--momentum", type=float, default=0.5)
+    args = ap.parse_args()
+
+    hvd.init()
+    torch.manual_seed(42 + hvd.rank())
+    (xtr, ytr), _ = synthetic_mnist()
+    xtr = torch.from_numpy(np.transpose(xtr, (0, 3, 1, 2)))
+    ytr = torch.from_numpy(ytr.astype(np.int64))
+
+    model = Net()
+    optimizer = torch.optim.SGD(model.parameters(),
+                                lr=args.lr * hvd.size(),
+                                momentum=args.momentum)
+    # Reference integration (pytorch_mnist.py:102-110): broadcast state,
+    # wrap the optimizer.
+    hvd.broadcast_parameters(model.state_dict(), root_rank=0)
+    hvd.broadcast_optimizer_state(optimizer, root_rank=0)
+    optimizer = hvd.DistributedOptimizer(
+        optimizer, named_parameters=model.named_parameters())
+
+    model.train()
+    first = last = None
+    for epoch in range(args.epochs):
+        for i in range(0, len(xtr) - args.batch_size, args.batch_size):
+            data = xtr[i:i + args.batch_size]
+            target = ytr[i:i + args.batch_size]
+            optimizer.zero_grad()
+            loss = F.nll_loss(model(data), target)
+            loss.backward()
+            optimizer.step()
+            if first is None:
+                first = loss.item()
+            last = loss.item()
+        print(f"epoch {epoch}: loss={last:.4f}")
+    assert last < first, (first, last)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,39 @@
+"""Minimal torch model zoo for the torch benchmark example (torchvision is
+not in this image; the reference pulls models from it —
+examples/pytorch_synthetic_benchmark.py:34)."""
+
+import torch.nn as nn
+
+
+def _block(cin, cout, stride=1):
+    return nn.Sequential(
+        nn.Conv2d(cin, cout, 3, stride=stride, padding=1, bias=False),
+        nn.BatchNorm2d(cout), nn.ReLU(inplace=True),
+        nn.Conv2d(cout, cout, 3, padding=1, bias=False),
+        nn.BatchNorm2d(cout), nn.ReLU(inplace=True))
+
+
+class SmallResNet(nn.Module):
+    def __init__(self, widths=(64, 128, 256, 512), num_classes=1000):
+        super().__init__()
+        layers = [nn.Conv2d(3, widths[0], 7, stride=2, padding=3,
+                            bias=False),
+                  nn.BatchNorm2d(widths[0]), nn.ReLU(inplace=True),
+                  nn.MaxPool2d(3, stride=2, padding=1)]
+        cin = widths[0]
+        for w in widths:
+            layers.append(_block(cin, w, stride=1 if w == cin else 2))
+            cin = w
+        self.features = nn.Sequential(*layers)
+        self.pool = nn.AdaptiveAvgPool2d(1)
+        self.fc = nn.Linear(cin, num_classes)
+
+    def forward(self, x):
+        x = self.pool(self.features(x)).flatten(1)
+        return self.fc(x)
+
+
+def get_model(name: str) -> nn.Module:
+    if name in ("resnet18", "resnet34", "resnet50"):
+        return SmallResNet()
+    raise ValueError(f"unknown model {name}")
