@@ -1,0 +1,51 @@
+"""dm-haiku frontend.
+
+The reference ships four frontends (TF, torch, standalone Keras, tf.keras
+— SURVEY.md §1 L4); haiku fills the "second JAX-native frontend" seat
+here. Haiku is functional like flax, so the integration surface is thin:
+the same optax ``DistributedOptimizer`` wrapper, parameter/state broadcast
+for ``hk.transform`` param trees, and distributed grad helpers.
+"""
+
+from __future__ import annotations
+
+from horovod_tpu.common.topology import (  # noqa: F401
+    init,
+    shutdown,
+    is_initialized,
+    size,
+    rank,
+    local_size,
+    local_rank,
+    cross_size,
+    cross_rank,
+    mesh,
+)
+from horovod_tpu.jax import (  # noqa: F401
+    Compression,
+    DistributedOptimizer,
+    allreduce,
+    allreduce_pytree,
+    broadcast_object,
+    broadcast_pytree,
+    grad,
+    jit,
+    value_and_grad,
+)
+from horovod_tpu.ops.collectives import (  # noqa: F401
+    HVD_AXIS,
+    allgather,
+    axis_rank,
+    broadcast,
+)
+
+
+def broadcast_parameters(params, root_rank: int = 0):
+    """Broadcast an ``hk.Params`` tree from root (haiku params are plain
+    nested dicts of arrays — one fused broadcast per dtype)."""
+    return broadcast_pytree(params, root_rank=root_rank)
+
+
+def broadcast_state(state, root_rank: int = 0):
+    """Broadcast ``hk.State`` (batch norm statistics etc.)."""
+    return broadcast_pytree(state, root_rank=root_rank)

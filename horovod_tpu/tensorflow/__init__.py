@@ -1,0 +1,145 @@
+"""TensorFlow frontend (reference: horovod/tensorflow/__init__.py).
+
+TF computes on host CPU in this stack (the chips belong to JAX/XLA);
+collectives stage through the mesh like the reference's CudaOnCPU path.
+For TPU-resident TF-free training use :mod:`horovod_tpu.jax` — this
+frontend exists so reference TF scripts port mechanically.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import tensorflow as tf
+
+from horovod_tpu.common.topology import (  # noqa: F401
+    init,
+    shutdown,
+    is_initialized,
+    size,
+    rank,
+    local_size,
+    local_rank,
+    cross_size,
+    cross_rank,
+    mpi_threads_supported,
+)
+from horovod_tpu.tensorflow.compression import Compression  # noqa: F401
+from horovod_tpu.tensorflow.mpi_ops import (  # noqa: F401
+    _allreduce,
+    allgather,
+    broadcast,
+)
+
+
+def allreduce(tensor, average: bool = True, device_dense: str = "",
+              device_sparse: str = "", compression=Compression.none):
+    """Allreduce with the reference's sparse path: IndexedSlices become an
+    allgather of values+indices (reference:
+    horovod/tensorflow/__init__.py:48-94)."""
+    if isinstance(tensor, tf.IndexedSlices):
+        values = allgather(tensor.values)
+        indices = allgather(tensor.indices)
+        if average:
+            values = tf.math.divide(values, float(size()))
+        return tf.IndexedSlices(values, indices,
+                                dense_shape=tensor.dense_shape)
+    t, ctx = compression.compress(tensor)
+    summed = _allreduce(t, average=False)
+    out = compression.decompress(summed, ctx)
+    if average:
+        out = tf.math.divide(out, float(size()))
+    return out
+
+
+def broadcast_variables(variables, root_rank: int = 0):
+    """Assign every variable its root-rank value (reference:
+    broadcast_global_variables, horovod/tensorflow/__init__.py:96-115)."""
+    for var in variables:
+        var.assign(broadcast(tf.convert_to_tensor(var), root_rank))
+
+
+def broadcast_global_variables(root_rank: int = 0):
+    """TF1-style parity name; in TF2 pass explicit variables to
+    :func:`broadcast_variables`."""
+    raise NotImplementedError(
+        "TF2 has no global variable collection; call "
+        "broadcast_variables(model.variables, root_rank) instead "
+        "(reference API: horovod/tensorflow/__init__.py:96-115)")
+
+
+class BroadcastGlobalVariablesCallback(tf.keras.callbacks.Callback):
+    """Keras callback broadcasting initial model+optimizer state from root
+    (the TF2 form of BroadcastGlobalVariablesHook, reference:
+    horovod/tensorflow/__init__.py:118-149)."""
+
+    def __init__(self, root_rank: int = 0):
+        super().__init__()
+        self.root_rank = root_rank
+        self._done = False
+
+    def on_train_batch_begin(self, batch, logs=None):
+        if self._done:
+            return
+        broadcast_variables(self.model.variables, self.root_rank)
+        if getattr(self.model, "optimizer", None) is not None:
+            broadcast_variables(self.model.optimizer.variables,
+                                self.root_rank)
+        self._done = True
+
+
+class DistributedGradientTape(tf.GradientTape):
+    """GradientTape whose ``gradient()`` allreduces results (reference:
+    horovod/tensorflow/__init__.py:253-328)."""
+
+    def __init__(self, *args, average: bool = True,
+                 compression=Compression.none,
+                 sparse_as_dense: bool = False, **kwargs):
+        super().__init__(*args, **kwargs)
+        self._hvd_average = average
+        self._hvd_compression = compression
+        self._hvd_sparse_as_dense = sparse_as_dense
+
+    def gradient(self, target, sources, output_gradients=None, **kw):
+        grads = super().gradient(target, sources, output_gradients, **kw)
+        return [self._reduce(g) for g in grads]
+
+    def _reduce(self, g):
+        if g is None:
+            return None
+        if isinstance(g, tf.IndexedSlices) and self._hvd_sparse_as_dense:
+            g = tf.convert_to_tensor(g)
+        return allreduce(g, average=self._hvd_average,
+                         compression=self._hvd_compression)
+
+
+def DistributedOptimizer(optimizer, name: Optional[str] = None,
+                         use_locking: bool = False, average: bool = True,
+                         compression=Compression.none,
+                         sparse_as_dense: bool = False):
+    """Wrap a keras optimizer so gradients are allreduced before being
+    applied (reference: horovod/tensorflow/__init__.py:152-250 — there it
+    overrides compute_gradients; TF2's integration point is
+    apply_gradients)."""
+
+    class _Distributed(optimizer.__class__):
+        _hvd_wrapped = True
+
+        def apply_gradients(self, grads_and_vars, *args, **kwargs):
+            gv = list(grads_and_vars)
+            reduced = []
+            for g, v in gv:
+                if g is None:
+                    reduced.append((g, v))
+                    continue
+                if isinstance(g, tf.IndexedSlices) and sparse_as_dense:
+                    g = tf.convert_to_tensor(g)
+                reduced.append(
+                    (allreduce(g, average=average, compression=compression),
+                     v))
+            return super().apply_gradients(reduced, *args, **kwargs)
+
+    # Fresh instance of the dynamic subclass; slots build lazily on first
+    # apply_gradients (keras 3 semantics). Wrap BEFORE any training, as the
+    # reference requires (its optimizer is likewise wrapped pre-training).
+    return _Distributed.from_config(optimizer.get_config())

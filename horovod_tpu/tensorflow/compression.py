@@ -1,0 +1,54 @@
+"""Gradient compression for the TF frontend (reference:
+horovod/tensorflow/compression.py)."""
+
+from __future__ import annotations
+
+import tensorflow as tf
+
+
+class Compressor:
+    """Interface (reference: tensorflow/compression.py:23-34)."""
+
+    @staticmethod
+    def compress(tensor):
+        raise NotImplementedError
+
+    @staticmethod
+    def decompress(tensor, ctx):
+        raise NotImplementedError
+
+
+class NoneCompressor(Compressor):
+    @staticmethod
+    def compress(tensor):
+        return tensor, None
+
+    @staticmethod
+    def decompress(tensor, ctx):
+        return tensor
+
+
+class FP16Compressor(Compressor):
+    """Cast-down/cast-up (reference: tensorflow/compression.py:46-64).
+    On TPU the 16-bit wire dtype is bfloat16 — same exponent range as f32,
+    so gradient casts cannot overflow the way fp16 can."""
+
+    @staticmethod
+    def compress(tensor):
+        ctx = tensor.dtype
+        if tensor.dtype.is_floating:
+            tensor = tf.cast(tensor, tf.bfloat16)
+        return tensor, ctx
+
+    @staticmethod
+    def decompress(tensor, ctx):
+        if ctx is not None and ctx.is_floating:
+            tensor = tf.cast(tensor, ctx)
+        return tensor
+
+
+class Compression:
+    """Reference: tensorflow/compression.py:67-74."""
+
+    none = NoneCompressor
+    fp16 = FP16Compressor

@@ -1,0 +1,125 @@
+"""TF collective ops with registered gradients (reference:
+horovod/tensorflow/mpi_ops.py + the custom-op kernels of
+tensorflow/mpi_ops.cc).
+
+The reference implements TF custom C++ ops that enqueue into the engine.
+Here the bridge is ``tf.py_function`` into the XLA data plane: TF runs on
+host CPU (there is no TF-on-TPU in this stack — JAX owns the chips), so
+collectives hop tensor → numpy → mesh collective → numpy → tensor, exactly
+the staging shape of the reference's CudaOnCPU path
+(torch/mpi_ops_v2.cc:78-110). Gradients are registered per the reference:
+allreduce→allreduce (mpi_ops.py:94-105), allgather→allreduce+slice
+(:127-148), broadcast→allreduce zeroed off-root (:168-183).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+import tensorflow as tf
+
+from horovod_tpu.common import topology as _topo
+from horovod_tpu.ops import collectives as _C
+
+
+def _np_collective(kind: str, t: np.ndarray, *, average=False, root=0):
+    import jax.numpy as jnp
+
+    x = jnp.asarray(t)
+    if kind == "allreduce":
+        out = _C.allreduce(x, average=average)
+    elif kind == "allgather":
+        out = _C.allgather(x)
+    elif kind == "broadcast":
+        out = _C.broadcast(x, root)
+    else:
+        raise ValueError(kind)
+    return np.asarray(out)
+
+
+def _bridge(kind: str, tensor: tf.Tensor, **kw) -> tf.Tensor:
+    """Run an XLA-mesh collective on a TF tensor via py_function so the op
+    works in both eager and tf.function graphs."""
+
+    def fn(t):
+        return _np_collective(kind, t.numpy(), **kw)
+
+    out = tf.py_function(fn, [tensor], Tout=tensor.dtype)
+    if kind != "allgather":
+        out.set_shape(tensor.shape)
+    else:
+        shape = tensor.shape.as_list()
+        if shape and shape[0] is not None:
+            shape[0] = shape[0] * _topo.size()
+        out.set_shape(shape)
+    return out
+
+
+def size() -> int:
+    return _topo.size()
+
+
+def rank() -> int:
+    return _topo.rank()
+
+
+def _allreduce(tensor: tf.Tensor, average: bool = False,
+               name: Optional[str] = None) -> tf.Tensor:
+    @tf.custom_gradient
+    def op(x):
+        y = _bridge("allreduce", x, average=average)
+
+        def grad(dy):
+            # Reference: allreduce's gradient is an allreduce
+            # (tensorflow/mpi_ops.py:94-105).
+            return _bridge("allreduce", dy, average=average)
+
+        return y, grad
+
+    return op(tensor)
+
+
+def allgather(tensor: tf.Tensor, name: Optional[str] = None) -> tf.Tensor:
+    """Concat along dim 0 over ranks (reference: mpi_ops.py:108-126)."""
+    n = _topo.size()
+
+    @tf.custom_gradient
+    def op(x):
+        y = _bridge("allgather", x)
+
+        def grad(dy):
+            # Reference: allreduce(SUM) then slice this rank's rows
+            # (mpi_ops.py:127-148). Equal first dims per rank here (the
+            # single-controller case); the eager varying-dim path exists
+            # on the jax frontend.
+            summed = _bridge("allreduce", dy, average=False)
+            per = tf.shape(summed)[0] // n
+            r = _topo.rank()
+            return summed[per * r: per * (r + 1)]
+
+        return y, grad
+
+    return op(tensor)
+
+
+def broadcast(tensor: tf.Tensor, root_rank: int,
+              name: Optional[str] = None) -> tf.Tensor:
+    """Every rank receives root's value (reference: mpi_ops.py:151-183)."""
+    root_rank = _C._check_root(root_rank)
+
+    @tf.custom_gradient
+    def op(x):
+        y = _bridge("broadcast", x, root=root_rank)
+
+        def grad(dy):
+            # Reference: reduce to root, zero elsewhere (mpi_ops.py:
+            # 168-183).
+            g = _bridge("allreduce", dy, average=False)
+            if _topo.rank() == root_rank:
+                return g
+            return tf.zeros_like(g)
+
+        return y, grad
+
+    return op(tensor)

@@ -1,0 +1,52 @@
+"""haiku frontend: a transformed model trains through the distributed
+optimizer on the mesh."""
+
+import numpy as np
+import pytest
+
+hk = pytest.importorskip("haiku")
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+import optax  # noqa: E402
+from jax.sharding import PartitionSpec as P  # noqa: E402
+
+import horovod_tpu.haiku as hvd_hk  # noqa: E402
+
+
+def test_haiku_training_loop(hvd):
+    def net(x):
+        return hk.Sequential([hk.Linear(16), jax.nn.relu, hk.Linear(1)])(x)
+
+    model = hk.without_apply_rng(hk.transform(net))
+    rng = np.random.RandomState(0)
+    x = rng.randn(64, 4).astype(np.float32)
+    y = (x @ rng.randn(4, 1)).astype(np.float32)
+
+    params = model.init(jax.random.PRNGKey(0), jnp.asarray(x[:8]))
+    params = hvd_hk.broadcast_parameters(params)
+    opt = hvd_hk.DistributedOptimizer(optax.adam(1e-2))
+    opt_state = opt.init(params)
+
+    def loss_fn(p, xb, yb):
+        return jnp.mean((model.apply(p, xb) - yb) ** 2)
+
+    @hvd_hk.jit(in_specs=(P(), P(), P(hvd_hk.HVD_AXIS), P(hvd_hk.HVD_AXIS)),
+                out_specs=(P(), P(), P()))
+    def step(p, s, xb, yb):
+        loss, g = jax.value_and_grad(loss_fn)(p, xb, yb)
+        updates, s = opt.update(g, s, p)
+        return optax.apply_updates(p, updates), s, hvd_hk.allreduce(loss)
+
+    losses = []
+    for _ in range(30):
+        params, opt_state, loss = step(params, opt_state,
+                                       jnp.asarray(x), jnp.asarray(y))
+        losses.append(float(loss))
+    assert losses[-1] < losses[0] * 0.2
+
+
+def test_haiku_state_broadcast(hvd):
+    state = {"bn": {"mean": jnp.ones((4,)), "var": jnp.zeros((4,))}}
+    out = hvd_hk.broadcast_state(state)
+    np.testing.assert_allclose(out["bn"]["mean"], np.ones(4))
