@@ -1,0 +1,126 @@
+"""Flash attention as a pallas TPU kernel.
+
+The hot op of transformer training. XLA's stock attention materializes the
+(s × s) logits in HBM; this kernel streams K/V blocks through VMEM with an
+online softmax so HBM traffic is O(s·d) instead of O(s²) — the standard
+flash formulation (Dao et al.), written for the MXU: block sizes default to
+128 (the systolic tile), accumulation in f32.
+
+Plugs in anywhere the model zoo accepts an ``attention_fn``
+(:class:`horovod_tpu.models.TransformerConfig`) and composes with sequence
+parallelism: inside :func:`horovod_tpu.parallel.ulysses_attention` it
+kernels the per-head full-sequence attention, and ring attention's
+per-block math is the same online-softmax update this kernel runs locally.
+
+Off-TPU (tests, CPU debugging) the kernel runs in pallas interpret mode —
+same code path, scalar semantics.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+NEG_INF = -1e30
+
+
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k: int, causal: bool,
+                  scale: float):
+    bq = q_ref.shape[1]
+    d = q_ref.shape[2]
+    s = k_ref.shape[1]
+    qi = pl.program_id(1)
+
+    q = q_ref[0].astype(jnp.float32) * scale  # (bq, d)
+
+    nk = s // block_k
+    if causal:
+        # Blocks entirely above the diagonal contribute nothing; bound the
+        # loop at the diagonal block.
+        ub = (qi * bq + bq + block_k - 1) // block_k
+        ub = jnp.minimum(ub, nk)
+    else:
+        ub = nk
+
+    q_pos = qi * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, block_k), 0)
+
+    def body(ki, carry):
+        o, m, l = carry
+        kb = k_ref[0, pl.dslice(ki * block_k, block_k), :].astype(jnp.float32)
+        vb = v_ref[0, pl.dslice(ki * block_k, block_k), :].astype(jnp.float32)
+        sblk = q @ kb.T  # (bq, bk) on the MXU
+        if causal:
+            k_pos = ki * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (bq, block_k), 1)
+            sblk = jnp.where(q_pos >= k_pos, sblk, NEG_INF)
+        m_new = jnp.maximum(m, jnp.max(sblk, axis=1))
+        p = jnp.exp(sblk - m_new[:, None])
+        alpha = jnp.exp(m - m_new)
+        l = l * alpha + jnp.sum(p, axis=1)
+        o = o * alpha[:, None] + p @ vb
+        return o, m_new, l
+
+    o0 = jnp.zeros((bq, d), jnp.float32)
+    m0 = jnp.full((bq,), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((bq,), jnp.float32)
+    o, m, l = jax.lax.fori_loop(0, ub, body, (o0, m0, l0))
+    o_ref[0] = (o / l[:, None]).astype(o_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("causal", "block_q", "block_k",
+                                             "interpret"))
+def _flash_bhsd(q, k, v, causal: bool, block_q: int, block_k: int,
+                interpret: bool):
+    bh, s, d = q.shape
+    grid = (bh, s // block_q)
+    kernel = functools.partial(_flash_kernel, block_k=block_k,
+                               causal=causal, scale=d ** -0.5)
+    return pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, s, d), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, s, d), lambda b, i: (b, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((bh, s, d), q.dtype),
+        interpret=interpret,
+    )(q, k, v)
+
+
+def flash_attention(q, k, v, bias=None, causal: bool = False,
+                    block_q: int = 128, block_k: int = 128,
+                    interpret: bool | None = None):
+    """Exact attention, flash-style. Shapes (batch, seq, heads, head_dim)
+    — the model zoo's ``attention_fn`` contract. ``bias`` is not
+    supported by the kernel (use the stock attention for biased variants).
+    """
+    if bias is not None:
+        raise NotImplementedError(
+            "flash_attention does not take a bias; use "
+            "models.transformer.dot_product_attention for biased attention")
+    b, s, h, d = q.shape
+    block_q = min(block_q, s)
+    block_k = min(block_k, s)
+    if s % block_q or s % block_k:
+        raise ValueError(
+            f"seq len {s} must be divisible by block sizes "
+            f"({block_q}, {block_k})")
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+
+    def to_bhsd(t):
+        return jnp.transpose(t, (0, 2, 1, 3)).reshape(b * h, s, d)
+
+    out = _flash_bhsd(to_bhsd(q), to_bhsd(k), to_bhsd(v), causal,
+                      block_q, block_k, interpret)
+    return jnp.transpose(out.reshape(b, h, s, d), (0, 2, 1, 3))
+
+
+def flash_attention_causal(q, k, v, bias=None, **kw):
+    """Causal variant matching the ``attention_fn`` signature."""
+    return flash_attention(q, k, v, bias, causal=True, **kw)
