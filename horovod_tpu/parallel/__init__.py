@@ -31,3 +31,4 @@ from horovod_tpu.parallel.tensor_parallel import (  # noqa: F401
     ParallelMLP,
 )
 from horovod_tpu.parallel.pipeline import pipeline_apply  # noqa: F401
+from horovod_tpu.parallel.moe import moe_layer  # noqa: F401

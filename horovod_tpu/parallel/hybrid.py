@@ -31,6 +31,7 @@ from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
 from horovod_tpu.parallel.mesh import hybrid_mesh
+from horovod_tpu.parallel.moe import moe_layer
 from horovod_tpu.parallel.pipeline import pipeline_apply
 from horovod_tpu.parallel.ring_attention import ring_attention
 from horovod_tpu.parallel.tensor_parallel import (
@@ -56,14 +57,20 @@ class HybridConfig:
     microbatches: int = 2
     lr: float = 0.1
     dtype: object = jnp.float32
+    # Expert-parallel MoE block per layer (experts sharded over 'ep').
+    use_moe: bool = True
+    experts_per_chip: int = 2
+    moe_capacity_factor: float = 2.0
 
 
 def partition_axes(n: int) -> dict:
-    """Factor ``n`` devices into (dp, pp, tp, sp): powers of two feed the
-    model axes first (pp, tp, sp), any remainder rides dp."""
-    sizes = {"dp": 1, "pp": 1, "tp": 1, "sp": 1}
+    """Factor ``n`` devices into (dp, pp, tp, sp, ep): powers of two feed
+    the model axes first (pp, tp, sp, then ep), any remainder rides dp.
+    Axes the budget can't fill stay at size 1 — their collectives become
+    no-ops but the sharding structure is identical."""
+    sizes = {"dp": 1, "pp": 1, "tp": 1, "sp": 1, "ep": 1}
     rem = n
-    for ax in ("pp", "tp", "sp"):
+    for ax in ("pp", "tp", "sp", "ep"):
         if rem % 2 == 0 and rem > 1:
             sizes[ax] = 2
             rem //= 2
@@ -103,6 +110,44 @@ class HybridStage(nn.Module):
             h = nn.LayerNorm(dtype=cfg.dtype, name=f"ln_mlp_{i}")(x)
             x = x + ParallelMLP(cfg.hidden_dim, cfg.mlp_dim, "tp",
                                 dtype=cfg.dtype, name=f"mlp_{i}")(h)
+            if cfg.use_moe:
+                # Expert-parallel MoE block: experts sharded over 'ep'.
+                # Tokens are replicated across ep in this recipe (they are
+                # sharded over dp and sp only), so each ep chip routes the
+                # same tokens and expert compute is duplicated ep-fold —
+                # correct but redundant. Production deployments map the ep
+                # groups onto dp groups so tokens arrive pre-sharded; kept
+                # simple here because it leaves gradient reduction uniform
+                # (see reduce_grads). The load-balance aux loss is dropped
+                # (pipeline activations must be shape-invariant).
+                ep = lax.psum(1, "ep")
+                ep_idx = lax.axis_index("ep")
+                e_local = cfg.experts_per_chip
+
+                def _expert_init(key, shape, dtype):
+                    # Experts are *sharded* over ep: distinct weights per
+                    # ep chip. Everything else in the stage (router,
+                    # attention, MLP, norms) must stay REPLICATED across
+                    # ep — the module init key is identical across ep, and
+                    # only expert leaves fold the ep index in.
+                    return nn.initializers.lecun_normal()(
+                        jax.random.fold_in(key, ep_idx), shape, dtype)
+
+                h = nn.LayerNorm(dtype=cfg.dtype, name=f"ln_moe_{i}")(x)
+                router = self.param(
+                    f"moe_router_{i}", nn.initializers.lecun_normal(),
+                    (cfg.hidden_dim, e_local * ep), jnp.float32)
+                wi = self.param(
+                    f"moe_wi_{i}", _expert_init,
+                    (e_local, cfg.hidden_dim, cfg.mlp_dim), jnp.float32)
+                wo = self.param(
+                    f"moe_wo_{i}", _expert_init,
+                    (e_local, cfg.mlp_dim, cfg.hidden_dim), jnp.float32)
+                b, s, hid = h.shape
+                y, _aux = moe_layer(
+                    h.reshape(b * s, hid), router, wi, wo, "ep",
+                    capacity_factor=cfg.moe_capacity_factor)
+                x = x + y.reshape(b, s, hid).astype(x.dtype)
         return x
 
 
@@ -110,7 +155,8 @@ def build_train_step(mesh: Mesh, cfg: HybridConfig):
     """Return ``(step, token_spec)`` where ``step(tokens, key) ->
     (loss_before, loss_after)`` initializes hybrid-sharded parameters,
     takes one full SGD step, and re-evaluates — all inside a single
-    compiled SPMD program over ``mesh`` (axes dp/pp/tp/sp)."""
+    compiled SPMD program over ``mesh``. Required axes: dp/pp/tp/sp, plus
+    ``ep`` when ``cfg.use_moe`` (the only place the ep axis is touched)."""
     cfg_stage = HybridStage(cfg)
 
     def spmd(tokens, key):
@@ -124,7 +170,11 @@ def build_train_step(mesh: Mesh, cfg: HybridConfig):
         m = cfg.microbatches
         bm = b_local // m
 
-        # Distinct init per (pp stage, tp shard); identical across dp/sp.
+        # Distinct init per (pp stage, tp shard); identical across
+        # dp/sp/ep — expert weights alone diverge per ep chip, via their
+        # own initializer (see HybridStage._expert_init). Folding ep here
+        # would make the router/attention/MLP weights diverge across ep,
+        # silently desynchronizing the replicas.
         stage_key = jax.random.fold_in(
             jax.random.fold_in(key, pp_idx), tp_idx)
         dummy = jnp.zeros((bm, s_local, cfg.hidden_dim), cfg.dtype)

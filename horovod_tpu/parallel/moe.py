@@ -1,0 +1,110 @@
+"""Mixture-of-experts with expert parallelism over an ``ep`` mesh axis.
+
+Switch-Transformer-style top-1 token-choice routing with capacity: tokens
+are dispatched to experts with one ``all_to_all`` (each chip owns
+``n_experts / ep`` experts' FFN weights), expert FFNs run as dense batched
+matmuls on the MXU, and a mirror ``all_to_all`` brings results home.
+Overflow tokens beyond expert capacity pass through the residual (their
+combine weight is zero) — standard Switch semantics.
+
+No reference equivalent (data-parallel only, SURVEY.md §2.3); this
+completes the ep axis of the hybrid mesh. All dispatch/combine logic is
+one-hot einsum — no gather/scatter with dynamic shapes, so XLA tiles
+everything statically.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def _top1_dispatch(x, router_logits, n_experts: int, capacity: int):
+    """Build dispatch/combine tensors for top-1 routing.
+
+    Returns (dispatch (t,E,C) bool-ish float, combine (t,E,C) float,
+    aux_loss scalar).
+    """
+    t = x.shape[0]
+    probs = jax.nn.softmax(router_logits.astype(jnp.float32), axis=-1)
+    expert_idx = jnp.argmax(probs, axis=-1)  # (t,)
+    gate = jnp.max(probs, axis=-1)  # (t,)
+    onehot = jax.nn.one_hot(expert_idx, n_experts, dtype=jnp.float32)
+    # Position of each token within its chosen expert's queue.
+    pos = (jnp.cumsum(onehot, axis=0) - 1.0) * onehot  # (t, E)
+    keep = (pos < capacity) * onehot
+    pos_clamped = jnp.minimum(pos, capacity - 1).astype(jnp.int32)
+    pos_onehot = jax.nn.one_hot(pos_clamped, capacity,
+                                dtype=jnp.float32)  # (t, E, C)
+    dispatch = keep[..., None] * pos_onehot  # (t, E, C)
+    combine = dispatch * gate[:, None, None]
+    # Switch load-balancing loss: E * sum_e fraction_tokens_e * mean_prob_e.
+    frac = jnp.mean(onehot, axis=0)
+    mean_prob = jnp.mean(probs, axis=0)
+    aux = n_experts * jnp.sum(frac * mean_prob)
+    return dispatch, combine, aux, t
+
+
+def moe_layer(
+    x,
+    router_w,
+    expert_wi,
+    expert_wo,
+    axis_name: str = "ep",
+    capacity_factor: float = 1.25,
+    act: Callable = jax.nn.gelu,
+):
+    """Apply an expert-parallel MoE FFN block inside SPMD code.
+
+    Args:
+      x: (tokens_local, hidden) this chip's tokens.
+      router_w: (hidden, n_experts_global) router weights (replicated).
+      expert_wi: (experts_local, hidden, ff) this chip's experts' input
+        projections — experts are sharded over ``axis_name``.
+      expert_wo: (experts_local, ff, hidden).
+      capacity_factor: per-expert queue size multiplier.
+
+    Returns:
+      (tokens_local, hidden) output, plus the scalar load-balancing aux
+      loss (already pmean'd over the ep axis).
+    """
+    ep = lax.psum(1, axis_name)
+    e_local = expert_wi.shape[0]
+    n_experts = e_local * ep
+    t, hidden = x.shape
+    capacity = max(1, int(t * capacity_factor / n_experts))
+
+    logits = x.astype(jnp.float32) @ router_w.astype(jnp.float32)
+    dispatch, combine, aux, _ = _top1_dispatch(x, logits, n_experts,
+                                               capacity)
+
+    # (t, E, C) x (t, h) -> (E, C, h): token payloads in expert queues.
+    expert_in = jnp.einsum("tec,th->ech", dispatch, x.astype(jnp.float32))
+    # Route queues to their owning chips: (E, C, h) = (ep, e_local, C, h);
+    # all_to_all swaps the ep dim for a source-chip dim.
+    expert_in = expert_in.reshape(ep, e_local, capacity, hidden)
+    expert_in = lax.all_to_all(expert_in, axis_name, split_axis=0,
+                               concat_axis=0, tiled=True)
+    # Post-all_to_all layout is again (ep, e_local, C, h), but dim 0 now
+    # indexes SOURCE chips: row s holds chip s's queue for this chip's
+    # local experts. Merge source × capacity into one batch per expert.
+    expert_in = jnp.transpose(expert_in, (1, 0, 2, 3)).reshape(
+        e_local, ep * capacity, hidden)
+
+    # Dense batched expert FFNs on the MXU.
+    h1 = act(jnp.einsum("ebh,ehf->ebf", expert_in,
+                        expert_wi.astype(jnp.float32)))
+    out = jnp.einsum("ebf,efh->ebh", h1, expert_wo.astype(jnp.float32))
+
+    # Reverse the routing.
+    out = out.reshape(e_local, ep, capacity, hidden)
+    out = jnp.transpose(out, (1, 0, 2, 3))  # (ep, e_local, C, h)
+    out = lax.all_to_all(out, axis_name, split_axis=0, concat_axis=0,
+                         tiled=True)
+    out = out.reshape(n_experts, capacity, hidden)
+
+    y = jnp.einsum("tec,ech->th", combine, out)
+    return y.astype(x.dtype), lax.pmean(aux, axis_name)
