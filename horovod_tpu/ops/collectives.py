@@ -27,6 +27,7 @@ controller.
 from __future__ import annotations
 
 import functools
+import os
 from typing import Optional, Sequence
 
 import jax
@@ -241,6 +242,53 @@ def ranked_alltoall(stacked):
 
 
 # ---------------------------------------------------------------------------
+# Consistency-check mode (debug): reproduce the reference coordinator's
+# request validation (operations.cc:315-517). SPMD determinism makes this
+# structurally unnecessary, but when hunting divergence bugs across
+# controller processes, HVD_CONSISTENCY_CHECKS=1 cross-checks every eager
+# collective's (op, dtype, shape, root) before executing it and surfaces
+# mismatches as errors on EVERY process, like the broadcast ERROR response.
+# ---------------------------------------------------------------------------
+
+_FP_LEN = 16  # op, root, dtype-hash, ndim, dims[<=12]
+
+
+def consistency_checks_enabled() -> bool:
+    return bool(os.environ.get("HVD_CONSISTENCY_CHECKS")
+                or os.environ.get("HOROVOD_CONSISTENCY_CHECKS"))
+
+
+def _maybe_consistency_check(op_code: int, tensor, root: int = -1):
+    st = _topo._require_init()
+    if not consistency_checks_enabled() or st.num_processes == 1:
+        return
+    fp = np.zeros((_FP_LEN,), np.int32)
+    fp[0] = op_code
+    fp[1] = root
+    import zlib
+
+    # crc32, not hash(): Python string hashing is salted per process.
+    fp[2] = zlib.crc32(str(jnp.asarray(tensor).dtype).encode()) % (2 ** 31)
+    shape = jnp.asarray(tensor).shape
+    fp[3] = len(shape)
+    for i, d in enumerate(shape[:12]):
+        fp[4 + i] = d % (2 ** 31)
+    # Every local chip contributes this controller's fingerprint; the
+    # gathered matrix is identical everywhere, so the error (or not) is
+    # raised consistently on every process.
+    gathered = np.asarray(ranked_allgather(_replicated_stack(jnp.asarray(fp))))
+    gathered = gathered.reshape(st.size, _FP_LEN)
+    if not (gathered == gathered[0]).all():
+        bad = np.where((gathered != gathered[0]).any(axis=1))[0]
+        raise _topo.HorovodInternalError(
+            f"consistency check failed: ranks {bad.tolist()} submitted a "
+            f"mismatched collective (op/dtype/shape/root fingerprints "
+            f"differ; local fingerprint {fp.tolist()}). The reference "
+            "coordinator would return an ERROR response here "
+            "(operations.cc:315-517).")
+
+
+# ---------------------------------------------------------------------------
 # Public verbs — context-polymorphic (SPMD tracer or eager host value)
 # ---------------------------------------------------------------------------
 
@@ -259,6 +307,7 @@ def allreduce(tensor, average: bool = True, name: Optional[str] = None):
         # psum(1, axis) constant-folds to the axis size at trace time.
         return _psum_avg(tensor, lax.psum(1, HVD_AXIS), average)
     tensor = jnp.asarray(tensor)
+    _maybe_consistency_check(0, tensor)
     return ranked_allreduce(_replicated_stack(tensor), average=average)
 
 
@@ -274,6 +323,8 @@ def allgather(tensor, name: Optional[str] = None):
     tensor = jnp.asarray(tensor)
     if tensor.ndim == 0:
         raise ValueError("allgather requires a tensor with at least one dimension")
+    # Allgather legitimately permits differing first dims; check the rest.
+    _maybe_consistency_check(1, tensor[:0] if tensor.shape[0] else tensor)
     st = _topo._require_init()
     if st.num_processes == 1:
         return ranked_allgather(_replicated_stack(tensor))
@@ -308,6 +359,7 @@ def broadcast(tensor, root_rank: int, name: Optional[str] = None):
             _require_axis("broadcast")
         return _root_select_psum(tensor, root_rank)
     tensor = jnp.asarray(tensor)
+    _maybe_consistency_check(2, tensor, root_rank)
     return ranked_broadcast(_replicated_stack(tensor), root_rank)
 
 
@@ -320,6 +372,7 @@ def reducescatter(tensor, name: Optional[str] = None):
             _require_axis("reducescatter")
         return lax.psum_scatter(tensor, HVD_AXIS, scatter_dimension=0, tiled=True)
     tensor = jnp.asarray(tensor)
+    _maybe_consistency_check(3, tensor)
     return _local_row(ranked_reducescatter(_replicated_stack(tensor)))
 
 
@@ -331,6 +384,7 @@ def alltoall(tensor, name: Optional[str] = None):
             _require_axis("alltoall")
         return lax.all_to_all(tensor, HVD_AXIS, split_axis=0, concat_axis=0, tiled=True)
     tensor = jnp.asarray(tensor)
+    _maybe_consistency_check(4, tensor)
     return _local_row(ranked_alltoall(_replicated_stack(tensor)))
 
 

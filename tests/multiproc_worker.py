@@ -1,0 +1,93 @@
+"""Worker script for multi-process distributed tests: 2 controller
+processes × 4 virtual CPU chips = an 8-chip world. The TPU analogue of the
+reference's `mpirun -np N` test tier (SURVEY.md §4)."""
+
+import os
+import sys
+
+
+def main():
+    port = sys.argv[1]
+    pid = int(sys.argv[2])
+    nproc = int(sys.argv[3])
+    scenario = sys.argv[4]
+
+    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                               + " --xla_force_host_platform_device_count=4")
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    jax.distributed.initialize(coordinator_address=f"127.0.0.1:{port}",
+                               num_processes=nproc, process_id=pid)
+
+    import numpy as np
+    import jax.numpy as jnp
+
+    import horovod_tpu as hvd
+
+    hvd.init()
+    assert hvd.size() == 4 * nproc, hvd.size()
+    assert hvd.num_processes() == nproc
+    assert hvd.cross_rank() == pid
+    assert hvd.local_size() == 4
+
+    if scenario == "collectives":
+        # allreduce: each process's chips contribute its value.
+        mine = float(pid + 1)
+        out = np.asarray(hvd.allreduce(jnp.full((3,), mine), average=False))
+        expect = 4 * sum(range(1, nproc + 1))
+        np.testing.assert_allclose(out, np.full((3,), expect))
+
+        # broadcast from a chip owned by process 1.
+        val = jnp.full((2,), float(pid) + 10.0)
+        out = np.asarray(hvd.broadcast(val, root_rank=4))  # proc 1's chip
+        np.testing.assert_allclose(out, np.full((2,), 11.0))
+
+        # allgather with DIFFERENT first dims per process (the
+        # size-exchange + pad + strip path).
+        rows = pid + 1
+        g = np.asarray(hvd.allgather(
+            jnp.full((rows, 2), float(pid))))
+        # Each of the 4 local chips contributes this controller's tensor.
+        expect_rows = sum(4 * (p + 1) for p in range(nproc))
+        assert g.shape == (expect_rows, 2), g.shape
+
+        # broadcast_object (pickle path).
+        import horovod_tpu.jax as hvd_jax
+
+        obj = hvd_jax.broadcast_object(
+            {"epoch": 7, "who": "proc0"} if pid == 0 else None, root_rank=0)
+        assert obj == {"epoch": 7, "who": "proc0"}
+
+        # Engine path: async allreduce with fusion force-disabled.
+        from horovod_tpu.core import engine as eng
+
+        e = eng.get_engine()
+        assert e.fusion_threshold == 0, e.fusion_threshold
+        hs = [e.allreduce_async(f"t{i}", np.ones((4,), np.float32), False)
+              for i in range(3)]
+        for h in hs:
+            np.testing.assert_allclose(e.synchronize(h),
+                                       np.full((4,), 4.0 * nproc))
+    elif scenario == "mismatch":
+        os.environ["HVD_CONSISTENCY_CHECKS"] = "1"
+        from horovod_tpu.common.topology import HorovodInternalError
+
+        # Matching op first: must pass.
+        hvd.allreduce(jnp.ones((2,)), average=False)
+        # Then a shape mismatch: every process must raise.
+        shape = (2,) if pid == 0 else (3,)
+        try:
+            hvd.allreduce(jnp.ones(shape), average=False)
+        except HorovodInternalError:
+            print(f"proc {pid}: mismatch detected OK", flush=True)
+        else:
+            raise SystemExit("consistency check did not fire")
+    else:
+        raise SystemExit(f"unknown scenario {scenario}")
+
+    print(f"proc {pid}: SCENARIO {scenario} PASSED", flush=True)
+
+
+if __name__ == "__main__":
+    main()
