@@ -1,0 +1,66 @@
+#!/usr/bin/env python
+"""Allreduce microbenchmark: bus bandwidth and scaling efficiency.
+
+The driver's north-star metric is allreduce scaling efficiency at 8→256
+chips (BASELINE.md). This harness measures, for a sweep of buffer sizes:
+
+- achieved allreduce algorithmic bandwidth (2·N·(size-1)/size bytes moved
+  per chip per ring allreduce — the standard bus-bandwidth formula), and
+- weak-scaling efficiency = t(1 chip) / t(N chips) for fixed per-chip
+  payload (1.0 = perfect).
+
+Runs on whatever mesh is visible: one real chip today, a pod slice
+unmodified. On a single chip the collective is a self-reduction, so the
+numbers are an upper bound / plumbing check.
+
+Run: PYTHONPATH=. python examples/allreduce_benchmark.py --sizes-mb 1 16 64
+"""
+
+import argparse
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+import horovod_tpu as hvd
+from horovod_tpu.ops.collectives import HVD_AXIS, ranked_allreduce
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--sizes-mb", type=float, nargs="+",
+                    default=[1, 4, 16, 64])
+    ap.add_argument("--iters", type=int, default=20)
+    ap.add_argument("--warmup", type=int, default=3)
+    args = ap.parse_args()
+
+    hvd.init()
+    n = hvd.size()
+    mesh = hvd.mesh()
+    print(f"# world: {n} chip(s), platform="
+          f"{jax.devices()[0].platform}")
+
+    for mb in args.sizes_mb:
+        elems = int(mb * 1024 * 1024 / 4)
+        # Per-chip payload of `elems` f32, stacked over the mesh.
+        x = jax.device_put(
+            np.ones((n, elems), np.float32),
+            NamedSharding(mesh, P(HVD_AXIS)))
+        for _ in range(args.warmup):
+            jax.block_until_ready(ranked_allreduce(x))
+        t0 = time.perf_counter()
+        for _ in range(args.iters):
+            out = ranked_allreduce(x)
+        jax.block_until_ready(out)
+        dt = (time.perf_counter() - t0) / args.iters
+        payload = elems * 4
+        bus_bytes = 2 * payload * (n - 1) / max(n, 1)
+        print(f"size={mb:8.1f} MB/chip  time={dt*1e3:8.3f} ms  "
+              f"busbw={bus_bytes/dt/1e9:8.2f} GB/s  "
+              f"alg_bw={payload/dt/1e9:8.2f} GB/s")
+
+
+if __name__ == "__main__":
+    main()

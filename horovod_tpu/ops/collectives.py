@@ -250,15 +250,20 @@ def ranked_alltoall(stacked):
 # mismatches as errors on EVERY process, like the broadcast ERROR response.
 # ---------------------------------------------------------------------------
 
-_FP_LEN = 16  # op, root, dtype-hash, ndim, dims[<=12]
+_FP_LEN = 16  # op, root, dtype-hash, ndim, dims[<=11], flags
 
 
 def consistency_checks_enabled() -> bool:
-    return bool(os.environ.get("HVD_CONSISTENCY_CHECKS")
-                or os.environ.get("HOROVOD_CONSISTENCY_CHECKS"))
+    """NOTE: the flag must be set uniformly on EVERY controller process —
+    the check itself is a collective, so partial enablement desynchronizes
+    the launch order (a hang, not an error). '0'/'false'/'off' disable."""
+    val = (os.environ.get("HVD_CONSISTENCY_CHECKS")
+           or os.environ.get("HOROVOD_CONSISTENCY_CHECKS") or "")
+    return val.lower() not in ("", "0", "false", "off")
 
 
-def _maybe_consistency_check(op_code: int, tensor, root: int = -1):
+def _maybe_consistency_check(op_code: int, tensor, root: int = -1,
+                             flags: int = 0):
     st = _topo._require_init()
     if not consistency_checks_enabled() or st.num_processes == 1:
         return
@@ -271,8 +276,9 @@ def _maybe_consistency_check(op_code: int, tensor, root: int = -1):
     fp[2] = zlib.crc32(str(jnp.asarray(tensor).dtype).encode()) % (2 ** 31)
     shape = jnp.asarray(tensor).shape
     fp[3] = len(shape)
-    for i, d in enumerate(shape[:12]):
+    for i, d in enumerate(shape[:11]):
         fp[4 + i] = d % (2 ** 31)
+    fp[15] = flags  # e.g. the allreduce average flag
     # Every local chip contributes this controller's fingerprint; the
     # gathered matrix is identical everywhere, so the error (or not) is
     # raised consistently on every process.
@@ -307,7 +313,7 @@ def allreduce(tensor, average: bool = True, name: Optional[str] = None):
         # psum(1, axis) constant-folds to the axis size at trace time.
         return _psum_avg(tensor, lax.psum(1, HVD_AXIS), average)
     tensor = jnp.asarray(tensor)
-    _maybe_consistency_check(0, tensor)
+    _maybe_consistency_check(0, tensor, flags=int(average))
     return ranked_allreduce(_replicated_stack(tensor), average=average)
 
 
@@ -324,7 +330,7 @@ def allgather(tensor, name: Optional[str] = None):
     if tensor.ndim == 0:
         raise ValueError("allgather requires a tensor with at least one dimension")
     # Allgather legitimately permits differing first dims; check the rest.
-    _maybe_consistency_check(1, tensor[:0] if tensor.shape[0] else tensor)
+    _maybe_consistency_check(1, tensor[:0])
     st = _topo._require_init()
     if st.num_processes == 1:
         return ranked_allgather(_replicated_stack(tensor))
