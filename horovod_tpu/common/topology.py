@@ -102,15 +102,32 @@ def init(ranks: Optional[Sequence[int]] = None, devices: Optional[Sequence] = No
 
         import jax
 
+        # Launcher-driven platform selection (horovod_tpu.run --cpu): the
+        # env var JAX_PLATFORMS alone can be preempted by pre-registered
+        # plugins, so apply it through jax.config while the backend is
+        # still uninitialized.
+        plat = os.environ.get("HVD_PLATFORM")
+        if plat:
+            try:
+                jax.config.update("jax_platforms", plat)
+            except Exception:
+                pass  # backend already up; leave the platform as-is
+
         # Multi-host: if the user (or launcher) provided coordination env,
         # bring up the JAX distributed client so jax.devices() is global.
+        # The already-initialized probe must NOT touch the backend
+        # (jax.process_count() would initialize it, after which
+        # distributed.initialize refuses to run), hence the client check.
         coord = os.environ.get("HVD_COORDINATOR_ADDRESS")
-        if coord and jax.process_count() == 1 and os.environ.get("HVD_NUM_PROCESSES"):
-            jax.distributed.initialize(
-                coordinator_address=coord,
-                num_processes=int(os.environ["HVD_NUM_PROCESSES"]),
-                process_id=int(os.environ.get("HVD_PROCESS_ID", "0")),
-            )
+        if coord and os.environ.get("HVD_NUM_PROCESSES"):
+            from jax._src import distributed as _jax_dist
+
+            if _jax_dist.global_state.client is None:
+                jax.distributed.initialize(
+                    coordinator_address=coord,
+                    num_processes=int(os.environ["HVD_NUM_PROCESSES"]),
+                    process_id=int(os.environ.get("HVD_PROCESS_ID", "0")),
+                )
 
         if devices is None:
             devices = list(jax.devices())

@@ -26,6 +26,7 @@ from horovod_tpu.models.resnet import (  # noqa: F401
     ResNet152,
 )
 from horovod_tpu.models.vgg import VGG16  # noqa: F401
+from horovod_tpu.models.inception import InceptionV3  # noqa: F401
 from horovod_tpu.models.mnist import MnistConvNet, MnistMLP  # noqa: F401
 from horovod_tpu.models.word2vec import Word2Vec  # noqa: F401
 from horovod_tpu.models.transformer import (  # noqa: F401
@@ -41,6 +42,8 @@ _REGISTRY = {
     "resnet101": ResNet101,
     "resnet152": ResNet152,
     "vgg16": VGG16,
+    "inceptionv3": InceptionV3,
+    "inception_v3": InceptionV3,
     "mnist_cnn": MnistConvNet,
     "mnist_mlp": MnistMLP,
 }

@@ -1,0 +1,159 @@
+"""Inception V3 — the third headline benchmark model.
+
+The reference's flagship scaling claim is 90% efficiency for Inception V3
+at 512 GPUs (reference: README.md:45-50, docs/benchmarks.md:1-7), with the
+model supplied by tf.keras.applications. Native flax implementation
+(Szegedy et al. 2015 v3 topology: factorized 7x1/1x7 convolutions, the
+A/B/C/D/E block family, aux head omitted — benchmarks train the main head).
+NHWC, bf16 compute, f32 params. ~23.8M parameters at 1000 classes.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Sequence, Tuple
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class ConvBN(nn.Module):
+    features: int
+    kernel: Tuple[int, int]
+    strides: Tuple[int, int] = (1, 1)
+    padding: Any = "SAME"
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        x = nn.Conv(self.features, self.kernel, self.strides,
+                    padding=self.padding, use_bias=False,
+                    dtype=self.dtype)(x)
+        x = nn.BatchNorm(use_running_average=not train, momentum=0.9,
+                         epsilon=1e-3, dtype=self.dtype)(x)
+        return nn.relu(x)
+
+
+def _pool_avg(x):
+    return nn.avg_pool(x, (3, 3), strides=(1, 1), padding="SAME")
+
+
+class InceptionA(nn.Module):
+    pool_features: int
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train=True):
+        c = partial(ConvBN, dtype=self.dtype)
+        b1 = c(64, (1, 1))(x, train)
+        b2 = c(64, (5, 5))(c(48, (1, 1))(x, train), train)
+        b3 = c(96, (3, 3))(c(96, (3, 3))(c(64, (1, 1))(x, train), train),
+                           train)
+        b4 = c(self.pool_features, (1, 1))(_pool_avg(x), train)
+        return jnp.concatenate([b1, b2, b3, b4], axis=-1)
+
+
+class InceptionB(nn.Module):
+    """Grid reduction 35x35 -> 17x17."""
+
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train=True):
+        c = partial(ConvBN, dtype=self.dtype)
+        b1 = c(384, (3, 3), (2, 2), "VALID")(x, train)
+        b2 = c(96, (3, 3), (2, 2), "VALID")(
+            c(96, (3, 3))(c(64, (1, 1))(x, train), train), train)
+        b3 = nn.max_pool(x, (3, 3), strides=(2, 2))
+        return jnp.concatenate([b1, b2, b3], axis=-1)
+
+
+class InceptionC(nn.Module):
+    """17x17 blocks with factorized 7x1/1x7 convolutions."""
+
+    channels_7x7: int
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train=True):
+        c = partial(ConvBN, dtype=self.dtype)
+        f = self.channels_7x7
+        b1 = c(192, (1, 1))(x, train)
+        b2 = x
+        for k in ((1, 1), (1, 7), (7, 1)):
+            feats = 192 if k == (7, 1) else f
+            b2 = c(feats, k)(b2, train)
+        b3 = x
+        for i, k in enumerate(((1, 1), (7, 1), (1, 7), (7, 1), (1, 7))):
+            feats = 192 if i == 4 else f
+            b3 = c(feats, k)(b3, train)
+        b4 = c(192, (1, 1))(_pool_avg(x), train)
+        return jnp.concatenate([b1, b2, b3, b4], axis=-1)
+
+
+class InceptionD(nn.Module):
+    """Grid reduction 17x17 -> 8x8."""
+
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train=True):
+        c = partial(ConvBN, dtype=self.dtype)
+        b1 = c(320, (3, 3), (2, 2), "VALID")(c(192, (1, 1))(x, train), train)
+        b2 = c(192, (1, 1))(x, train)
+        for k in ((1, 7), (7, 1)):
+            b2 = c(192, k)(b2, train)
+        b2 = c(192, (3, 3), (2, 2), "VALID")(b2, train)
+        b3 = nn.max_pool(x, (3, 3), strides=(2, 2))
+        return jnp.concatenate([b1, b2, b3], axis=-1)
+
+
+class InceptionE(nn.Module):
+    """8x8 blocks with split 1x3/3x1 branches."""
+
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train=True):
+        c = partial(ConvBN, dtype=self.dtype)
+        b1 = c(320, (1, 1))(x, train)
+        b2 = c(384, (1, 1))(x, train)
+        b2 = jnp.concatenate([c(384, (1, 3))(b2, train),
+                              c(384, (3, 1))(b2, train)], axis=-1)
+        b3 = c(448, (1, 1))(x, train)
+        b3 = c(384, (3, 3))(b3, train)
+        b3 = jnp.concatenate([c(384, (1, 3))(b3, train),
+                              c(384, (3, 1))(b3, train)], axis=-1)
+        b4 = c(192, (1, 1))(_pool_avg(x), train)
+        return jnp.concatenate([b1, b2, b3, b4], axis=-1)
+
+
+class InceptionV3(nn.Module):
+    num_classes: int = 1000
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        c = partial(ConvBN, dtype=self.dtype)
+        x = jnp.asarray(x, self.dtype)
+        x = c(32, (3, 3), (2, 2), "VALID")(x, train)
+        x = c(32, (3, 3), padding="VALID")(x, train)
+        x = c(64, (3, 3))(x, train)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2))
+        x = c(80, (1, 1), padding="VALID")(x, train)
+        x = c(192, (3, 3), padding="VALID")(x, train)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2))
+        x = InceptionA(32, self.dtype)(x, train)
+        x = InceptionA(64, self.dtype)(x, train)
+        x = InceptionA(64, self.dtype)(x, train)
+        x = InceptionB(self.dtype)(x, train)
+        x = InceptionC(128, self.dtype)(x, train)
+        x = InceptionC(160, self.dtype)(x, train)
+        x = InceptionC(160, self.dtype)(x, train)
+        x = InceptionC(192, self.dtype)(x, train)
+        x = InceptionD(self.dtype)(x, train)
+        x = InceptionE(self.dtype)(x, train)
+        x = InceptionE(self.dtype)(x, train)
+        x = jnp.mean(x, axis=(1, 2))
+        x = nn.Dropout(0.5, deterministic=not train)(x)
+        return nn.Dense(self.num_classes, dtype=jnp.float32)(x)

@@ -1,0 +1,107 @@
+"""Local multi-process launcher — the role ``mpirun`` plays for the
+reference (reference: docs/running.md tells users to invoke
+``mpirun -np N python train.py``; there is no launcher in-tree at v0.15.2).
+
+    python -m horovod_tpu.run -np 2 python train.py --epochs 1
+
+Spawns N controller processes wired together through ``jax.distributed``
+(coordinator on a free localhost port). On a CPU host each process gets
+``--ncpus-per-proc`` virtual chips so an N-process × M-chip world can be
+simulated exactly like the reference's single-host ``mpirun -np N`` test
+tier (SURVEY.md §4). On real multi-host TPU pods, prefer one process per
+host started by your scheduler; this launcher is for local runs and tests.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import socket
+import subprocess
+import sys
+import threading
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _stream(prefix: str, pipe, out):
+    for line in iter(pipe.readline, ""):
+        out.write(f"{prefix}{line}")
+        out.flush()
+    pipe.close()
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        prog="python -m horovod_tpu.run",
+        description="Launch N local horovod_tpu controller processes.")
+    ap.add_argument("-np", "--num-proc", type=int, required=True)
+    ap.add_argument("--ncpus-per-proc", type=int, default=4,
+                    help="virtual CPU chips per process (CPU simulation)")
+    ap.add_argument("--cpu", action="store_true", default=False,
+                    help="force the CPU platform (default: inherit)")
+    ap.add_argument("--tag-output", action="store_true", default=True)
+    ap.add_argument("command", nargs=argparse.REMAINDER,
+                    help="command to run, e.g. python train.py --epochs 1")
+    args = ap.parse_args(argv)
+    if not args.command:
+        ap.error("no command given")
+    cmd = args.command
+    if cmd[0] == "--":
+        cmd = cmd[1:]
+
+    port = _free_port()
+    procs = []
+    threads = []
+    for i in range(args.num_proc):
+        env = dict(os.environ)
+        env["HVD_COORDINATOR_ADDRESS"] = f"127.0.0.1:{port}"
+        env["HVD_NUM_PROCESSES"] = str(args.num_proc)
+        env["HVD_PROCESS_ID"] = str(i)
+        if args.cpu:
+            # HVD_PLATFORM is applied via jax.config inside hvd.init()
+            # (plain JAX_PLATFORMS can be preempted by plugins).
+            env["HVD_PLATFORM"] = "cpu"
+            env["JAX_PLATFORMS"] = "cpu"
+            env["XLA_FLAGS"] = (
+                env.get("XLA_FLAGS", "") +
+                f" --xla_force_host_platform_device_count="
+                f"{args.ncpus_per_proc}").strip()
+        p = subprocess.Popen(cmd, env=env, stdout=subprocess.PIPE,
+                             stderr=subprocess.STDOUT, text=True)
+        procs.append(p)
+        prefix = f"[{i}] " if args.tag_output else ""
+        t = threading.Thread(target=_stream, args=(prefix, p.stdout,
+                                                   sys.stdout), daemon=True)
+        t.start()
+        threads.append(t)
+
+    def _kill_all(signum=None, frame=None):
+        for p in procs:
+            if p.poll() is None:
+                p.terminate()
+
+    signal.signal(signal.SIGINT, _kill_all)
+    signal.signal(signal.SIGTERM, _kill_all)
+
+    rc = 0
+    for i, p in enumerate(procs):
+        code = p.wait()
+        if code != 0 and rc == 0:
+            rc = code
+            sys.stderr.write(
+                f"process {i} exited with code {code}; "
+                "terminating the remaining processes\n")
+            _kill_all()
+    for t in threads:
+        t.join(timeout=5)
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -71,6 +71,19 @@ def test_vgg16_param_count():
     assert 138e6 < n < 139e6, n  # the communication-bound headline model
 
 
+def test_inception_v3_param_count_and_shape():
+    """Inception V3 ImageNet: ~23.8M params (torchvision: 23.83M w/o aux);
+    299x299 input -> 8x8 final grid."""
+    m = models.InceptionV3(num_classes=1000, dtype=jnp.float32)
+    variables = m.init(jax.random.PRNGKey(0),
+                       jnp.zeros((1, 299, 299, 3)), False)
+    n = sum(int(np.prod(p.shape)) for p in
+            jax.tree_util.tree_leaves(variables["params"]))
+    assert 23.0e6 < n < 24.5e6, n
+    out = m.apply(variables, jnp.zeros((2, 299, 299, 3)), False)
+    assert out.shape == (2, 1000)
+
+
 def test_word2vec_loss_decreases():
     m = models.Word2Vec(vocab_size=100, embedding_dim=16)
     rng = jax.random.PRNGKey(0)

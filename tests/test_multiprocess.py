@@ -53,6 +53,34 @@ def test_two_process_collectives():
     _run_world("collectives")
 
 
+def test_launcher_spawns_world():
+    """python -m horovod_tpu.run -np 2 --cpu wires a 2-process world
+    (the mpirun role — reference: docs/running.md)."""
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ)
+    env.pop("JAX_PLATFORMS", None)
+    env.pop("XLA_FLAGS", None)
+    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+    worker = os.path.join(repo, "tests", "launcher_worker.py")
+    proc = subprocess.run(
+        [sys.executable, "-m", "horovod_tpu.run", "-np", "2", "--cpu",
+         "--", sys.executable, worker],
+        capture_output=True, text=True, timeout=240, env=env, cwd=repo)
+    assert proc.returncode == 0, proc.stdout[-3000:] + proc.stderr[-2000:]
+    assert proc.stdout.count("LAUNCHER TEST PASSED") == 2, proc.stdout
+
+
+def test_launcher_propagates_failure():
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ)
+    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+    proc = subprocess.run(
+        [sys.executable, "-m", "horovod_tpu.run", "-np", "2", "--cpu",
+         "--", sys.executable, "-c", "import sys; sys.exit(3)"],
+        capture_output=True, text=True, timeout=120, env=env, cwd=repo)
+    assert proc.returncode == 3, (proc.returncode, proc.stderr[-500:])
+
+
 def test_two_process_consistency_check_detects_mismatch():
     outs = _run_world("mismatch")
     for out in outs:
