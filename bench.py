@@ -189,6 +189,9 @@ def main():
     ncalls_warm = max(1, args.num_warmup_batches // spc)
     ncalls_iter = max(1, args.num_batches_per_iter // spc)
     batches_per_iter = ncalls_iter * spc
+    if batches_per_iter != args.num_batches_per_iter:
+        print(f"# note: window rounded to {batches_per_iter} batches "
+              f"(multiple of --steps-per-call {spc})", file=sys.stderr)
 
     loss = run_batches(ncalls_warm)
     assert np.isfinite(loss), f"diverged in warmup: {loss}"
@@ -207,6 +210,8 @@ def main():
         # Guard against a cost-analysis that multiplied by the scan trip
         # count (would make MFU read > 1 on a sane measurement).
         flops_per_step /= spc
+        print("# note: cost_analysis FLOPs exceeded chip peak; assuming it "
+              f"counted the scan body {spc}x and dividing", file=sys.stderr)
     mfu = (flops_per_step / step_time / peak
            ) if peak and flops_per_step else None
     result = {

@@ -308,6 +308,14 @@ class Engine:
 
     def _run_cycle(self):
         entries = self._drain()
+        if len(entries) > 1 and _multi_controller():
+            # Deterministic cross-controller execution order: with several
+            # controllers each eager collective is a global program launch,
+            # so every process must execute the same sequence. Multi-threaded
+            # enqueue makes arrival order process-local; name order is not.
+            # (Full agreement on batch composition comes from the negotiated
+            # path — see core/coordinator.py.)
+            entries.sort(key=lambda e: e.name)
         if entries and self._param_manager is not None:
             # One update per engine cycle with that cycle's traffic — the
             # manager's scoring window contract (parameter_manager.cc

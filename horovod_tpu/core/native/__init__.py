@@ -91,6 +91,8 @@ def load_library():
                                             ctypes.c_void_p]
     lib.hvd_engine_set_params.argtypes = [ctypes.c_void_p, ctypes.c_double,
                                           ctypes.c_longlong]
+    lib.hvd_engine_set_sort_by_name.argtypes = [ctypes.c_void_p,
+                                                ctypes.c_int]
     lib.hvd_alloc.restype = ctypes.c_void_p
     lib.hvd_alloc.argtypes = [ctypes.c_longlong]
     lib.hvd_engine_enqueue.restype = ctypes.c_longlong

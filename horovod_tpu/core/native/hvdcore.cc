@@ -19,6 +19,7 @@
 //  - stall watchdog (CheckForStalledTensors, operations.cc:1535-1581)
 //  - chrome-tracing timeline writer (common/timeline.cc)
 
+#include <algorithm>
 #include <atomic>
 #include <chrono>
 #include <condition_variable>
@@ -99,6 +100,34 @@ void* hvd_alloc(long long nbytes) { return malloc((size_t)nbytes); }
 // Timeline (reference: common/timeline.cc — rank-0 chrome tracing JSON)
 // ---------------------------------------------------------------------------
 
+// Tensor names are arbitrary user strings; escape them before interpolating
+// into the trace JSON (reference: timeline.cc writes via an escaping JSON
+// writer) or a quote/backslash would produce an unparseable file.
+std::string JsonEscape(const std::string& s) {
+  std::string out;
+  out.reserve(s.size() + 8);
+  for (unsigned char c : s) {
+    switch (c) {
+      case '"': out += "\\\""; break;
+      case '\\': out += "\\\\"; break;
+      case '\b': out += "\\b"; break;
+      case '\f': out += "\\f"; break;
+      case '\n': out += "\\n"; break;
+      case '\r': out += "\\r"; break;
+      case '\t': out += "\\t"; break;
+      default:
+        if (c < 0x20) {
+          char buf[8];
+          snprintf(buf, sizeof(buf), "\\u%04x", c);
+          out += buf;
+        } else {
+          out += (char)c;
+        }
+    }
+  }
+  return out;
+}
+
 class Timeline {
  public:
   void Initialize(const std::string& path) {
@@ -115,12 +144,14 @@ class Timeline {
   bool Active() const { return active_; }
 
   // Phase span per tensor lane (reference uses one chrome "pid" per tensor
-  // name — timeline.cc:60-96).
-  void Begin(const std::string& name, const char* phase) {
-    Emit(name, phase, 'B');
+  // name — timeline.cc:60-96). `args` is pre-rendered JSON object body
+  // (e.g. dtype/shape — reference: timeline.cc:98-188 WriteEvent args).
+  void Begin(const std::string& name, const char* phase,
+             const std::string& args = "") {
+    Emit(name, phase, 'B', args);
   }
   void End(const std::string& name, const char* phase) {
-    Emit(name, phase, 'E');
+    Emit(name, phase, 'E', "");
   }
 
   void Close() {
@@ -140,7 +171,8 @@ class Timeline {
     }
   }
 
-  void Emit(const std::string& name, const char* phase, char ph) {
+  void Emit(const std::string& name, const char* phase, char ph,
+            const std::string& args) {
     if (!active_) return;
     std::lock_guard<std::mutex> g(mu_);
     if (!active_) return;
@@ -152,13 +184,15 @@ class Timeline {
       lanes_[name] = pid;
       Sep();
       file_ << "{\"name\":\"process_name\",\"ph\":\"M\",\"pid\":" << pid
-            << ",\"args\":{\"name\":\"" << name << "\"}}";
+            << ",\"args\":{\"name\":\"" << JsonEscape(name) << "\"}}";
     } else {
       pid = it->second;
     }
     Sep();
     file_ << "{\"name\":\"" << phase << "\",\"ph\":\"" << ph
-          << "\",\"pid\":" << pid << ",\"ts\":" << ts << "}";
+          << "\",\"pid\":" << pid << ",\"ts\":" << ts;
+    if (!args.empty()) file_ << ",\"args\":{" << args << "}";
+    file_ << "}";
     // 1 s flush horizon like the reference (timeline.h:32).
     if (SecondsSince(last_flush_) > 1.0) {
       file_.flush();
@@ -173,6 +207,33 @@ class Timeline {
   bool active_ = false;
   bool first_ = true;
 };
+
+// Engine wire dtype names, by dtype_num code. MUST stay in sync with the
+// _DTYPES table in horovod_tpu/core/native_engine.py (the Python side
+// assigns the codes; this table only feeds timeline args).
+const char* DtypeName(int dtype_num) {
+  static const char* kNames[] = {
+      "float32",  "float64", "float16", "int8",       "uint8",
+      "int16",    "uint16",  "int32",   "uint32",     "int64",
+      "uint64",   "bool",    "complex64", "complex128", "bfloat16"};
+  if (dtype_num >= 0 && dtype_num < (int)(sizeof(kNames) / sizeof(*kNames)))
+    return kNames[dtype_num];
+  return "unknown";
+}
+
+// Pre-rendered args body for timeline events — dtype + shape, the detail
+// the reference writer records (timeline.cc:98-188).
+std::string TensorArgs(int dtype_num, const std::vector<long long>& shape) {
+  std::string out = "\"dtype\": \"";
+  out += DtypeName(dtype_num);
+  out += "\", \"shape\": [";
+  for (size_t i = 0; i < shape.size(); ++i) {
+    if (i) out += ", ";
+    out += std::to_string(shape[i]);
+  }
+  out += "]";
+  return out;
+}
 
 // ---------------------------------------------------------------------------
 // Engine
@@ -228,6 +289,15 @@ class Engine {
     std::lock_guard<std::mutex> g(mu_);
     if (cycle_s > 0) cycle_s_ = cycle_s;
     if (fusion_bytes >= 0) fusion_bytes_ = fusion_bytes;
+  }
+
+  // Deterministic cross-controller execution order: sort each drained
+  // cycle by tensor name before executing, so multi-controller processes
+  // with thread-racy enqueue order still launch collectives in one agreed
+  // sequence (full batch agreement comes from the negotiated path).
+  void SetSortByName(int on) {
+    std::lock_guard<std::mutex> g(mu_);
+    sort_by_name_ = on != 0;
   }
 
   long long Enqueue(int op, const char* name, int dtype_num, int itemsize,
@@ -381,10 +451,17 @@ class Engine {
   // singly, in order.
   void RunCycle(std::deque<Entry>& entries) {
     long long fusion_limit;
+    bool sort_by_name;
     {
       std::lock_guard<std::mutex> g(mu_);
       fusion_limit = fusion_bytes_;
+      sort_by_name = sort_by_name_;
     }
+    if (sort_by_name && entries.size() > 1)
+      std::stable_sort(entries.begin(), entries.end(),
+                       [](const Entry& a, const Entry& b) {
+                         return a.name < b.name;
+                       });
     std::vector<Entry*> fuse;
     long long fuse_bytes = 0;
     long long cycle_bytes = 0;
@@ -469,7 +546,9 @@ class Engine {
     req.shape[0] = total;
     hvd_result res{};
     if (timeline_.Active())
-      for (auto* e : batch) timeline_.Begin(e->name, "ALLREDUCE");
+      for (auto* e : batch)
+        timeline_.Begin(e->name, "ALLREDUCE",
+                        TensorArgs(e->dtype_num, e->shape));
     int rc = CallExecutor(&req, &res);
     if (timeline_.Active())
       for (auto* e : batch) timeline_.End(e->name, "ALLREDUCE");
@@ -508,7 +587,8 @@ class Engine {
       req.shape[i] = e.shape[i];
     const char* phase = e.op == HVD_ALLGATHER ? "ALLGATHER" : "BROADCAST";
     hvd_result res{};
-    if (timeline_.Active()) timeline_.Begin(e.name, phase);
+    if (timeline_.Active())
+      timeline_.Begin(e.name, phase, TensorArgs(e.dtype_num, e.shape));
     int rc = CallExecutor(&req, &res);
     if (timeline_.Active()) timeline_.End(e.name, phase);
     if (rc != 0) {
@@ -597,6 +677,7 @@ class Engine {
   std::unordered_map<long long, std::shared_ptr<HandleState>> handles_;
   long long next_handle_ = 0;
   bool shutdown_ = false;
+  bool sort_by_name_ = false;
   hvd_exec_fn exec_fn_ = nullptr;
   void* exec_ctx_ = nullptr;
 
@@ -622,6 +703,10 @@ void hvd_engine_set_executor(void* e, hvd_exec_fn fn, void* ctx) {
 
 void hvd_engine_set_params(void* e, double cycle_s, long long fusion_bytes) {
   static_cast<Engine*>(e)->SetParams(cycle_s, fusion_bytes);
+}
+
+void hvd_engine_set_sort_by_name(void* e, int on) {
+  static_cast<Engine*>(e)->SetSortByName(on);
 }
 
 long long hvd_engine_enqueue(void* e, int op, const char* name, int dtype_num,

@@ -128,6 +128,11 @@ class NativeEngine:
             float(self.cycle_time_s), int(self.fusion_threshold),
             float(stall_warning_s), timeline_path.encode())
         self._lib.hvd_engine_set_executor(self._ptr, self._cb, None)
+        # Deterministic multi-controller ordering (same rule as the python
+        # twin's _run_cycle sort); re-evaluated in set_params since topology
+        # may come up after engine construction.
+        self._lib.hvd_engine_set_sort_by_name(
+            self._ptr, int(_multi_controller()))
         self._meta: dict = {}  # handle -> np.dtype (for result decode)
 
         # Autotuner: the C++ loop reports per-cycle traffic through TICK
@@ -207,6 +212,8 @@ class NativeEngine:
         """Live parameter updates (the autotuner drives this)."""
         if self._ptr is None:
             return
+        if _multi_controller():
+            self._lib.hvd_engine_set_sort_by_name(self._ptr, 1)
         if fusion_threshold is not None and _multi_controller():
             # Multi-controller fusion stays off even if topology came up
             # after engine construction (see engine.config_from_env).

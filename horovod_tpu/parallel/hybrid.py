@@ -200,11 +200,22 @@ def build_train_step(mesh: Mesh, cfg: HybridConfig):
                 params["stage"], micro, "pp")
             out = out.reshape((b_local, s_local, cfg.hidden_dim))
             logits = (out @ params["head"]).astype(jnp.float32)
-            # Next-token prediction within the local sequence shard.
-            tgt = jnp.roll(tokens, -1, axis=1)
+            # Next-token prediction. The target for the last position of
+            # each sp shard is the NEXT shard's first token, fetched over
+            # ICI via ppermute (shard j sends its first column to shard
+            # j-1); the global last position has no next token and is
+            # masked out of the loss.
+            nxt_first = lax.ppermute(
+                tokens[:, :1], "sp",
+                [(j, (j - 1) % sp) for j in range(sp)])
+            tgt = jnp.concatenate([tokens[:, 1:], nxt_first], axis=1)
             ll = jax.nn.log_softmax(logits)
-            loss = -jnp.take_along_axis(ll, tgt[..., None], axis=-1).mean()
-            return lax.pmean(loss, ("dp", "sp"))
+            tok_loss = -jnp.take_along_axis(ll, tgt[..., None], axis=-1)[..., 0]
+            pos_ids = sp_idx * s_local + jnp.arange(s_local)
+            mask = (pos_ids < cfg.seq_len - 1).astype(tok_loss.dtype)
+            num = lax.psum((tok_loss * mask[None, :]).sum(), ("dp", "sp"))
+            den = lax.psum(jnp.float32(b_local) * mask.sum(), ("dp", "sp"))
+            return num / den
 
         def reduce_grads(g):
             # Stage/head: replicated over dp+sp -> pmean. Embeddings feed

@@ -21,6 +21,7 @@ import socket
 import subprocess
 import sys
 import threading
+import time
 
 
 def _free_port() -> int:
@@ -89,15 +90,24 @@ def main(argv=None):
     signal.signal(signal.SIGINT, _kill_all)
     signal.signal(signal.SIGTERM, _kill_all)
 
+    # Poll ALL children each tick (mpirun semantics: first failure tears
+    # down the whole job). A sequential wait() would never observe a
+    # higher-index child dying while process 0 blocks in a collective.
     rc = 0
-    for i, p in enumerate(procs):
-        code = p.wait()
-        if code != 0 and rc == 0:
-            rc = code
-            sys.stderr.write(
-                f"process {i} exited with code {code}; "
-                "terminating the remaining processes\n")
-            _kill_all()
+    pending = set(range(len(procs)))
+    while pending:
+        exited = [i for i in pending if procs[i].poll() is not None]
+        for i in exited:
+            pending.discard(i)
+            code = procs[i].returncode
+            if code != 0 and rc == 0:
+                rc = code
+                sys.stderr.write(
+                    f"process {i} exited with code {code}; "
+                    "terminating the remaining processes\n")
+                _kill_all()
+        if pending and not exited:
+            time.sleep(0.05)
     for t in threads:
         t.join(timeout=5)
     return rc
