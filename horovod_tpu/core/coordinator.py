@@ -1,0 +1,489 @@
+"""Cross-controller negotiation — the TPU-native coordinator protocol.
+
+The reference coordinates multi-process collectives through a rank-0
+master: every tick workers ``MPI_Gather`` their pending-request lists to
+rank 0, which validates them, decides readiness + fusion, and
+``MPI_Bcast``s a response all ranks then execute (reference:
+horovod/common/operations.cc:279-517 ConstructMPIResponse, fusion decision
+:2035-2074). On TPU there is no MPI; the idiomatic control plane is the
+key-value store of the JAX coordination service (``jax.distributed``),
+which every multi-controller run already stands up.
+
+Protocol (one *round* per engine cycle, symmetric — no master):
+
+1. Every process publishes ``<ns>/r<N>/p<pid>`` = JSON of its pending
+   request metadata (name, op, dtype, shape, flags). Process 0's message
+   additionally carries the engine params (cycle time, fusion threshold)
+   — the role ParameterManager::SyncParams plays in the reference.
+2. Every process reads all P round-``N`` keys (blocking, timeout).
+3. Every process computes the SAME decision with a pure function of the
+   identical inputs: a tensor is *ready* when every process announced it;
+   announced-by-all tensors with mismatched fingerprints become error
+   groups (the reference's ERROR response — surfaced on every process);
+   ready tensors execute in lexicographic name order, allreduces fused
+   per (dtype, average, prescale) up to the agreed threshold.
+
+Rank-0 decision-making is unnecessary because the KV store gives every
+process the same inputs — determinism replaces the broadcast. Entries not
+yet announced everywhere simply stay pending for the next round, which is
+also what powers missing-rank stall attribution (reference:
+CheckForStalledTensors, operations.cc:1535-1581): every round each process
+sees exactly who has NOT yet submitted a stalled tensor.
+
+Cleanup: a process deletes its round-``N-1`` key after completing round
+``N`` reads (everyone publishing round ``N`` proves round ``N-1`` was
+fully consumed). Shutdown publishes a tombstone key peers poll while
+blocked, so a clean exit propagates as ``ShutdownError`` instead of a
+hang (reference: shutdown flag in MPIRequestList, operations.cc:2008-2011).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import threading
+import time
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Sequence, Tuple
+
+LOG = logging.getLogger("horovod_tpu.coordinator")
+
+_POLL_SLICE_S = 0.5  # granularity of tombstone checks while blocked
+_IDLE_BACKOFF_CAP_S = 0.1  # max stretch between all-idle rounds
+
+OPS = ("allreduce", "allgather", "broadcast")
+
+
+def negotiation_enabled() -> bool:
+    """HVD_NEGOTIATION=0 disables the protocol (multi-controller runs then
+    fall back to unfused, name-ordered execution)."""
+    val = (os.environ.get("HVD_NEGOTIATION")
+           or os.environ.get("HOROVOD_NEGOTIATION") or "1")
+    return val.lower() not in ("0", "false", "off")
+
+
+def negotiation_timeout_s() -> float:
+    return float(os.environ.get("HVD_NEGOTIATION_TIMEOUT", "600"))
+
+
+class KVTimeout(Exception):
+    pass
+
+
+class KVError(Exception):
+    pass
+
+
+class PeerShutdown(Exception):
+    def __init__(self, process: int):
+        super().__init__(f"process {process} shut down during negotiation")
+        self.process = process
+
+
+class NegotiationTimeout(Exception):
+    def __init__(self, process: int, waited_s: float):
+        super().__init__(
+            f"negotiation timed out after {waited_s:.0f}s waiting for "
+            f"process {process}; it may have crashed or stopped its engine")
+        self.process = process
+
+
+class JaxKV:
+    """KV backend over the JAX coordination service."""
+
+    def __init__(self):
+        from jax._src import distributed
+
+        client = distributed.global_state.client
+        if client is None:
+            raise KVError("jax.distributed is not initialized")
+        self._client = client
+
+    def set(self, key: str, value: str):
+        try:
+            self._client.key_value_set(key, value)
+        except Exception as exc:
+            raise KVError(str(exc)) from None
+
+    def get(self, key: str, timeout_s: float) -> str:
+        try:
+            return self._client.blocking_key_value_get(
+                key, max(1, int(timeout_s * 1000)))
+        except Exception as exc:  # DEADLINE_EXCEEDED / connection errors
+            msg = str(exc)
+            if "DEADLINE_EXCEEDED" in msg or "deadline" in msg.lower():
+                raise KVTimeout(key) from None
+            raise KVError(msg) from None
+
+    def try_get(self, key: str) -> Optional[str]:
+        try:
+            return self._client.key_value_try_get(key)
+        except Exception:
+            return None
+
+    def delete(self, key: str):
+        try:
+            self._client.key_value_delete(key)
+        except Exception:
+            pass  # cleanup is best-effort
+
+
+class LocalKV:
+    """In-memory KV shared by instances created from the same ``store``
+    dict — lets unit tests run N coordinators on N threads."""
+
+    def __init__(self, store: dict, cond: Optional[threading.Condition] = None):
+        self._store = store
+        self._cond = cond or store.setdefault(
+            "__cond__", threading.Condition())
+
+    def set(self, key: str, value: str):
+        with self._cond:
+            self._store[key] = value
+            self._cond.notify_all()
+
+    def get(self, key: str, timeout_s: float) -> str:
+        deadline = time.monotonic() + timeout_s
+        with self._cond:
+            while key not in self._store:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    raise KVTimeout(key)
+                self._cond.wait(remaining)
+            return self._store[key]
+
+    def try_get(self, key: str) -> Optional[str]:
+        with self._cond:
+            return self._store.get(key)
+
+    def delete(self, key: str):
+        with self._cond:
+            self._store.pop(key, None)
+
+
+@dataclass(frozen=True)
+class RequestMeta:
+    """One pending collective's identity — the MPIRequest analogue
+    (reference: common/mpi_message.h:44-95)."""
+
+    name: str
+    op: str
+    dtype: str
+    itemsize: int
+    shape: Tuple[int, ...]
+    average: bool = False
+    root_rank: int = 0
+    prescale: float = 1.0
+    age_s: float = 0.0
+    nbytes: int = 0
+
+    def wire(self) -> list:
+        return [self.name, self.op, self.dtype, self.itemsize,
+                list(self.shape), int(self.average), self.root_rank,
+                self.prescale, round(self.age_s, 3), self.nbytes]
+
+    @staticmethod
+    def from_wire(w: list) -> "RequestMeta":
+        return RequestMeta(name=w[0], op=w[1], dtype=w[2], itemsize=w[3],
+                           shape=tuple(w[4]), average=bool(w[5]),
+                           root_rank=w[6], prescale=w[7], age_s=w[8],
+                           nbytes=w[9])
+
+
+@dataclass
+class Group:
+    """One decided execution unit: indices into the local entry list.
+    ``error`` set => complete those entries with that error instead."""
+
+    indices: List[int]
+    error: Optional[str] = None
+
+
+@dataclass
+class Decision:
+    groups: List[Group]
+    cycle_time_s: Optional[float] = None
+    fusion_threshold: Optional[int] = None
+    idle_backoff_s: float = 0.0
+
+
+def _fingerprint(m: RequestMeta):
+    """Identity that must agree across processes for one tensor name.
+    Allgather legitimately permits differing first dims (reference:
+    MPI_Allgatherv sizes, operations.cc:810-857)."""
+    shape = m.shape[1:] if m.op == "allgather" else m.shape
+    dim0 = ("*",) if m.op == "allgather" else ()
+    return (m.op, m.dtype, m.itemsize, dim0 + tuple(shape), m.average,
+            m.root_rank, m.prescale)
+
+
+def _mismatch_message(name: str, metas: Dict[int, RequestMeta]) -> str:
+    """Reference-style coordinator error (operations.cc:315-517 builds
+    'Mismatched ...' ERROR responses)."""
+    pids = sorted(metas)
+    a = metas[pids[0]]
+    for pid in pids[1:]:
+        b = metas[pid]
+        if a.op != b.op:
+            field, va, vb = "collective operations", a.op, b.op
+        elif a.dtype != b.dtype or a.itemsize != b.itemsize:
+            field, va, vb = "data types", a.dtype, b.dtype
+        elif a.root_rank != b.root_rank:
+            field, va, vb = "root ranks", a.root_rank, b.root_rank
+        elif a.average != b.average or a.prescale != b.prescale:
+            field, va, vb = ("reduction options",
+                             (a.average, a.prescale), (b.average, b.prescale))
+        else:
+            field, va, vb = "tensor shapes", list(a.shape), list(b.shape)
+        return (f"Mismatched {field} for collective '{name}': process "
+                f"{pids[0]} submitted {va}, process {pid} submitted {vb}. "
+                "All processes must submit identical collectives for the "
+                "same tensor name.")
+    return f"Mismatched collective '{name}'"
+
+
+def decide(tables: Dict[int, List[RequestMeta]], my_entries: Sequence[RequestMeta],
+           fusion_threshold: int) -> List[Group]:
+    """The pure decision function — MUST be deterministic in its inputs,
+    since every process computes it independently on identical inputs
+    (the role of rank 0 + MPI_Bcast in the reference)."""
+    by_name: Dict[str, Dict[int, RequestMeta]] = {}
+    for pid, metas in tables.items():
+        for m in metas:
+            by_name.setdefault(m.name, {})[pid] = m
+    nproc = len(tables)
+    local_index = {m.name: i for i, m in enumerate(my_entries)}
+
+    ready, errors = [], {}
+    for name in sorted(by_name):
+        metas = by_name[name]
+        if len(metas) < nproc or name not in local_index:
+            continue  # not announced everywhere yet — stays pending
+        fps = {_fingerprint(m) for m in metas.values()}
+        if len(fps) > 1:
+            errors[name] = _mismatch_message(name, metas)
+        else:
+            ready.append(metas[0] if 0 in metas else next(iter(metas.values())))
+
+    groups: List[Group] = []
+    open_groups: Dict[tuple, Group] = {}
+    open_bytes: Dict[tuple, int] = {}
+    for m in sorted(ready, key=lambda m: m.name):
+        idx = local_index[m.name]
+        if m.op != "allreduce" or fusion_threshold <= 0:
+            groups.append(Group([idx]))
+            continue
+        key = (m.dtype, m.average, m.prescale)
+        g = open_groups.get(key)
+        if g is not None and open_bytes[key] + m.nbytes <= fusion_threshold:
+            g.indices.append(idx)
+            open_bytes[key] += m.nbytes
+        else:
+            g = Group([idx])
+            open_groups[key] = g
+            open_bytes[key] = m.nbytes
+            groups.append(g)
+    for name in sorted(errors):
+        groups.append(Group([local_index[name]], errors[name]))
+    return groups
+
+
+class Coordinator:
+    """Per-engine negotiation endpoint. NOT thread-safe: exactly one
+    thread (the engine's dispatch loop) drives ``negotiate``."""
+
+    def __init__(self, kv, num_processes: int, process_index: int,
+                 cycle_time_s: float, fusion_threshold: int,
+                 stall_warning_s: float = 60.0,
+                 timeout_s: Optional[float] = None,
+                 namespace: str = "hvd/neg/g0"):
+        self.kv = kv
+        self.nproc = num_processes
+        self.pid = process_index
+        self.cycle_time_s = cycle_time_s
+        self.fusion_threshold = fusion_threshold
+        self.stall_warning_s = stall_warning_s
+        self.timeout_s = (negotiation_timeout_s()
+                          if timeout_s is None else timeout_s)
+        self.ns = namespace
+        self.round = 0
+        self.dead: Optional[str] = None  # poisoned: message to fail with
+        self.idle_rounds = 0
+        self.waiting_on: Optional[int] = None  # peer a blocked read awaits
+        self.last_tables: Dict[int, set] = {}
+        self._last_stall_warn = 0.0
+        self._closed = False
+
+    # -- keys ---------------------------------------------------------------
+
+    def _round_key(self, rnd: int, pid: int) -> str:
+        return f"{self.ns}/r{rnd}/p{pid}"
+
+    def _tomb_key(self, pid: int) -> str:
+        return f"{self.ns}/dead/p{pid}"
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def close(self):
+        """Publish the shutdown tombstone (peers blocked on our next round
+        key discover it between poll slices)."""
+        if self._closed:
+            return
+        self._closed = True
+        try:
+            self.kv.set(self._tomb_key(self.pid), str(self.round))
+        except Exception:
+            pass  # coordination service may already be down at exit
+
+    # -- the round ----------------------------------------------------------
+
+    def _read_peer(self, rnd: int, peer: int) -> dict:
+        deadline = time.monotonic() + self.timeout_s
+        self.waiting_on = peer
+        try:
+            while True:
+                if self._closed:
+                    # Local shutdown while blocked on a silent peer (e.g.
+                    # it was SIGKILLed without a tombstone): abort the
+                    # round so engine teardown is not held hostage for the
+                    # full negotiation timeout.
+                    raise KVError("local engine is shutting down")
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    raise NegotiationTimeout(peer, self.timeout_s)
+                try:
+                    raw = self.kv.get(self._round_key(rnd, peer),
+                                      min(_POLL_SLICE_S, remaining))
+                    return json.loads(raw)
+                except KVTimeout:
+                    if self.kv.try_get(self._tomb_key(peer)) is not None:
+                        raise PeerShutdown(peer) from None
+        finally:
+            self.waiting_on = None
+
+    def negotiate(self, entries: Sequence[RequestMeta]) -> Decision:
+        """Run one round. Raises PeerShutdown / NegotiationTimeout /
+        KVError — callers fail their pending entries and poison the
+        engine's negotiated path."""
+        if self.dead:
+            raise KVError(self.dead)
+        rnd = self.round
+        msg = {"entries": [m.wire() for m in entries]}
+        if self.pid == 0:
+            msg["params"] = [self.cycle_time_s, self.fusion_threshold]
+        try:
+            self.kv.set(self._round_key(rnd, self.pid), json.dumps(msg))
+        except KVError as exc:
+            self.dead = str(exc)
+            self.close()  # tombstone: let peers fail fast, not time out
+            raise
+
+        tables: Dict[int, List[RequestMeta]] = {
+            self.pid: list(entries)}
+        params = msg.get("params")
+        try:
+            for peer in range(self.nproc):
+                if peer == self.pid:
+                    continue
+                peer_msg = self._read_peer(rnd, peer)
+                tables[peer] = [RequestMeta.from_wire(w)
+                                for w in peer_msg.get("entries", [])]
+                if peer == 0:
+                    params = peer_msg.get("params")
+        except (PeerShutdown, NegotiationTimeout, KVError) as exc:
+            self.dead = str(exc)
+            # We will never publish another round: tombstone so peers
+            # blocked on OUR next message fail fast instead of waiting
+            # out the full negotiation timeout.
+            self.close()
+            raise
+        self.round = rnd + 1
+        # Everyone has published round `rnd`, so round `rnd-1` keys are
+        # fully consumed — reclaim ours.
+        if rnd > 0:
+            self.kv.delete(self._round_key(rnd - 1, self.pid))
+
+        cycle_s, fusion = (params if params else
+                           (self.cycle_time_s, self.fusion_threshold))
+        self.cycle_time_s, self.fusion_threshold = cycle_s, int(fusion)
+        groups = decide(tables, entries, int(fusion))
+        self.last_tables = {pid: {m.name for m in metas}
+                            for pid, metas in tables.items()}
+        total = sum(len(t) for t in tables.values())
+        self.idle_rounds = self.idle_rounds + 1 if total == 0 else 0
+        backoff = 0.0
+        if self.idle_rounds:
+            backoff = min(cycle_s * (2 ** min(self.idle_rounds, 10)),
+                          _IDLE_BACKOFF_CAP_S)
+        self._maybe_warn_stalls(entries)
+        return Decision(groups=groups, cycle_time_s=cycle_s,
+                        fusion_threshold=int(fusion),
+                        idle_backoff_s=backoff)
+
+    # -- stall attribution (reference: CheckForStalledTensors,
+    # operations.cc:1535-1581 — names the ranks holding up each tensor) ----
+
+    def missing_processes(self, name: str) -> List[int]:
+        if not self.last_tables:
+            return []
+        return [p for p in range(self.nproc)
+                if name not in self.last_tables.get(p, set())]
+
+    def _maybe_warn_stalls(self, entries: Sequence[RequestMeta]):
+        if self.stall_warning_s <= 0:
+            return
+        now = time.monotonic()
+        if now - self._last_stall_warn < self.stall_warning_s:
+            return
+        lines = []
+        for m in entries:
+            if m.age_s <= self.stall_warning_s:
+                continue
+            missing = self.missing_processes(m.name)
+            if missing:
+                lines.append(f"{m.name} [missing from process(es): "
+                             f"{', '.join(map(str, missing))}]")
+        if lines:
+            self._last_stall_warn = now
+            LOG.warning(
+                "One or more tensors were submitted to be reduced, gathered "
+                "or broadcast by a subset of processes and are waiting for "
+                "the remainder for more than %ds: %s",
+                int(self.stall_warning_s), "; ".join(lines))
+
+
+# Engine generation counter: each engine shutdown/re-init cycle gets a
+# fresh KV namespace, so a new incarnation never consumes the previous
+# one's tombstone or final-round keys. Engine lifecycle must be COLLECTIVE
+# across processes (every process inits/shuts down the same number of
+# times) — the same contract MPI_Init/Finalize imposes on the reference.
+_generation = 0
+
+
+def make_coordinator(cycle_time_s: float, fusion_threshold: int,
+                     stall_warning_s: float,
+                     warn_stalls: bool = True) -> Optional[Coordinator]:
+    """Build a Coordinator for the current topology, or None when the run
+    is single-controller / negotiation is disabled / no KV service."""
+    global _generation
+
+    from horovod_tpu.common import topology as topo
+
+    if not (topo.is_initialized() and topo.num_processes() > 1):
+        return None
+    if not negotiation_enabled():
+        return None
+    try:
+        kv = JaxKV()
+    except KVError:
+        LOG.warning("multi-controller run without a jax.distributed "
+                    "coordination service; negotiation disabled (fusion "
+                    "stays off)")
+        return None
+    gen = _generation
+    _generation += 1
+    return Coordinator(kv, topo.num_processes(), topo.process_index(),
+                       cycle_time_s, fusion_threshold,
+                       stall_warning_s if warn_stalls else 0.0,
+                       namespace=f"hvd/neg/g{gen}")

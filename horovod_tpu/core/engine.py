@@ -125,14 +125,31 @@ def _multi_controller() -> bool:
     decisions are local to a controller; with several controllers, local
     drain timing could fuse different batches on different processes and
     launch mismatched collective programs — the failure the reference's
-    rank-0 negotiation exists to prevent (operations.cc:279-517). Until
-    negotiation lands, multi-process runs execute one deterministic
-    collective per tensor."""
+    rank-0 negotiation exists to prevent (operations.cc:279-517). The
+    negotiated path (core/coordinator.py) makes batch composition agreed;
+    without it, multi-process runs execute one name-ordered collective
+    per tensor."""
     try:
         from horovod_tpu.common import topology as _topo
 
         return _topo.is_initialized() and _topo.num_processes() > 1
     except Exception:
+        return False
+
+
+def _negotiated() -> bool:
+    """True when multi-controller runs will coordinate batches through the
+    KV-store negotiation protocol (so fusion/autotune may stay enabled)."""
+    if not _multi_controller():
+        return False
+    from horovod_tpu.core import coordinator as _coord
+
+    if not _coord.negotiation_enabled():
+        return False
+    try:
+        _coord.JaxKV()
+        return True
+    except _coord.KVError:
         return False
 
 
@@ -150,8 +167,12 @@ def config_from_env(cycle_time_s: Optional[float],
         b = os.environ.get("HVD_FUSION_THRESHOLD") or os.environ.get(
             "HOROVOD_FUSION_THRESHOLD")
         fusion_threshold = int(b) if b else DEFAULT_FUSION_THRESHOLD
-    if _multi_controller():
+    if _multi_controller() and not _negotiated():
         fusion_threshold = 0
+    st = os.environ.get("HVD_STALL_CHECK_TIME") or os.environ.get(
+        "HOROVOD_STALL_CHECK_TIME")
+    if st:  # seconds; reference hardcodes 60 (operations.cc:253)
+        stall_warning_s = float(st)
     if os.environ.get("HVD_STALL_CHECK_DISABLE") or os.environ.get(
             "HOROVOD_STALL_CHECK_DISABLE"):
         stall_warning_s = 0.0
@@ -160,13 +181,21 @@ def config_from_env(cycle_time_s: Optional[float],
 
 def make_autotuner(engine):
     """Shared autotuner construction (reference: HOROVOD_AUTOTUNE,
-    operations.cc:1797-1804). Returns a ParameterManager or None; tuning
-    is gated to single-controller worlds (see _multi_controller). Failures
-    are reported, not silently swallowed, and never take the engine down."""
+    operations.cc:1797-1804). Returns a ParameterManager or None. In
+    multi-controller worlds tuning runs on process 0 only and propagates
+    through the negotiation round params, mirroring the reference where
+    rank 0 tunes and broadcasts (parameter_manager.cc:63-77,203-236);
+    without negotiation it stays off. Failures are reported, not silently
+    swallowed, and never take the engine down."""
     from horovod_tpu.tune import ParameterManager, autotune_enabled
 
-    if not autotune_enabled() or _multi_controller():
+    if not autotune_enabled():
         return None
+    if _multi_controller():
+        from horovod_tpu.common import topology as _topo
+
+        if not _negotiated() or _topo.process_index() != 0:
+            return None
     try:
         return ParameterManager(engine)
     except Exception as exc:
@@ -198,6 +227,12 @@ class Engine:
         self._next_handle = 0
         self._shutdown = threading.Event()
         self._last_stall_warn = 0.0
+        # Negotiated multi-controller path (core/coordinator.py): entries
+        # drained but not yet agreed with the peer processes.
+        self._coordinator = None
+        self._coord_unavailable = False
+        self._negotiating: list = []
+        self._extra_wait = 0.0
         self._thread = threading.Thread(
             target=self._loop, name="hvd-background", daemon=True
         )
@@ -277,7 +312,11 @@ class Engine:
             start = time.monotonic()
             self._run_cycle()
             elapsed = time.monotonic() - start
-            sleep = self.cycle_time_s - elapsed
+            # idle-round backoff keeps all-quiet negotiation rounds from
+            # hammering the coordination service (identical on every
+            # process, so rounds stay in lockstep).
+            sleep = self.cycle_time_s - elapsed + self._extra_wait
+            self._extra_wait = 0.0
             if sleep > 0:
                 self._shutdown.wait(sleep)
         # Fail whatever is left (reference: operations.cc:1833-1848).
@@ -297,24 +336,116 @@ class Engine:
 
     def set_params(self, cycle_time_s: Optional[float] = None,
                    fusion_threshold: Optional[int] = None):
-        """Live parameter updates (the autotuner drives this)."""
+        """Live parameter updates (the autotuner drives this). In a
+        negotiated multi-controller world, process 0's values propagate to
+        every process through the round params (coordinator.negotiate)."""
         if cycle_time_s is not None and cycle_time_s > 0:
             self.cycle_time_s = cycle_time_s
         if fusion_threshold is not None and fusion_threshold >= 0:
-            # The multi-controller invariant holds even if topology came up
-            # after engine construction: fusion stays off.
-            self.fusion_threshold = 0 if _multi_controller() \
-                else fusion_threshold
+            # Without negotiation, the multi-controller invariant holds
+            # even if topology came up after engine construction: fusion
+            # stays off.
+            self.fusion_threshold = 0 if (
+                _multi_controller() and not _negotiated()
+            ) else fusion_threshold
+        if self._coordinator is not None:
+            self._coordinator.cycle_time_s = self.cycle_time_s
+            self._coordinator.fusion_threshold = self.fusion_threshold
+
+    def _maybe_build_coordinator(self):
+        """Lazily stand up negotiation once topology is known (the engine
+        may be constructed before hvd.init())."""
+        if self._coordinator is not None or self._coord_unavailable:
+            return
+        if not _multi_controller():
+            return
+        from horovod_tpu.core import coordinator as coord
+
+        # warn_stalls=False: this engine's own watchdog thread already
+        # attributes stalls via coordinator.missing_processes — a second
+        # warning from inside negotiate() would be a duplicate.
+        self._coordinator = coord.make_coordinator(
+            self.cycle_time_s, self.fusion_threshold,
+            0.0 if self.stall_check_disabled else self.stall_warning_s,
+            warn_stalls=False)
+        if self._coordinator is None:
+            # Fall back to the unfused, name-ordered local path for good.
+            self._coord_unavailable = True
+            self.fusion_threshold = 0
+
+    def _negotiated_cycle(self, entries):
+        """One negotiation round: agree on batch composition with every
+        peer process, then execute exactly the agreed groups (the role of
+        the reference's RunLoopOnce negotiation half,
+        operations.cc:1921-2172)."""
+        from horovod_tpu.core import coordinator as coord
+
+        for e in entries:
+            self.timeline.start(e.name, f"NEGOTIATE_{e.op.upper()}")
+        self._negotiating.extend(entries)
+        c = self._coordinator
+        now = time.monotonic()
+        metas = [
+            coord.RequestMeta(
+                name=e.name, op=e.op, dtype=str(e.tensor.dtype),
+                itemsize=e.tensor.dtype.itemsize,
+                shape=tuple(e.tensor.shape), average=e.average,
+                root_rank=e.root_rank, prescale=e.prescale,
+                age_s=now - e.enqueued_at, nbytes=e.tensor.nbytes)
+            for e in self._negotiating
+        ]
+        try:
+            decision = c.negotiate(metas)
+        except Exception as exc:
+            err = (ShutdownError(str(exc))
+                   if isinstance(exc, coord.PeerShutdown)
+                   else EngineError(str(exc)))
+            for e in self._negotiating:
+                self.timeline.end(e.name, f"NEGOTIATE_{e.op.upper()}")
+                self._complete(e, None, err)
+            self._negotiating.clear()
+            return
+        self.cycle_time_s = decision.cycle_time_s or self.cycle_time_s
+        if decision.fusion_threshold is not None:
+            self.fusion_threshold = decision.fusion_threshold
+        self._extra_wait = decision.idle_backoff_s
+        done = set()
+        executed_bytes = 0
+        for g in decision.groups:
+            ents = [self._negotiating[i] for i in g.indices]
+            done.update(g.indices)
+            for e in ents:
+                self.timeline.end(e.name, f"NEGOTIATE_{e.op.upper()}")
+            if g.error:
+                for e in ents:
+                    self._complete(e, None, EngineError(g.error))
+                continue
+            executed_bytes += sum(e.tensor.nbytes for e in ents)
+            if ents[0].op == "allreduce":
+                self._exec_allreduce_batch(ents)
+            else:
+                for e in ents:
+                    self._exec_single(e)
+        if done:
+            self._negotiating = [e for i, e in enumerate(self._negotiating)
+                                 if i not in done]
+        if executed_bytes and self._param_manager is not None:
+            self._param_manager.update(executed_bytes)
 
     def _run_cycle(self):
         entries = self._drain()
+        self._maybe_build_coordinator()
+        if self._coordinator is not None:
+            self._negotiated_cycle(entries)
+            return
         if len(entries) > 1 and _multi_controller():
-            # Deterministic cross-controller execution order: with several
-            # controllers each eager collective is a global program launch,
-            # so every process must execute the same sequence. Multi-threaded
-            # enqueue makes arrival order process-local; name order is not.
-            # (Full agreement on batch composition comes from the negotiated
-            # path — see core/coordinator.py.)
+            # Fallback (negotiation disabled/unavailable): sort each
+            # drained cycle by name so thread-racy enqueue order within a
+            # cycle cannot diverge across processes. This is per-cycle
+            # only — drain-boundary skew can still split a batch
+            # differently on different processes, so this mode requires a
+            # single enqueue thread with identical program order (the
+            # negotiated path has no such requirement).
             entries.sort(key=lambda e: e.name)
         if entries and self._param_manager is not None:
             # One update per engine cycle with that cycle's traffic — the
@@ -428,7 +559,23 @@ class Engine:
             ]
         if stalled:
             self._last_stall_warn = now
-            names = ", ".join(f"{n} ({int(age)}s)" for n, age in stalled)
+            c = self._coordinator
+
+            def _fmt(n, age):
+                # Name the processes holding this tensor up (reference:
+                # CheckForStalledTensors, operations.cc:1535-1581).
+                if c is not None and c.last_tables:
+                    missing = c.missing_processes(n)
+                    if missing:
+                        return (f"{n} ({int(age)}s; missing from "
+                                f"process(es): "
+                                f"{', '.join(map(str, missing))})")
+                return f"{n} ({int(age)}s)"
+
+            names = ", ".join(_fmt(n, age) for n, age in stalled)
+            if c is not None and c.waiting_on is not None:
+                names += (f" [negotiation is blocked waiting for process "
+                          f"{c.waiting_on}]")
             LOG.warning(
                 "One or more tensors were submitted to be reduced/gathered/"
                 "broadcast but have not completed for over %ds: %s",
@@ -436,6 +583,12 @@ class Engine:
             )
 
     def shutdown(self):
+        # Publish the shutdown tombstone first: peers blocked mid-round on
+        # our next message discover it and surface ShutdownError instead
+        # of hanging (reference: shutdown propagation via the coordinator,
+        # operations.cc:2008-2011).
+        if self._coordinator is not None:
+            self._coordinator.close()
         self._shutdown.set()
         self._thread.join(timeout=5)
         with self._lock:

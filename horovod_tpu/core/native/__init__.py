@@ -76,6 +76,11 @@ EXEC_FN = ctypes.CFUNCTYPE(ctypes.c_int, ctypes.c_void_p,
                            ctypes.POINTER(HvdRequest),
                            ctypes.POINTER(HvdResult))
 
+# Negotiation control-plane hook: (ctx, table_json, decision_out) -> rc.
+# The callback must write an hvd_alloc()'d C string into *decision_out.
+NEG_FN = ctypes.CFUNCTYPE(ctypes.c_int, ctypes.c_void_p, ctypes.c_char_p,
+                          ctypes.POINTER(ctypes.c_void_p))
+
 
 def load_library():
     """Build if needed, load, and declare signatures. Cached."""
@@ -93,6 +98,10 @@ def load_library():
                                           ctypes.c_longlong]
     lib.hvd_engine_set_sort_by_name.argtypes = [ctypes.c_void_p,
                                                 ctypes.c_int]
+    lib.hvd_engine_set_negotiator.argtypes = [ctypes.c_void_p, NEG_FN,
+                                              ctypes.c_void_p]
+    lib.hvd_engine_set_negotiation_active.argtypes = [ctypes.c_void_p,
+                                                      ctypes.c_int]
     lib.hvd_alloc.restype = ctypes.c_void_p
     lib.hvd_alloc.argtypes = [ctypes.c_longlong]
     lib.hvd_engine_enqueue.restype = ctypes.c_longlong

@@ -92,6 +92,22 @@ struct hvd_result {
 
 typedef int (*hvd_exec_fn)(void* ctx, hvd_request* req, hvd_result* res);
 
+// Cross-controller negotiation hook (the control plane lives in Python —
+// core/coordinator.py — the way the reference's C++ core calls into
+// framework-owned services through abstract interfaces, common/common.h).
+// `table_json` describes every not-yet-agreed entry in order; the callback
+// writes an hvd_alloc()'d decision string to *decision_out (the engine
+// frees it):
+//   p <cycle_s> <fusion_bytes>      agreed engine params for this round
+//   w <seconds>                     one-shot extra wait before next cycle
+//   g <i,i,...>                     execute these entries as one group
+//   e <i,i,...> <message>           complete these entries with an error
+// Unreferenced indices stay pending for the next round. A nonzero return
+// poisons negotiation: all pending entries fail with *decision_out as the
+// message (e.g. a peer shut down or timed out).
+typedef int (*hvd_negotiate_fn)(void* ctx, const char* table_json,
+                                char** decision_out);
+
 void* hvd_alloc(long long nbytes) { return malloc((size_t)nbytes); }
 
 }  // extern "C"
@@ -283,6 +299,21 @@ class Engine {
     exec_ctx_ = ctx;
   }
 
+  void SetNegotiator(hvd_negotiate_fn fn, void* ctx) {
+    std::lock_guard<std::mutex> g(mu_);
+    neg_fn_ = fn;
+    neg_ctx_ = ctx;
+  }
+
+  // Divert cycles through the negotiator (multi-controller worlds). The
+  // Python side flips this on once topology knows several controller
+  // processes exist and a coordination service is reachable.
+  void SetNegotiationActive(int on) {
+    std::lock_guard<std::mutex> g(mu_);
+    neg_active_ = on != 0;
+    cv_.notify_all();  // idle loop must start ticking rounds immediately
+  }
+
   // Live-tunable engine parameters (the autotuner drives these; reference:
   // ParameterManager::SetAutoTuning + readback, parameter_manager.cc).
   void SetParams(double cycle_s, long long fusion_bytes) {
@@ -291,10 +322,11 @@ class Engine {
     if (fusion_bytes >= 0) fusion_bytes_ = fusion_bytes;
   }
 
-  // Deterministic cross-controller execution order: sort each drained
-  // cycle by tensor name before executing, so multi-controller processes
-  // with thread-racy enqueue order still launch collectives in one agreed
-  // sequence (full batch agreement comes from the negotiated path).
+  // Fallback ordering when negotiation is disabled: sort each drained
+  // cycle by tensor name so thread-racy enqueue order within a cycle
+  // cannot diverge across controller processes. Per-cycle only — this
+  // mode additionally requires a single enqueue thread with identical
+  // program order on every process; the negotiated path does not.
   void SetSortByName(int on) {
     std::lock_guard<std::mutex> g(mu_);
     sort_by_name_ = on != 0;
@@ -423,17 +455,33 @@ class Engine {
   void Loop() {
     while (true) {
       std::deque<Entry> batch;
+      bool negotiate;
       {
         std::unique_lock<std::mutex> lk(mu_);
-        double cycle = cycle_s_;
-        cv_.wait_for(lk, std::chrono::duration<double>(cycle),
-                     [&] { return shutdown_ || !queue_.empty(); });
+        double cycle = cycle_s_ + extra_wait_;
+        extra_wait_ = 0.0;
+        bool active = neg_active_ && neg_fn_ != nullptr;
+        if (active) {
+          // Rounds must tick even with nothing local to submit: peers
+          // block on our round message (reference: every rank gathers a
+          // possibly-empty request list each tick, operations.cc:2117).
+          cv_.wait_for(lk, std::chrono::duration<double>(cycle),
+                       [&] { return shutdown_; });
+        } else {
+          cv_.wait_for(lk, std::chrono::duration<double>(cycle),
+                       [&] { return shutdown_ || !queue_.empty(); });
+        }
         // On shutdown, leave queued entries for the failure drain below:
         // executing them could call into Python during teardown.
         if (shutdown_) break;
         batch.swap(queue_);
+        negotiate = neg_active_ && neg_fn_ != nullptr;
       }
-      RunCycle(batch);
+      if (negotiate) {
+        NegotiateCycle(batch);
+      } else {
+        RunCycle(batch);
+      }
     }
     // Fail whatever remains (reference: SHUT_DOWN_ERROR path,
     // operations.cc:1833-1848).
@@ -444,6 +492,157 @@ class Engine {
     }
     for (auto& e : rest)
       Complete(e, nullptr, 0, nullptr, "Horovod engine has been shut down");
+    for (auto& e : negotiating_)
+      Complete(e, nullptr, 0, nullptr, "Horovod engine has been shut down");
+    negotiating_.clear();
+  }
+
+  static const char* NegPhase(int op) {
+    switch (op) {
+      case HVD_ALLGATHER: return "NEGOTIATE_ALLGATHER";
+      case HVD_BROADCAST: return "NEGOTIATE_BROADCAST";
+      default: return "NEGOTIATE_ALLREDUCE";
+    }
+  }
+
+  void FailAllNegotiating(const std::string& msg) {
+    for (auto& e : negotiating_) {
+      if (timeline_.Active()) timeline_.End(e.name, NegPhase(e.op));
+      Complete(e, nullptr, 0, nullptr, msg.c_str());
+    }
+    negotiating_.clear();
+  }
+
+  // One negotiation round: describe every not-yet-agreed entry to the
+  // control plane, execute exactly the groups it returns (the reference's
+  // coordinated half of RunLoopOnce, operations.cc:1921-2172).
+  void NegotiateCycle(std::deque<Entry>& fresh) {
+    for (auto& e : fresh) {
+      if (timeline_.Active()) timeline_.Begin(e.name, NegPhase(e.op));
+      negotiating_.push_back(std::move(e));
+    }
+    if (neg_poisoned_) {
+      if (!negotiating_.empty()) FailAllNegotiating(neg_poison_);
+      return;
+    }
+    // Serialize the table (names JSON-escaped; everything else numeric).
+    std::string table = "[";
+    for (size_t i = 0; i < negotiating_.size(); ++i) {
+      Entry& e = negotiating_[i];
+      if (i) table += ",";
+      table += "{\"n\":\"" + JsonEscape(e.name) + "\"";
+      table += ",\"o\":" + std::to_string(e.op);
+      table += ",\"d\":" + std::to_string(e.dtype_num);
+      table += ",\"i\":" + std::to_string(e.itemsize);
+      table += ",\"s\":[";
+      for (size_t j = 0; j < e.shape.size(); ++j) {
+        if (j) table += ",";
+        table += std::to_string(e.shape[j]);
+      }
+      table += "],\"a\":" + std::to_string(e.average);
+      table += ",\"r\":" + std::to_string(e.root_rank);
+      table += ",\"p\":" + std::to_string(e.prescale);
+      table += ",\"t\":" + std::to_string(SecondsSince(e.enqueued));
+      table += ",\"b\":" + std::to_string((long long)e.data.size()) + "}";
+    }
+    table += "]";
+    hvd_negotiate_fn fn;
+    void* ctx;
+    {
+      std::lock_guard<std::mutex> g(mu_);
+      fn = neg_fn_;
+      ctx = neg_ctx_;
+    }
+    char* decision = nullptr;
+    int rc = fn(ctx, table.c_str(), &decision);
+    if (rc != 0) {
+      neg_poisoned_ = true;
+      neg_poison_ = decision ? decision : "negotiation failed";
+      free(decision);
+      FailAllNegotiating(neg_poison_);
+      return;
+    }
+    long long executed_bytes = ParseAndExecute(decision ? decision : "");
+    free(decision);
+    if (executed_bytes > 0) {
+      hvd_request req{};
+      req.op = HVD_TICK;
+      req.names = "";
+      req.count = executed_bytes;
+      hvd_result res{};
+      CallExecutor(&req, &res);  // autotune traffic report; best-effort
+    }
+  }
+
+  // Decision grammar: see hvd_negotiate_fn. Returns executed bytes.
+  long long ParseAndExecute(const std::string& decision) {
+    std::vector<bool> done(negotiating_.size(), false);
+    long long executed_bytes = 0;
+    size_t pos = 0;
+    while (pos < decision.size()) {
+      size_t eol = decision.find('\n', pos);
+      if (eol == std::string::npos) eol = decision.size();
+      std::string line = decision.substr(pos, eol - pos);
+      pos = eol + 1;
+      if (line.empty()) continue;
+      char kind = line[0];
+      std::string rest = line.size() > 2 ? line.substr(2) : "";
+      if (kind == 'p') {
+        double cyc = 0;
+        long long fus = -1;
+        if (sscanf(rest.c_str(), "%lf %lld", &cyc, &fus) == 2)
+          SetParams(cyc, fus);
+        continue;
+      }
+      if (kind == 'w') {
+        double w = atof(rest.c_str());
+        std::lock_guard<std::mutex> g(mu_);
+        if (w > 0) extra_wait_ = w;
+        continue;
+      }
+      if (kind != 'g' && kind != 'e') continue;
+      // indices up to first space; for 'e' the remainder is the message
+      size_t sp = rest.find(' ');
+      std::string idxs = sp == std::string::npos ? rest : rest.substr(0, sp);
+      std::string msg = sp == std::string::npos ? "" : rest.substr(sp + 1);
+      std::vector<Entry*> group;
+      size_t ip = 0;
+      bool bad = false;
+      while (ip < idxs.size()) {
+        size_t comma = idxs.find(',', ip);
+        if (comma == std::string::npos) comma = idxs.size();
+        long long idx = atoll(idxs.substr(ip, comma - ip).c_str());
+        ip = comma + 1;
+        if (idx < 0 || idx >= (long long)negotiating_.size() || done[idx]) {
+          bad = true;
+          break;
+        }
+        done[idx] = true;
+        group.push_back(&negotiating_[idx]);
+      }
+      if (bad || group.empty()) continue;  // malformed line: leave pending
+      for (auto* e : group)
+        if (timeline_.Active()) timeline_.End(e->name, NegPhase(e->op));
+      if (kind == 'e') {
+        for (auto* e : group)
+          Complete(*e, nullptr, 0, nullptr,
+                   msg.empty() ? "mismatched collective" : msg.c_str());
+        continue;
+      }
+      for (auto* e : group) executed_bytes += (long long)e->data.size();
+      if (group[0]->op == HVD_ALLREDUCE) {
+        ExecAllreduceBatch(group);
+      } else {
+        for (auto* e : group) ExecSingle(*e);
+      }
+    }
+    // Compact: drop completed entries, preserve order of the rest.
+    std::vector<Entry> remaining;
+    remaining.reserve(negotiating_.size());
+    for (size_t i = 0; i < negotiating_.size(); ++i)
+      if (!done[i]) remaining.push_back(std::move(negotiating_[i]));
+    negotiating_.swap(remaining);
+    return executed_bytes;
   }
 
   // Fuse allreduces per (dtype, average, prescale) in request order up to
@@ -680,6 +879,14 @@ class Engine {
   bool sort_by_name_ = false;
   hvd_exec_fn exec_fn_ = nullptr;
   void* exec_ctx_ = nullptr;
+  hvd_negotiate_fn neg_fn_ = nullptr;
+  void* neg_ctx_ = nullptr;
+  bool neg_active_ = false;
+  double extra_wait_ = 0.0;  // one-shot idle-round backoff
+  // Loop-thread-only state (no lock needed):
+  std::vector<Entry> negotiating_;
+  bool neg_poisoned_ = false;
+  std::string neg_poison_;
 
   std::thread loop_, watchdog_;
 };
@@ -707,6 +914,14 @@ void hvd_engine_set_params(void* e, double cycle_s, long long fusion_bytes) {
 
 void hvd_engine_set_sort_by_name(void* e, int on) {
   static_cast<Engine*>(e)->SetSortByName(on);
+}
+
+void hvd_engine_set_negotiator(void* e, hvd_negotiate_fn fn, void* ctx) {
+  static_cast<Engine*>(e)->SetNegotiator(fn, ctx);
+}
+
+void hvd_engine_set_negotiation_active(void* e, int on) {
+  static_cast<Engine*>(e)->SetNegotiationActive(on);
 }
 
 long long hvd_engine_enqueue(void* e, int op, const char* name, int dtype_num,

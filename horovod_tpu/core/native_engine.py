@@ -25,6 +25,7 @@ from horovod_tpu.core.engine import (
     JaxExecutor,
     ShutdownError,
     _multi_controller,
+    _negotiated,
     config_from_env,
     make_autotuner,
 )
@@ -47,6 +48,59 @@ except ImportError:  # pragma: no cover
 _DTYPE_CODE = {dt: i for i, dt in enumerate(_DTYPES)}
 
 _OPS = {"allreduce": 0, "allgather": 1, "broadcast": 2}
+_OPS_INV = {v: k for k, v in _OPS.items()}
+
+
+def _write_cstring(lib, out_pp, text: bytes):
+    """Hand a string to C through an hvd_alloc'd buffer (the engine frees
+    it — Python-owned bytes would dangle once the callback frame drops)."""
+    ptr = lib.hvd_alloc(len(text) + 1)
+    ctypes.memmove(ptr, text + b"\0", len(text) + 1)
+    out_pp[0] = ptr
+
+
+def _make_negotiator(engine):
+    """ctypes trampoline: libhvdcore's loop thread calls this each cycle
+    with the pending-entry table; we run one KV negotiation round
+    (core/coordinator.py) and hand back the agreed decision."""
+    import json
+
+    from horovod_tpu.core import coordinator as coord
+
+    lib = engine._lib
+
+    @native.NEG_FN
+    def neg(ctx, table_json, out_pp):
+        try:
+            c = engine._coordinator
+            rows = json.loads(table_json.decode())
+            metas = [
+                coord.RequestMeta(
+                    name=r["n"], op=_OPS_INV[r["o"]],
+                    dtype=str(_DTYPES[r["d"]]), itemsize=r["i"],
+                    shape=tuple(r["s"]), average=bool(r["a"]),
+                    root_rank=r["r"], prescale=r["p"], age_s=r["t"],
+                    nbytes=r["b"])
+                for r in rows
+            ]
+            decision = c.negotiate(metas)
+            lines = [f"p {decision.cycle_time_s} "
+                     f"{decision.fusion_threshold}"]
+            if decision.idle_backoff_s:
+                lines.append(f"w {decision.idle_backoff_s}")
+            for g in decision.groups:
+                idxs = ",".join(map(str, g.indices))
+                if g.error:
+                    lines.append(f"e {idxs} " + g.error.replace("\n", " "))
+                else:
+                    lines.append(f"g {idxs}")
+            _write_cstring(lib, out_pp, "\n".join(lines).encode())
+            return 0
+        except Exception as exc:  # peer shutdown / timeout / KV failure
+            _write_cstring(lib, out_pp, str(exc).encode()[:4000])
+            return 1
+
+    return neg
 
 
 def _make_callback(executor):
@@ -118,6 +172,7 @@ class NativeEngine:
                  timeline_path: Optional[str] = None):
         self.cycle_time_s, self.fusion_threshold, stall_warning_s = \
             config_from_env(cycle_time_s, fusion_threshold, stall_warning_s)
+        self._stall_warning_s = stall_warning_s
         if timeline_path is None:
             timeline_path = tl.timeline_path_from_env() or ""
 
@@ -128,17 +183,39 @@ class NativeEngine:
             float(self.cycle_time_s), int(self.fusion_threshold),
             float(stall_warning_s), timeline_path.encode())
         self._lib.hvd_engine_set_executor(self._ptr, self._cb, None)
+        # Negotiated multi-controller path: register the control-plane
+        # trampoline; it is activated lazily once topology knows several
+        # processes exist (set_params is re-applied at hvd.init()).
+        self._coordinator = None
+        self._neg = _make_negotiator(self)  # keep trampoline alive
+        self._lib.hvd_engine_set_negotiator(self._ptr, self._neg, None)
+        self._maybe_activate_negotiation()
         # Deterministic multi-controller ordering (same rule as the python
         # twin's _run_cycle sort); re-evaluated in set_params since topology
         # may come up after engine construction.
-        self._lib.hvd_engine_set_sort_by_name(
-            self._ptr, int(_multi_controller()))
+        if self._coordinator is None:
+            self._lib.hvd_engine_set_sort_by_name(
+                self._ptr, int(_multi_controller()))
         self._meta: dict = {}  # handle -> np.dtype (for result decode)
 
         # Autotuner: the C++ loop reports per-cycle traffic through TICK
         # callbacks; tuned values land back via hvd_engine_set_params.
         self._param_manager = make_autotuner(self)
         self._executor.param_manager = self._param_manager
+
+    def _maybe_activate_negotiation(self):
+        """Build the coordinator + flip the C++ loop into negotiated mode
+        once a multi-controller world with a KV service is known."""
+        if self._coordinator is not None or self._ptr is None:
+            return
+        if not _multi_controller():
+            return
+        from horovod_tpu.core import coordinator as coord
+
+        self._coordinator = coord.make_coordinator(
+            self.cycle_time_s, self.fusion_threshold, self._stall_warning_s)
+        if self._coordinator is not None:
+            self._lib.hvd_engine_set_negotiation_active(self._ptr, 1)
 
     def _enqueue(self, op: str, name: str, tensor: np.ndarray,
                  average: bool = False, root_rank: int = 0,
@@ -212,12 +289,13 @@ class NativeEngine:
         """Live parameter updates (the autotuner drives this)."""
         if self._ptr is None:
             return
-        if _multi_controller():
+        self._maybe_activate_negotiation()
+        if _multi_controller() and self._coordinator is None:
+            # No negotiation available: fall back to unfused, name-ordered
+            # execution (see engine.config_from_env).
             self._lib.hvd_engine_set_sort_by_name(self._ptr, 1)
-        if fusion_threshold is not None and _multi_controller():
-            # Multi-controller fusion stays off even if topology came up
-            # after engine construction (see engine.config_from_env).
-            fusion_threshold = 0
+            if fusion_threshold is not None:
+                fusion_threshold = 0
         self._lib.hvd_engine_set_params(
             self._ptr,
             -1.0 if cycle_time_s is None else float(cycle_time_s),
@@ -226,12 +304,21 @@ class NativeEngine:
             self.cycle_time_s = cycle_time_s
         if fusion_threshold is not None and fusion_threshold >= 0:
             self.fusion_threshold = fusion_threshold
+        if self._coordinator is not None:
+            # Process 0's tuned values propagate through the round params
+            # (reference: ParameterManager::SyncParams).
+            self._coordinator.cycle_time_s = self.cycle_time_s
+            self._coordinator.fusion_threshold = self.fusion_threshold
 
     def shutdown(self):
         if self._ptr is None:
             return
         if self._param_manager is not None:
             self._param_manager.close()
+        if self._coordinator is not None:
+            # Tombstone first: peers blocked mid-round on our next message
+            # surface ShutdownError instead of hanging.
+            self._coordinator.close()
         # Quiesce (fail outstanding work, wake waiters, join C++ threads)
         # but deliberately LEAK the small C++ object: another thread may
         # still be inside hvd_engine_wait_meta, and destroying a condition

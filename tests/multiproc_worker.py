@@ -14,6 +14,10 @@ def main():
 
     os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
                                + " --xla_force_host_platform_device_count=4")
+    if scenario.startswith("engine"):
+        # Timeline must be configured before hvd.init() (the engine is
+        # created there in multi-controller worlds).
+        os.environ["HVD_TIMELINE"] = f"/tmp/hvd_timeline_{scenario}_{pid}.json"
     import jax
 
     jax.config.update("jax_platforms", "cpu")
@@ -59,7 +63,21 @@ def main():
             {"epoch": 7, "who": "proc0"} if pid == 0 else None, root_rank=0)
         assert obj == {"epoch": 7, "who": "proc0"}
 
-        # Engine path: async allreduce with fusion force-disabled.
+        # Engine path: with negotiation (the default in multi-controller
+        # worlds) fusion stays ENABLED; batch composition is agreed
+        # through KV rounds (core/coordinator.py).
+        from horovod_tpu.core import engine as eng
+
+        e = eng.get_engine()
+        assert e.fusion_threshold > 0, e.fusion_threshold
+        hs = [e.allreduce_async(f"t{i}", np.ones((4,), np.float32), False)
+              for i in range(3)]
+        for h in hs:
+            np.testing.assert_allclose(e.synchronize(h),
+                                       np.full((4,), 4.0 * nproc))
+    elif scenario == "collectives_nonegotiation":
+        # HVD_NEGOTIATION=0 (set by the test): the fallback multi-
+        # controller engine path must force fusion OFF and still agree.
         from horovod_tpu.core import engine as eng
 
         e = eng.get_engine()
@@ -69,6 +87,120 @@ def main():
         for h in hs:
             np.testing.assert_allclose(e.synchronize(h),
                                        np.full((4,), 4.0 * nproc))
+    elif scenario == "engine_fusion":
+        # Negotiated fusion across controllers (reference: the rank-0
+        # coordinator's fused responses, operations.cc:2035-2074): both
+        # processes enqueue the same names with different values; results
+        # must be identical everywhere and the engine must actually fuse.
+        from horovod_tpu.core import engine as eng
+
+        e = eng.get_engine()
+        assert e.fusion_threshold > 0
+        vals = [float(10 * i + pid + 1) for i in range(4)]
+        hs = [e.allreduce_async(f"grad/{i}", np.full((8,), v, np.float32),
+                                False)
+              for i, v in enumerate(vals)]
+        hg = e.allgather_async("gath", np.full((pid + 1, 2), float(pid),
+                                               np.float32))
+        hb = e.broadcast_async("bcast", np.full((3,), float(pid) + 5.0,
+                                                np.float32), 0)
+        outs = [e.synchronize(h) for h in hs]
+        for i, out in enumerate(outs):
+            # 4 chips per process contribute each process's value.
+            expect = 4 * sum(10 * i + p + 1 for p in range(nproc))
+            np.testing.assert_array_equal(out, np.full((8,), expect))
+        g = e.synchronize(hg)
+        assert g.shape == (sum(4 * (p + 1) for p in range(nproc)), 2)
+        np.testing.assert_array_equal(e.synchronize(hb),
+                                      np.full((3,), 5.0))
+        # Bitwise agreement across processes (the test compares lines).
+        print("RESULT " + ",".join(str(float(o[0])) for o in outs),
+              flush=True)
+        # The timeline must show fusion actually happened.
+        import json
+
+        eng.shutdown_engine()
+        evs = json.load(open(os.environ["HVD_TIMELINE"]))
+        assert any(ev.get("name") == "MEMCPY_IN_FUSION_BUFFER"
+                   for ev in evs), "no fused batch in timeline"
+        assert any(str(ev.get("name", "")).startswith("NEGOTIATE_")
+                   for ev in evs), "no negotiation phases in timeline"
+    elif scenario == "engine_mismatch":
+        # Cross-process dtype/shape/root mismatches must surface the SAME
+        # coordinator-style error on EVERY process (reference:
+        # test_torch.py:265-349, operations.cc:315-517), and the engine
+        # must stay usable afterwards.
+        from horovod_tpu.core import engine as eng
+        from horovod_tpu.core.engine import EngineError
+
+        e = eng.get_engine()
+
+        def expect_error(h, needle):
+            try:
+                e.synchronize(h)
+            except EngineError as err:
+                assert needle in str(err), (needle, str(err))
+                print(f"proc {pid}: {needle} OK", flush=True)
+            else:
+                raise SystemExit(f"no error surfaced for {needle}")
+
+        dt = np.float32 if pid == 0 else np.float64
+        expect_error(e.allreduce_async("dt", np.ones((4,), dt), False),
+                     "Mismatched data types")
+        shape = (4,) if pid == 0 else (2, 2)
+        expect_error(e.allreduce_async("shp", np.ones(shape, np.float32),
+                                       False),
+                     "Mismatched tensor shapes")
+        expect_error(e.broadcast_async("rt", np.ones((2,), np.float32),
+                                       root_rank=pid),
+                     "Mismatched root ranks")
+        op_h = (e.allreduce_async("op", np.ones((2,), np.float32), False)
+                if pid == 0 else
+                e.allgather_async("op", np.ones((2,), np.float32)))
+        expect_error(op_h, "Mismatched collective operations")
+        # Engine must still work after entry-level errors.
+        h = e.allreduce_async("after", np.ones((4,), np.float32), False)
+        np.testing.assert_allclose(e.synchronize(h),
+                                   np.full((4,), 4.0 * nproc))
+    elif scenario == "engine_stall":
+        # Missing-rank stall attribution (reference: CheckForStalledTensors
+        # names missing ranks, operations.cc:1535-1581): process 1 delays
+        # submitting 'late'; process 0's warning must name process 1.
+        import time
+
+        from horovod_tpu.core import engine as eng
+
+        e = eng.get_engine()
+        if pid == 0:
+            h = e.allreduce_async("late", np.ones((2,), np.float32), False)
+        else:
+            time.sleep(4.0)
+            h = e.allreduce_async("late", np.ones((2,), np.float32), False)
+        np.testing.assert_allclose(e.synchronize(h),
+                                   np.full((2,), 4.0 * nproc))
+    elif scenario == "engine_peer_shutdown":
+        # Cooperative shutdown propagation (reference: shutdown flag in the
+        # request list → SHUT_DOWN_ERROR for stragglers,
+        # operations.cc:2008-2011, 1833-1848).
+        import time
+
+        from horovod_tpu.core import engine as eng
+        from horovod_tpu.core.engine import ShutdownError
+
+        e = eng.get_engine()
+        if pid == 1:
+            time.sleep(1.0)
+            hvd.shutdown()
+        else:
+            h = e.allreduce_async("orphan", np.ones((2,), np.float32),
+                                  False)
+            try:
+                e.synchronize(h)
+            except ShutdownError as err:
+                print(f"proc {pid}: peer shutdown surfaced: {err}",
+                      flush=True)
+            else:
+                raise SystemExit("peer shutdown did not surface")
     elif scenario == "mismatch":
         os.environ["HVD_CONSISTENCY_CHECKS"] = "1"
         from horovod_tpu.common.topology import HorovodInternalError

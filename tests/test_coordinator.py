@@ -1,0 +1,199 @@
+"""Unit tests for the cross-controller negotiation protocol
+(horovod_tpu/core/coordinator.py) using the in-memory LocalKV: N
+coordinator instances on N threads stand in for N controller processes.
+
+Mirrors the guarantees of the reference's rank-0 coordinator
+(reference: horovod/common/operations.cc:279-517): readiness requires
+every process, mismatched requests surface the SAME error on every
+process, fusion composition is agreed, and stalls are attributed to the
+processes that have not submitted."""
+
+import logging
+import threading
+
+import pytest
+
+from horovod_tpu.core.coordinator import (
+    Coordinator,
+    Decision,
+    Group,
+    LocalKV,
+    NegotiationTimeout,
+    PeerShutdown,
+    RequestMeta,
+    decide,
+)
+
+
+def meta(name, op="allreduce", dtype="float32", shape=(4,), **kw):
+    import numpy as np
+
+    nbytes = int(np.prod(shape)) * 4
+    return RequestMeta(name=name, op=op, dtype=dtype, itemsize=4,
+                       shape=tuple(shape), nbytes=nbytes, **kw)
+
+
+def run_round(per_process_entries, nproc=2, fusion=1 << 26, **coord_kw):
+    """Run one negotiation round on nproc threads; return decisions."""
+    store = {}
+    results = [None] * nproc
+    errors = [None] * nproc
+    timeout_s = coord_kw.pop("timeout_s", 10.0)
+
+    def worker(pid):
+        c = Coordinator(LocalKV(store), nproc, pid, 0.005, fusion,
+                        timeout_s=timeout_s, **coord_kw)
+        try:
+            results[pid] = c.negotiate(per_process_entries[pid])
+        except Exception as exc:  # surfaced to the test
+            errors[pid] = exc
+
+    threads = [threading.Thread(target=worker, args=(p,))
+               for p in range(nproc)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=30)
+    return results, errors
+
+
+class TestDecide:
+    def test_ready_requires_all_processes(self):
+        a = [meta("x"), meta("y")]
+        b = [meta("x")]
+        groups = decide({0: a, 1: b}, a, fusion_threshold=1 << 20)
+        executed = [i for g in groups for i in g.indices]
+        assert executed == [0]  # only 'x'; 'y' stays pending
+
+    def test_lexicographic_order_and_fusion(self):
+        a = [meta("b"), meta("a"), meta("c", dtype="float64")]
+        groups = decide({0: a, 1: a}, a, fusion_threshold=1 << 20)
+        # a+b fuse (same dtype); c is its own group.
+        assert [g.indices for g in groups] == [[1, 0], [2]]
+        assert all(g.error is None for g in groups)
+
+    def test_fusion_threshold_splits_groups(self):
+        a = [meta("a", shape=(4,)), meta("b", shape=(4,))]
+        groups = decide({0: a, 1: a}, a, fusion_threshold=16)
+        assert [g.indices for g in groups] == [[0], [1]]
+
+    def test_zero_threshold_disables_fusion(self):
+        a = [meta("a"), meta("b")]
+        groups = decide({0: a, 1: a}, a, fusion_threshold=0)
+        assert [g.indices for g in groups] == [[0], [1]]
+
+    def test_mismatched_dtype_is_error_group(self):
+        a = [meta("x", dtype="float32")]
+        b = [meta("x", dtype="float64", )]
+        for mine, table in ((a, {0: a, 1: b}), (b, {0: a, 1: b})):
+            groups = decide(table, mine, fusion_threshold=1 << 20)
+            assert len(groups) == 1 and groups[0].error
+            assert "Mismatched data types" in groups[0].error
+
+    def test_mismatched_shape_and_root(self):
+        a = [meta("x", shape=(2, 3))]
+        b = [meta("x", shape=(4,))]
+        groups = decide({0: a, 1: b}, a, fusion_threshold=0)
+        assert "Mismatched tensor shapes" in groups[0].error
+
+        a = [meta("r", op="broadcast", root_rank=0)]
+        b = [meta("r", op="broadcast", root_rank=1)]
+        groups = decide({0: a, 1: b}, a, fusion_threshold=0)
+        assert "Mismatched root ranks" in groups[0].error
+
+    def test_allgather_first_dim_may_differ(self):
+        a = [meta("g", op="allgather", shape=(2, 3))]
+        b = [meta("g", op="allgather", shape=(5, 3))]
+        groups = decide({0: a, 1: b}, a, fusion_threshold=1 << 20)
+        assert groups[0].error is None
+
+        b2 = [meta("g", op="allgather", shape=(5, 4))]
+        groups = decide({0: a, 1: b2}, a, fusion_threshold=1 << 20)
+        assert "Mismatched tensor shapes" in groups[0].error
+
+    def test_identical_decision_on_every_process(self):
+        a = [meta("m"), meta("k"), meta("z", op="broadcast")]
+        b = [meta("k"), meta("z", op="broadcast"), meta("m")]
+        ga = decide({0: a, 1: b}, a, fusion_threshold=1 << 20)
+        gb = decide({0: a, 1: b}, b, fusion_threshold=1 << 20)
+        names_a = [[a[i].name for i in g.indices] for g in ga]
+        names_b = [[b[i].name for i in g.indices] for g in gb]
+        assert names_a == names_b  # same composition, same order
+
+
+class TestRounds:
+    def test_two_process_round_agrees(self):
+        e = [meta("a"), meta("b")]
+        results, errors = run_round({0: e, 1: e})
+        assert errors == [None, None]
+        for r in results:
+            assert isinstance(r, Decision)
+            assert [g.indices for g in r.groups] == [[0, 1]]
+
+    def test_params_flow_from_process_zero(self):
+        store = {}
+        decisions = {}
+
+        def worker(pid, cycle, fusion):
+            c = Coordinator(LocalKV(store), 2, pid, cycle, fusion,
+                            timeout_s=10.0)
+            decisions[pid] = c.negotiate([])
+
+        t0 = threading.Thread(target=worker, args=(0, 0.042, 12345))
+        t1 = threading.Thread(target=worker, args=(1, 0.005, 999))
+        t0.start(), t1.start()
+        t0.join(10), t1.join(10)
+        # Process 1 adopted process 0's params.
+        assert decisions[1].cycle_time_s == 0.042
+        assert decisions[1].fusion_threshold == 12345
+
+    def test_timeout_names_the_laggard(self):
+        store = {}
+        c = Coordinator(LocalKV(store), 2, 0, 0.005, 0, timeout_s=0.7)
+        with pytest.raises(NegotiationTimeout) as ei:
+            c.negotiate([meta("x")])
+        assert "process 1" in str(ei.value)
+        assert c.dead  # poisoned afterwards
+
+    def test_peer_shutdown_tombstone(self):
+        store = {}
+        dead = Coordinator(LocalKV(store), 2, 1, 0.005, 0)
+        dead.close()
+        c = Coordinator(LocalKV(store), 2, 0, 0.005, 0, timeout_s=5.0)
+        with pytest.raises(PeerShutdown):
+            c.negotiate([meta("x")])
+
+    def test_key_cleanup_after_rounds(self):
+        store = {}
+        results = [None, None]
+
+        def worker(pid):
+            c = Coordinator(LocalKV(store), 2, pid, 0.001, 0, timeout_s=10.0)
+            for _ in range(4):
+                results[pid] = c.negotiate([])
+
+        ts = [threading.Thread(target=worker, args=(p,)) for p in (0, 1)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(10)
+        round_keys = [k for k in store if "/r" in str(k)]
+        # Rounds 0..3 ran; only the last two rounds' keys may linger.
+        assert all("/r2/" in k or "/r3/" in k for k in round_keys), store
+
+    def test_idle_backoff_grows(self):
+        e = []
+        results, errors = run_round({0: e, 1: e})
+        assert errors == [None, None]
+        assert all(r.idle_backoff_s > 0 for r in results)
+
+    def test_stall_attribution_warning(self, caplog):
+        stale = [meta("slowpoke", age_s=99.0)]
+        with caplog.at_level(logging.WARNING,
+                             logger="horovod_tpu.coordinator"):
+            # Process 1 never announces 'slowpoke'.
+            results, errors = run_round({0: stale, 1: []}, nproc=2,
+                                        stall_warning_s=1.0)
+        assert errors == [None, None]
+        msgs = [r.getMessage() for r in caplog.records]
+        assert any("slowpoke" in m and "process(es): 1" in m for m in msgs)

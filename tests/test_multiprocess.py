@@ -19,13 +19,15 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
-def _run_world(scenario: str, nproc: int = 2, timeout: int = 240):
+def _run_world(scenario: str, nproc: int = 2, timeout: int = 240,
+               extra_env: dict = None):
     port = _free_port()
     env = dict(os.environ)
     env.pop("JAX_PLATFORMS", None)
     env.pop("XLA_FLAGS", None)
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+    env.update(extra_env or {})
     procs = [
         subprocess.Popen(
             [sys.executable, _WORKER, str(port), str(i), str(nproc),
@@ -85,3 +87,59 @@ def test_two_process_consistency_check_detects_mismatch():
     outs = _run_world("mismatch")
     for out in outs:
         assert "mismatch detected OK" in out
+
+
+def test_two_process_engine_without_negotiation():
+    """HVD_NEGOTIATION=0: fallback multi-controller engine path keeps
+    fusion force-disabled and name-ordered execution."""
+    _run_world("collectives_nonegotiation",
+               extra_env={"HVD_NEGOTIATION": "0"})
+
+
+ENGINES = ["native", "python"]
+
+
+@pytest.mark.parametrize("engine", ENGINES)
+def test_two_process_negotiated_fusion(engine):
+    """Fusion stays ON across controller processes: batch composition is
+    agreed through KV negotiation rounds and results are identical
+    everywhere (reference: rank-0 fused responses,
+    operations.cc:2035-2074)."""
+    outs = _run_world("engine_fusion", extra_env={"HVD_ENGINE": engine})
+    results = [line for out in outs for line in out.splitlines()
+               if line.startswith("RESULT ")]
+    assert len(results) == 2 and results[0] == results[1], results
+
+
+@pytest.mark.parametrize("engine", ENGINES)
+def test_two_process_mismatch_errors_on_every_rank(engine):
+    """dtype/shape/root/op mismatches surface the same coordinator-style
+    error on EVERY process (reference: test_torch.py:265-349)."""
+    outs = _run_world("engine_mismatch", extra_env={"HVD_ENGINE": engine})
+    for out in outs:
+        for needle in ("Mismatched data types OK",
+                       "Mismatched tensor shapes OK",
+                       "Mismatched root ranks OK",
+                       "Mismatched collective operations OK"):
+            assert needle in out, out[-3000:]
+
+
+@pytest.mark.parametrize("engine", ENGINES)
+def test_two_process_stall_names_missing_process(engine):
+    """The stall warning names the process that has not submitted
+    (reference: CheckForStalledTensors, operations.cc:1535-1581)."""
+    outs = _run_world(
+        "engine_stall",
+        extra_env={"HVD_ENGINE": engine, "HVD_STALL_CHECK_TIME": "1"})
+    assert any("late" in out and "missing from process(es): 1" in out
+               for out in outs), outs[0][-3000:]
+
+
+@pytest.mark.parametrize("engine", ENGINES)
+def test_two_process_peer_shutdown_propagates(engine):
+    """A peer stopping its engine fails outstanding collectives with
+    ShutdownError instead of hanging (reference: SHUT_DOWN_ERROR,
+    operations.cc:1833-1848)."""
+    outs = _run_world("engine_peer_shutdown",
+                      extra_env={"HVD_ENGINE": engine})
+    assert any("peer shutdown surfaced" in out for out in outs)
