@@ -40,15 +40,23 @@ def main():
     ap.add_argument("--bf16", action="store_true", default=True)
     ap.add_argument("--remat", action="store_true",
                     help="checkpoint each layer (HBM for FLOPs)")
+    ap.add_argument("--flash", action="store_true",
+                    help="use the pallas flash-attention kernel "
+                         "(forward + backward) instead of stock attention")
     args = ap.parse_args()
 
     hvd.init()
+    attention_fn = None
+    if args.flash:
+        from horovod_tpu.ops.flash_attention import flash_attention
+
+        attention_fn = flash_attention  # BERT is bidirectional
     cfg = TransformerConfig(
         vocab_size=args.vocab, num_layers=args.layers,
         num_heads=args.heads, hidden_dim=args.hidden,
         mlp_dim=4 * args.hidden, max_len=args.seq_len,
         dtype=jnp.bfloat16 if args.bf16 else jnp.float32,
-        remat=args.remat)
+        remat=args.remat, attention_fn=attention_fn)
     model = TransformerLM(cfg)
     opt = hvd_jax.DistributedOptimizer(
         optax.adamw(1e-4, weight_decay=0.01))
@@ -83,12 +91,14 @@ def main():
     toks = jnp.asarray(tokens)
     for _ in range(args.warmup):
         params, opt_state, loss = step(params, opt_state, toks)
-    jax.block_until_ready(loss)
+    # Real device->host fetch: block_until_ready is not an execution
+    # barrier on the tunneled axon platform (see bench.py).
+    float(np.asarray(loss))
 
     t0 = time.perf_counter()
     for _ in range(args.steps):
         params, opt_state, loss = step(params, opt_state, toks)
-    jax.block_until_ready(loss)
+    float(np.asarray(loss))
     dt = time.perf_counter() - t0
     tok_per_sec = args.batch_size * args.seq_len * args.steps / dt
     print(f"tokens/sec/chip: {tok_per_sec:.0f}  loss={float(loss):.3f}")

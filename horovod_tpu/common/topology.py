@@ -166,18 +166,28 @@ def init(ranks: Optional[Sequence[int]] = None, devices: Optional[Sequence] = No
         if _eng._engine is not None:
             _eng._engine.set_params(
                 fusion_threshold=_eng._engine.fusion_threshold)
-        elif _state.num_processes > 1:
-            # Multi-controller liveness: negotiation rounds need EVERY
-            # process's engine participating (peers block on our round
-            # message even when we never use the engine path ourselves —
-            # the reference equivalently gathers a possibly-empty request
-            # list from every rank each tick, operations.cc:2117-2131).
-            from horovod_tpu.core import coordinator as _coord
+    except Exception:
+        pass
+    if _state.num_processes > 1:
+        # Multi-controller liveness: negotiation rounds need EVERY
+        # process's engine participating (peers block on our round
+        # message even when we never use the engine path ourselves —
+        # the reference equivalently gathers a possibly-empty request
+        # list from every rank each tick, operations.cc:2117-2131).
+        # A failure here MUST be loud: a silent non-participant stalls
+        # every peer for the full negotiation timeout.
+        try:
+            from horovod_tpu.core import coordinator as _coord, engine as _eng
 
             if _coord.negotiation_enabled():
                 _eng.get_engine()
-    except Exception:
-        pass
+        except Exception as exc:
+            import logging
+
+            logging.getLogger("horovod_tpu").error(
+                "failed to start the collective engine for negotiation "
+                "rounds (%s); peer processes' engine collectives will "
+                "stall until HVD_NEGOTIATION_TIMEOUT", exc)
 
 
 def shutdown():

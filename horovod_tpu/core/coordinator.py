@@ -50,7 +50,13 @@ from typing import Dict, List, Optional, Sequence, Tuple
 LOG = logging.getLogger("horovod_tpu.coordinator")
 
 _POLL_SLICE_S = 0.5  # granularity of tombstone checks while blocked
-_IDLE_BACKOFF_CAP_S = 0.1  # max stretch between all-idle rounds
+# Max stretch between all-idle rounds. Bounds steady-state KV chatter of a
+# P-process world to O(P^2)/cap reads per second against the coordination
+# service; a fresh enqueue wakes the engine loop immediately (both
+# engines), so the cap costs at most one peer's remaining backoff of
+# first-op latency after an idle stretch, not per-op latency.
+_IDLE_BACKOFF_CAP_S = float(os.environ.get(
+    "HVD_NEGOTIATION_IDLE_MAX", "1.0"))
 
 OPS = ("allreduce", "allgather", "broadcast")
 
@@ -225,6 +231,8 @@ def _mismatch_message(name: str, metas: Dict[int, RequestMeta]) -> str:
     a = metas[pids[0]]
     for pid in pids[1:]:
         b = metas[pid]
+        if _fingerprint(b) == _fingerprint(a):
+            continue  # this process agrees with pids[0]; find the one that doesn't
         if a.op != b.op:
             field, va, vb = "collective operations", a.op, b.op
         elif a.dtype != b.dtype or a.itemsize != b.itemsize:

@@ -226,6 +226,7 @@ class Engine:
         self._lock = threading.Lock()
         self._next_handle = 0
         self._shutdown = threading.Event()
+        self._wake = threading.Event()  # enqueue cuts idle sleeps short
         self._last_stall_warn = 0.0
         # Negotiated multi-controller path (core/coordinator.py): entries
         # drained but not yet agreed with the peer processes.
@@ -265,6 +266,7 @@ class Engine:
             self._pending_names[entry.name] = entry
         self.timeline.start(entry.name, tl.QUEUE)
         self._queue.put(entry)
+        self._wake.set()
         return entry.handle
 
     def allreduce_async(self, name: str, tensor: np.ndarray, average: bool,
@@ -318,7 +320,8 @@ class Engine:
             sleep = self.cycle_time_s - elapsed + self._extra_wait
             self._extra_wait = 0.0
             if sleep > 0:
-                self._shutdown.wait(sleep)
+                self._wake.wait(sleep)
+            self._wake.clear()
         # Fail whatever is left (reference: operations.cc:1833-1848).
         self._drain_with_error(ShutdownError("Horovod engine has been shut down"))
 
@@ -590,6 +593,7 @@ class Engine:
         if self._coordinator is not None:
             self._coordinator.close()
         self._shutdown.set()
+        self._wake.set()  # break an idle sleep immediately
         self._thread.join(timeout=5)
         with self._lock:
             handles = list(self._handles.values())

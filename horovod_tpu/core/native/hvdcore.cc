@@ -465,8 +465,9 @@ class Engine {
           // Rounds must tick even with nothing local to submit: peers
           // block on our round message (reference: every rank gathers a
           // possibly-empty request list each tick, operations.cc:2117).
+          // A fresh enqueue still cuts an idle-backoff stretch short.
           cv_.wait_for(lk, std::chrono::duration<double>(cycle),
-                       [&] { return shutdown_; });
+                       [&] { return shutdown_ || !queue_.empty(); });
         } else {
           cv_.wait_for(lk, std::chrono::duration<double>(cycle),
                        [&] { return shutdown_ || !queue_.empty(); });

@@ -101,6 +101,15 @@ class TestDecide:
         groups = decide({0: a, 1: b}, a, fusion_threshold=0)
         assert "Mismatched root ranks" in groups[0].error
 
+    def test_mismatch_message_names_the_differing_process(self):
+        # With 3 processes, the error must name the process that actually
+        # disagrees (and the right field), not the first two.
+        a = [meta("x", dtype="float32")]
+        c = [meta("x", dtype="float64")]
+        groups = decide({0: a, 1: a, 2: c}, a, fusion_threshold=0)
+        assert "Mismatched data types" in groups[0].error
+        assert "process 2" in groups[0].error
+
     def test_allgather_first_dim_may_differ(self):
         a = [meta("g", op="allgather", shape=(2, 3))]
         b = [meta("g", op="allgather", shape=(5, 3))]
