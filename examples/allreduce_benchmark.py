@@ -34,13 +34,30 @@ def main():
                     default=[1, 4, 16, 64])
     ap.add_argument("--iters", type=int, default=20)
     ap.add_argument("--warmup", type=int, default=3)
+    ap.add_argument("--hierarchical", action="store_true",
+                    help="route through reduce-scatter(ICI) -> psum(DCN) "
+                         "-> all-gather(ICI) (reference: "
+                         "HOROVOD_HIERARCHICAL_ALLREDUCE). Needs a "
+                         "two-tier world: multi-process, or "
+                         "HVD_TWO_TIER_SHAPE=o,i to split one host.")
     args = ap.parse_args()
 
+    import os
+
+    if args.hierarchical:
+        os.environ["HVD_HIERARCHICAL_ALLREDUCE"] = "1"
     hvd.init()
     n = hvd.size()
     mesh = hvd.mesh()
+    from horovod_tpu.ops.collectives import _hier_allreduce_active
+
+    mode = "hierarchical" if _hier_allreduce_active() else "flat"
+    if args.hierarchical and mode == "flat":
+        print("# WARNING: --hierarchical requested but the world has no "
+              "two-tier mesh; falling back to flat "
+              "(set HVD_TWO_TIER_SHAPE or run multi-process)")
     print(f"# world: {n} chip(s), platform="
-          f"{jax.devices()[0].platform}")
+          f"{jax.devices()[0].platform}, mode={mode}")
 
     for mb in args.sizes_mb:
         elems = int(mb * 1024 * 1024 / 4)
@@ -49,11 +66,15 @@ def main():
             np.ones((n, elems), np.float32),
             NamedSharding(mesh, P(HVD_AXIS)))
         for _ in range(args.warmup):
-            jax.block_until_ready(ranked_allreduce(x))
+            float(np.asarray(ranked_allreduce(x)[0]))
         t0 = time.perf_counter()
         for _ in range(args.iters):
             out = ranked_allreduce(x)
-        jax.block_until_ready(out)
+        # Real device->host fetch of a SLICED scalar: block_until_ready is
+        # not an execution barrier on the tunneled axon platform (see
+        # bench.py), and fetching the whole buffer would bill a multi-MB
+        # host transfer to the collective being measured.
+        float(np.asarray(out[0]))
         dt = (time.perf_counter() - t0) / args.iters
         payload = elems * 4
         bus_bytes = 2 * payload * (n - 1) / max(n, 1)

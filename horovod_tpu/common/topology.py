@@ -72,6 +72,7 @@ class _Topology:
         self.num_processes = 1
         self.process_index = 0
         self.homogeneous = True
+        self.two_tier = None  # (dcn, ici) Mesh when the world has 2 tiers
 
 
 _state = _Topology()
@@ -81,6 +82,58 @@ def _build_mesh(devs: Sequence) -> "object":
     from jax.sharding import Mesh
 
     return Mesh(np.asarray(devs), (HVD_AXIS,))
+
+
+def _build_two_tier(devices: Sequence):
+    """(dcn, ici) mesh over the SAME devices in the SAME order as the flat
+    world mesh — the reference's local/cross communicator split
+    (operations.cc:1668-1705). Axis names match
+    :mod:`horovod_tpu.parallel.mesh`. Returns None when the world has no
+    usable two-tier structure (single process without an override,
+    heterogeneous chip counts, or process-interleaved device order —
+    hierarchical collectives would silently permute ranks then).
+
+    ``HVD_TWO_TIER_SHAPE=o,i`` overrides the process grouping — the test
+    and simulation knob (e.g. treat a single 8-device process as 2 slices
+    of 4), mirroring how the reference's hierarchical path is exercised
+    by telling MPI there are multiple nodes.
+    """
+    from jax.sharding import Mesh
+
+    shape_env = os.environ.get("HVD_TWO_TIER_SHAPE")
+    if shape_env:
+        # An explicit override must fail loudly — silently degrading to
+        # flat collectives would invalidate whatever the user is measuring.
+        try:
+            outer, inner = (int(v) for v in shape_env.split(","))
+        except ValueError:
+            raise ValueError(
+                f"HVD_TWO_TIER_SHAPE={shape_env!r} is not 'outer,inner' "
+                "(e.g. '2,4')") from None
+        if outer < 1 or inner < 1 or outer * inner != len(devices):
+            raise ValueError(
+                f"HVD_TWO_TIER_SHAPE={shape_env!r} does not factor the "
+                f"{len(devices)}-device world")
+        arr = np.empty((outer, inner), dtype=object)
+        for idx, d in enumerate(devices):
+            arr[idx // inner, idx % inner] = d
+        return Mesh(arr, ("dcn", "ici"))
+    by_proc: dict = {}
+    for d in devices:
+        by_proc.setdefault(d.process_index, []).append(d)
+    if len(by_proc) < 2:
+        return None
+    if len({len(v) for v in by_proc.values()}) != 1:
+        return None  # heterogeneous: reference gates hierarchical off too
+    rows = [by_proc[p] for p in sorted(by_proc)]
+    flat = [d for row in rows for d in row]
+    if flat != list(devices):
+        return None  # interleaved order would change rank identity
+    arr = np.empty((len(rows), len(rows[0])), dtype=object)
+    for r, row in enumerate(rows):
+        for c, d in enumerate(row):
+            arr[r, c] = d
+    return Mesh(arr, ("dcn", "ici"))
 
 
 def init(ranks: Optional[Sequence[int]] = None, devices: Optional[Sequence] = None):
@@ -156,6 +209,7 @@ def init(ranks: Optional[Sequence[int]] = None, devices: Optional[Sequence] = No
         for d in devices:
             counts[d.process_index] = counts.get(d.process_index, 0) + 1
         _state.homogeneous = len(set(counts.values())) == 1
+        _state.two_tier = _build_two_tier(devices)
         _state.initialized = True
     # If an engine was constructed before init() (legal: enqueue works
     # pre-init), re-apply its params so the multi-controller fusion guard
@@ -210,6 +264,7 @@ def shutdown():
             pass
         _state.initialized = False
         _state.mesh = None
+        _state.two_tier = None
         _state.devices = []
         _state.local_devices = []
 
@@ -269,6 +324,12 @@ def process_index() -> int:
 def mesh():
     """The world ``jax.sharding.Mesh`` (1-D, axis name ``'hvd'``)."""
     return _require_init().mesh
+
+
+def two_tier():
+    """The (dcn, ici) world mesh, or None when the world has no two-tier
+    structure (see :func:`_build_two_tier`)."""
+    return _require_init().two_tier
 
 
 def devices() -> list:

@@ -275,18 +275,56 @@ DistributedGradientTape = value_and_grad
 # SPMD compilation helper
 # ---------------------------------------------------------------------------
 
+def _two_tier_specs(specs):
+    """Rewrite every ``'hvd'`` PartitionSpec entry to the ``('dcn','ici')``
+    axis pair so user specs written for the flat world mesh map unchanged
+    onto the two-tier mesh (same devices, same order — rank identity is
+    preserved)."""
+    from jax.sharding import PartitionSpec as P
+
+    def one_entry(e):
+        if e == HVD_AXIS:
+            return (_C.DCN_AXIS, _C.ICI_AXIS)
+        if isinstance(e, tuple):
+            out = []
+            for a in e:
+                out.extend((_C.DCN_AXIS, _C.ICI_AXIS) if a == HVD_AXIS
+                           else (a,))
+            return tuple(out)
+        return e
+
+    def one_spec(p):
+        return P(*(one_entry(e) for e in p)) if isinstance(p, P) else p
+
+    return _jax.tree_util.tree_map(
+        one_spec, specs, is_leaf=lambda x: isinstance(x, P))
+
+
 def jit(fn: Callable = None, *, in_specs, out_specs, static_argnums=(),
         donate_argnums=()):
     """Compile ``fn`` over the world mesh: ``shard_map`` with the ``'hvd'``
     rank axis bound (so in-step collectives lower to ICI collectives) under
     ``jax.jit``. This replaces the reference's runtime enqueue→negotiate→
-    execute pipeline (SURVEY.md §3.2) with one compiled program."""
+    execute pipeline (SURVEY.md §3.2) with one compiled program.
+
+    With ``HVD_HIERARCHICAL_ALLREDUCE`` on and a two-tier world, the step
+    maps over the (dcn, ici) mesh instead (specs spelled with ``'hvd'``
+    are rewritten) and in-step ``hvd.allreduce`` lowers to
+    reduce-scatter(ICI) → psum(DCN) → all-gather(ICI) — the reference's
+    hierarchical hot path (operations.cc:1194-1346) at compile time."""
 
     def wrap(f):
-        sm = _shard_map(
-            f, mesh=mesh(), in_specs=in_specs, out_specs=out_specs,
-            check_vma=False,
-        )
+        if _C._hier_allreduce_active():
+            sm = _shard_map(
+                f, mesh=_C._topo.two_tier(),
+                in_specs=_two_tier_specs(in_specs),
+                out_specs=_two_tier_specs(out_specs), check_vma=False,
+            )
+        else:
+            sm = _shard_map(
+                f, mesh=mesh(), in_specs=in_specs, out_specs=out_specs,
+                check_vma=False,
+            )
         return _jax.jit(sm, static_argnums=static_argnums,
                         donate_argnums=donate_argnums)
 

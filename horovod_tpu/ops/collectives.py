@@ -45,14 +45,72 @@ except ImportError:  # pragma: no cover
     from jax.experimental.shard_map import shard_map as _shard_map
 
 
+# Two-tier axis names, matching horovod_tpu.parallel.mesh (not imported:
+# the parallel package pulls flax; these two literals are the contract).
+DCN_AXIS = "dcn"
+ICI_AXIS = "ici"
+
+
+def hierarchical_allreduce_enabled() -> bool:
+    """HVD_HIERARCHICAL_ALLREDUCE routes rank-axis allreduces through
+    reduce-scatter(ICI) -> psum(DCN) -> all-gather(ICI) whenever the world
+    has a two-tier mesh (reference: HOROVOD_HIERARCHICAL_ALLREDUCE,
+    operations.cc:1760-1778, composition :1194-1346)."""
+    v = (os.environ.get("HVD_HIERARCHICAL_ALLREDUCE")
+         or os.environ.get("HOROVOD_HIERARCHICAL_ALLREDUCE") or "")
+    return v.lower() not in ("", "0", "false", "off")
+
+
+def hierarchical_allgather_enabled() -> bool:
+    """HVD_HIERARCHICAL_ALLGATHER: two-phase allgather (reference:
+    HOROVOD_HIERARCHICAL_ALLGATHER shared-memory path,
+    operations.cc:875-1010)."""
+    v = (os.environ.get("HVD_HIERARCHICAL_ALLGATHER")
+         or os.environ.get("HOROVOD_HIERARCHICAL_ALLGATHER") or "")
+    return v.lower() not in ("", "0", "false", "off")
+
+
+def _hier_allreduce_active() -> bool:
+    st = _topo._require_init()
+    return hierarchical_allreduce_enabled() and st.two_tier is not None
+
+
+def _hier_allgather_active() -> bool:
+    st = _topo._require_init()
+    return hierarchical_allgather_enabled() and st.two_tier is not None
+
+
 # ---------------------------------------------------------------------------
 # SPMD-context helpers
 # ---------------------------------------------------------------------------
 
+def _name_bound(name: str) -> bool:
+    try:
+        lax.axis_index(name)
+        return True
+    except NameError:
+        return False
+
+
+def rank_axes():
+    """The mesh axis name(s) enumerating ranks in the current SPMD context:
+    ``'hvd'`` over the flat world mesh, ``('dcn', 'ici')`` over the
+    two-tier mesh (hvd.jax.jit under HVD_HIERARCHICAL_ALLREDUCE). None
+    outside any rank axis."""
+    if _name_bound(HVD_AXIS):
+        return HVD_AXIS
+    if _name_bound(DCN_AXIS) and _name_bound(ICI_AXIS):
+        return (DCN_AXIS, ICI_AXIS)
+    return None
+
+
 def axis_rank():
     """Per-chip rank inside SPMD code (the in-program analogue of
     ``hvd.rank()``; reference rank discovery: operations.cc:1664-1666)."""
-    return lax.axis_index(HVD_AXIS)
+    ax = rank_axes()
+    if ax is None:
+        _require_axis("axis_rank")
+    return lax.axis_index(ax)
 
 
 def in_spmd(x=None) -> bool:
@@ -64,31 +122,24 @@ def in_spmd(x=None) -> bool:
 
 
 def _require_axis(opname: str):
-    """Raise a clear error when a collective is traced without the hvd axis
+    """Raise a clear error when a collective is traced without a rank axis
     (e.g. plain ``jax.jit`` instead of ``hvd.jit``/``shard_map``)."""
     raise RuntimeError(
-        f"horovod_tpu.{opname} was traced without the '{HVD_AXIS}' mesh axis. "
-        "Wrap your step with horovod_tpu.jax.jit(...) / shard_map over the "
-        "world mesh, or call it eagerly on concrete arrays."
+        f"horovod_tpu.{opname} was traced without the '{HVD_AXIS}' mesh axis "
+        f"(or the '{DCN_AXIS}'/'{ICI_AXIS}' pair). Wrap your step with "
+        "horovod_tpu.jax.jit(...) / shard_map over the world mesh, or call "
+        "it eagerly on concrete arrays."
     )
-
-
-def _axis_bound() -> bool:
-    try:
-        lax.axis_index(HVD_AXIS)
-        return True
-    except NameError:
-        return False
 
 
 # ---------------------------------------------------------------------------
 # Ranked primitives: stacked per-rank arrays over the device mesh
 # ---------------------------------------------------------------------------
 
-def _psum_avg(x, world: int, average: bool):
+def _psum_avg(x, world: int, average: bool, axis=HVD_AXIS):
     """psum, optionally averaged, preserving integer dtypes (floor-divide)
     so traced and eager calls agree."""
-    r = lax.psum(x, HVD_AXIS)
+    r = lax.psum(x, axis)
     if average:
         if jnp.issubdtype(x.dtype, jnp.floating) or jnp.issubdtype(x.dtype, jnp.complexfloating):
             r = (r / world).astype(x.dtype)
@@ -97,15 +148,31 @@ def _psum_avg(x, world: int, average: bool):
     return r
 
 
-def _root_select_psum(x, root: int):
+def _hier_allreduce(x, average: bool):
+    """reduce-scatter(ICI) -> psum(DCN) -> all-gather(ICI) over the bound
+    two-tier axes; the lazy import keeps flax off the hot import path."""
+    from horovod_tpu.parallel.hierarchical import hierarchical_allreduce
+
+    return hierarchical_allreduce(x, ICI_AXIS, DCN_AXIS, average=average)
+
+
+def _spmd_allreduce(x, average: bool, ax):
+    """In-SPMD allreduce over whatever rank axes are bound, hierarchical
+    when the two-tier axes are available and the env knob is on."""
+    if isinstance(ax, tuple) and hierarchical_allreduce_enabled():
+        return _hier_allreduce(x, average)
+    return _psum_avg(x, lax.psum(1, ax), average, axis=ax)
+
+
+def _root_select_psum(x, root: int, axis=HVD_AXIS):
     """Broadcast-from-root as select + psum. The select (not a mask multiply)
     keeps NaN/Inf on non-root ranks from poisoning the sum; bools ride
     through an integer cast since psum is undefined for them."""
-    idx = lax.axis_index(HVD_AXIS)
+    idx = lax.axis_index(axis)
     asbool = x.dtype == jnp.bool_
     v = x.astype(jnp.int8) if asbool else x
     v = jnp.where(idx == root, v, jnp.zeros_like(v))
-    r = lax.psum(v, HVD_AXIS)
+    r = lax.psum(v, axis)
     return r.astype(jnp.bool_) if asbool else r
 
 
@@ -118,35 +185,52 @@ def _rank_sharding(mesh, ndim: int):
 
 
 @functools.lru_cache(maxsize=None)
-def _ranked_program(op: str, mesh_key, root: int, average: bool):
+def _ranked_program(op: str, mesh_key, root: int, average: bool,
+                    hier: bool = False):
     """Build + cache a jitted collective over the current mesh. jit itself
-    caches per shape/dtype, so one program object serves all tensors."""
-    mesh = _mesh()
+    caches per shape/dtype, so one program object serves all tensors.
+
+    ``hier=True`` builds the program over the (dcn, ici) two-tier mesh
+    with the hierarchical composition (reference: operations.cc:1194-1346,
+    875-1010) instead of the flat world mesh — rank identity is unchanged
+    because the two meshes hold the same devices in the same order
+    (topology._build_two_tier enforces it)."""
+    st = _topo._require_init()
+    mesh = st.two_tier if hier else st.mesh
     world = mesh.devices.size
+    rank_spec = (DCN_AXIS, ICI_AXIS) if hier else HVD_AXIS
 
     def body(stacked):
         # stacked: local shard of the (size, *shape) array => (1, *shape);
         # x is this rank's tensor.
         x = stacked[0]
         if op == "allreduce":
+            if hier:
+                return _hier_allreduce(x, average)
             return _psum_avg(x, world, average)
         if op == "allgather":
+            if hier:
+                from horovod_tpu.parallel.hierarchical import (
+                    hierarchical_allgather,
+                )
+
+                return hierarchical_allgather(x, ICI_AXIS, DCN_AXIS)
             return lax.all_gather(x, HVD_AXIS, axis=0, tiled=True)
         if op == "broadcast":
-            return _root_select_psum(x, root)
+            return _root_select_psum(x, root, axis=rank_spec)
         if op == "reducescatter":
-            return lax.psum_scatter(x, HVD_AXIS, scatter_dimension=0, tiled=True)[None]
+            return lax.psum_scatter(x, rank_spec, scatter_dimension=0, tiled=True)[None]
         if op == "alltoall":
-            return lax.all_to_all(x, HVD_AXIS, split_axis=0, concat_axis=0, tiled=True)[None]
+            return lax.all_to_all(x, rank_spec, split_axis=0, concat_axis=0, tiled=True)[None]
         raise ValueError(op)
 
     if op in ("allreduce", "allgather", "broadcast"):
         out_spec = P()  # replicated result on every rank
     else:
-        out_spec = P(HVD_AXIS)  # per-rank results, stacked
+        out_spec = P(rank_spec)  # per-rank results, stacked
 
     def run(stacked):
-        spec = P(HVD_AXIS, *([None] * (stacked.ndim - 1)))
+        spec = P(rank_spec, *([None] * (stacked.ndim - 1)))
         # check_vma=False: all_gather/all_to_all results are replicated or
         # per-rank by construction; jax's static replication checker cannot
         # infer this for every primitive.
@@ -202,14 +286,18 @@ def _replicated_stack(x):
 
 
 def ranked_allreduce(stacked, average: bool = False):
-    """Sum (or mean) of per-rank tensors; result replicated to all ranks."""
-    return _ranked_program("allreduce", _mesh_key(), 0, average)(stacked)
+    """Sum (or mean) of per-rank tensors; result replicated to all ranks.
+    Routed hierarchically (ICI/DCN split) when HVD_HIERARCHICAL_ALLREDUCE
+    is on and the world has a two-tier mesh."""
+    return _ranked_program("allreduce", _mesh_key(), 0, average,
+                           hier=_hier_allreduce_active())(stacked)
 
 
 def ranked_allgather(stacked):
     """Concatenate per-rank tensors along dim 0 (reference: MPI_Allgatherv
     path, operations.cc:810-857); result (size*n, ...) replicated."""
-    return _ranked_program("allgather", _mesh_key(), 0, False)(stacked)
+    return _ranked_program("allgather", _mesh_key(), 0, False,
+                           hier=_hier_allgather_active())(stacked)
 
 
 def _check_root(root_rank: int) -> int:
@@ -308,10 +396,10 @@ def allreduce(tensor, average: bool = True, name: Optional[str] = None):
     SPMD ordering does not) and used by the timeline.
     """
     if in_spmd(tensor):
-        if not _axis_bound():
+        ax = rank_axes()
+        if ax is None:
             _require_axis("allreduce")
-        # psum(1, axis) constant-folds to the axis size at trace time.
-        return _psum_avg(tensor, lax.psum(1, HVD_AXIS), average)
+        return _spmd_allreduce(tensor, average, ax)
     tensor = jnp.asarray(tensor)
     _maybe_consistency_check(0, tensor, flags=int(average))
     return ranked_allreduce(_replicated_stack(tensor), average=average)
@@ -323,9 +411,10 @@ def allgather(tensor, name: Optional[str] = None):
     dims; eagerly that can only differ across processes, handled by a size
     exchange + pad + strip (XLA collectives need static shapes)."""
     if in_spmd(tensor):
-        if not _axis_bound():
+        ax = rank_axes()
+        if ax is None:
             _require_axis("allgather")
-        return lax.all_gather(tensor, HVD_AXIS, axis=0, tiled=True)
+        return lax.all_gather(tensor, ax, axis=0, tiled=True)
     tensor = jnp.asarray(tensor)
     if tensor.ndim == 0:
         raise ValueError("allgather requires a tensor with at least one dimension")
@@ -361,9 +450,10 @@ def broadcast(tensor, root_rank: int, name: Optional[str] = None):
     horovod/tensorflow/mpi_ops.py:151-167, operations.cc:1502-1522)."""
     root_rank = _check_root(root_rank)
     if in_spmd(tensor):
-        if not _axis_bound():
+        ax = rank_axes()
+        if ax is None:
             _require_axis("broadcast")
-        return _root_select_psum(tensor, root_rank)
+        return _root_select_psum(tensor, root_rank, axis=ax)
     tensor = jnp.asarray(tensor)
     _maybe_consistency_check(2, tensor, root_rank)
     return ranked_broadcast(_replicated_stack(tensor), root_rank)
@@ -374,9 +464,10 @@ def reducescatter(tensor, name: Optional[str] = None):
     (Beyond the reference's three verbs; native on TPU, and the building
     block of hierarchical allreduce — operations.cc:1194-1346.)"""
     if in_spmd(tensor):
-        if not _axis_bound():
+        ax = rank_axes()
+        if ax is None:
             _require_axis("reducescatter")
-        return lax.psum_scatter(tensor, HVD_AXIS, scatter_dimension=0, tiled=True)
+        return lax.psum_scatter(tensor, ax, scatter_dimension=0, tiled=True)
     tensor = jnp.asarray(tensor)
     _maybe_consistency_check(3, tensor)
     return _local_row(ranked_reducescatter(_replicated_stack(tensor)))
@@ -386,9 +477,10 @@ def alltoall(tensor, name: Optional[str] = None):
     """Each rank scatters equal chunks of dim 0 to all ranks and concatenates
     what it receives (beyond the reference's verbs; rides ICI natively)."""
     if in_spmd(tensor):
-        if not _axis_bound():
+        ax = rank_axes()
+        if ax is None:
             _require_axis("alltoall")
-        return lax.all_to_all(tensor, HVD_AXIS, split_axis=0, concat_axis=0, tiled=True)
+        return lax.all_to_all(tensor, ax, split_axis=0, concat_axis=0, tiled=True)
     tensor = jnp.asarray(tensor)
     _maybe_consistency_check(4, tensor)
     return _local_row(ranked_alltoall(_replicated_stack(tensor)))

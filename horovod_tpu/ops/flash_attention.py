@@ -104,6 +104,28 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref,
         lse_ref[0] = m_ref[:, :1] + jnp.log(safe)  # (bq, 1) lane
 
 
+def _kv_index(causal: bool, block_q: int, block_k: int):
+    """K/V index map for grids where the k tile is the innermost axis.
+    For causal attention the index is clamped to the last visible k block
+    of the current q block: pallas skips the HBM->VMEM copy when the
+    block index repeats between grid steps, so fully-masked steps (whose
+    compute pl.when also skips) cost no memory traffic."""
+    if not causal:
+        return lambda b, i, j: (b, j, 0)
+    last = lambda i: (i * block_q + block_q - 1) // block_k  # noqa: E731
+    return lambda b, i, j: (b, jnp.minimum(j, last(i)), 0)
+
+
+def _q_index(causal: bool, block_q: int, block_k: int):
+    """Q-side index map for the dK/dV grid (q tile innermost): clamped up
+    to the first visible q block of the current k block (same
+    repeated-index DMA-skip trick as _kv_index)."""
+    if not causal:
+        return lambda b, i, j: (b, j, 0)
+    first = lambda i: (i * block_k) // block_q  # noqa: E731
+    return lambda b, i, j: (b, jnp.maximum(j, first(i)), 0)
+
+
 @functools.partial(jax.jit, static_argnames=("causal", "block_q", "block_k",
                                              "interpret"))
 def _fwd_bhsd(q, k, v, causal, block_q, block_k, interpret):
@@ -111,13 +133,14 @@ def _fwd_bhsd(q, k, v, causal, block_q, block_k, interpret):
     nq, nk = s // block_q, s // block_k
     kernel = functools.partial(_fwd_kernel, causal=causal, scale=d ** -0.5,
                                nk=nk, block_q=block_q, block_k=block_k)
+    kv_idx = _kv_index(causal, block_q, block_k)
     return pl.pallas_call(
         kernel,
         grid=(bh, nq, nk),
         in_specs=[
             pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d), kv_idx),
+            pl.BlockSpec((1, block_k, d), kv_idx),
         ],
         out_specs=[
             pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
@@ -218,7 +241,8 @@ def _bwd_bhsd(q, k, v, lse, do, out, causal, block_q, block_k, interpret):
                     axis=-1, keepdims=True)
 
     q_spec_i = pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0))
-    k_spec_j = pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0))
+    k_spec_j = pl.BlockSpec((1, block_k, d),
+                            _kv_index(causal, block_q, block_k))
     row_spec_i = pl.BlockSpec((1, block_q, 1), lambda b, i, j: (b, i, 0))
 
     dq = pl.pallas_call(
@@ -234,9 +258,10 @@ def _bwd_bhsd(q, k, v, lse, do, out, causal, block_q, block_k, interpret):
     )(q, k, v, lse, delta, do)
 
     # dK/dV: grid over K tiles, Q innermost.
-    q_spec_j = pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, j, 0))
+    q_idx = _q_index(causal, block_q, block_k)
+    q_spec_j = pl.BlockSpec((1, block_q, d), q_idx)
     k_spec_i = pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, i, 0))
-    row_spec_j = pl.BlockSpec((1, block_q, 1), lambda b, i, j: (b, j, 0))
+    row_spec_j = pl.BlockSpec((1, block_q, 1), q_idx)
     dk, dv = pl.pallas_call(
         functools.partial(_dkv_kernel, causal=causal, scale=d ** -0.5,
                           nq=nq, block_q=block_q, block_k=block_k),

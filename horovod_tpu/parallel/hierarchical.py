@@ -54,7 +54,8 @@ def hierarchical_allreduce(
         out = out[:-pad]
     if average:
         world = inner * lax.psum(1, outer_axis)
-        if jnp.issubdtype(out.dtype, jnp.floating):
+        if (jnp.issubdtype(out.dtype, jnp.floating)
+                or jnp.issubdtype(out.dtype, jnp.complexfloating)):
             out = (out / world).astype(x.dtype)
         else:
             out = out // world

@@ -201,6 +201,43 @@ def main():
                       flush=True)
             else:
                 raise SystemExit("peer shutdown did not surface")
+    elif scenario == "hierarchical":
+        # HVD_HIERARCHICAL_ALLREDUCE=1 (set by the test): 2 processes x 4
+        # chips form the (dcn=2, ici=4) two-tier mesh from process
+        # grouping; eager, compiled and engine allreduces all route
+        # reduce-scatter(ICI) -> psum(DCN) -> all-gather(ICI)
+        # (reference: operations.cc:1194-1346, env gate :1760-1778).
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        import horovod_tpu.jax as hvd_jax
+        from horovod_tpu.common import topology
+        from horovod_tpu.ops import collectives as C
+
+        tt = topology.two_tier()
+        assert tt is not None and tt.devices.shape == (2, 4), tt
+        assert C._hier_allreduce_active()
+
+        mine = float(pid + 1)
+        out = np.asarray(hvd.allreduce(jnp.full((7,), mine), average=False))
+        np.testing.assert_allclose(out, np.full((7,), 4.0 * 3))  # 4*(1+2)
+
+        @hvd_jax.jit(in_specs=(P(hvd_jax.HVD_AXIS),), out_specs=P())
+        def compiled(x):
+            return C.allreduce(x[0], average=False)
+
+        mesh = hvd.mesh()
+        shards = [jax.device_put(jnp.full((1, 3), mine), d)
+                  for d in jax.local_devices()]
+        x = jax.make_array_from_single_device_arrays(
+            (8, 3), NamedSharding(mesh, P(hvd_jax.HVD_AXIS)), shards)
+        np.testing.assert_allclose(np.asarray(compiled(x)),
+                                   np.full((3,), 4.0 * 3))
+
+        from horovod_tpu.core import engine as eng
+
+        e = eng.get_engine()
+        h = e.allreduce_async("ht", np.full((5,), mine, np.float32), False)
+        np.testing.assert_allclose(e.synchronize(h), np.full((5,), 12.0))
     elif scenario == "mismatch":
         os.environ["HVD_CONSISTENCY_CHECKS"] = "1"
         from horovod_tpu.common.topology import HorovodInternalError

@@ -135,6 +135,15 @@ def test_two_process_stall_names_missing_process(engine):
                for out in outs), outs[0][-3000:]
 
 
+def test_two_process_hierarchical_allreduce():
+    """HVD_HIERARCHICAL_ALLREDUCE on a 2-process world: the (dcn, ici)
+    mesh is built from process grouping and eager/compiled/engine
+    allreduces all ride the hierarchical composition (reference:
+    operations.cc:1194-1346)."""
+    _run_world("hierarchical",
+               extra_env={"HVD_HIERARCHICAL_ALLREDUCE": "1"})
+
+
 @pytest.mark.parametrize("engine", ENGINES)
 def test_two_process_peer_shutdown_propagates(engine):
     """A peer stopping its engine fails outstanding collectives with
