@@ -68,6 +68,9 @@ def main():
                         "(each scanned step is a full real SGD update)")
     p.add_argument("--fp16-allreduce", action="store_true",
                    help="bf16 gradient compression on the wire")
+    p.add_argument("--profile", metavar="DIR", default=None,
+                   help="capture an XLA profiler trace of one timed "
+                        "window into DIR (view: tensorboard --logdir DIR)")
     args = p.parse_args()
 
     import jax
@@ -195,6 +198,16 @@ def main():
 
     loss = run_batches(ncalls_warm)
     assert np.isfinite(loss), f"diverged in warmup: {loss}"
+
+    if args.profile:
+        # One-command hot-path capture (docs/timeline.md): one full timed
+        # window under the XLA profiler, real fetch barrier inside.
+        from horovod_tpu.utils import profiler
+
+        with profiler.profile(args.profile):
+            run_batches(ncalls_iter)
+        print(f"# profile: {len(profiler.trace_files(args.profile))} "
+              f"xplane file(s) in {args.profile}", file=sys.stderr)
 
     rates = []
     for _ in range(args.num_iters):

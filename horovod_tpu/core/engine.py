@@ -501,9 +501,11 @@ class Engine:
                 [(e.tensor.reshape(-1) * e.prescale if e.prescale != 1.0
                   else e.tensor.reshape(-1)) for e in batch]
             )
-            for n in names:
-                self.timeline.end(n, tl.MEMCPY_IN_FUSION_BUFFER)
-                self.timeline.start(n, tl.ALLREDUCE)
+            for e in batch:
+                self.timeline.end(e.name, tl.MEMCPY_IN_FUSION_BUFFER)
+                self.timeline.start(e.name, tl.ALLREDUCE,
+                                    {"dtype": str(e.tensor.dtype),
+                                     "shape": list(e.tensor.shape)})
             out = self.executor.allreduce(flat, batch[0].average)
             off = 0
             for e in batch:
@@ -516,13 +518,14 @@ class Engine:
                 self._complete(e, None, EngineError(str(exc)))
 
     def _exec_single(self, e: _Entry):
+        args = {"dtype": str(e.tensor.dtype), "shape": list(e.tensor.shape)}
         try:
             if e.op == "allgather":
-                self.timeline.start(e.name, tl.ALLGATHER)
+                self.timeline.start(e.name, tl.ALLGATHER, args)
                 out = self.executor.allgather(e.tensor)
                 self.timeline.end(e.name, tl.ALLGATHER)
             elif e.op == "broadcast":
-                self.timeline.start(e.name, tl.BROADCAST)
+                self.timeline.start(e.name, tl.BROADCAST, args)
                 out = self.executor.broadcast(e.tensor, e.root_rank)
                 self.timeline.end(e.name, tl.BROADCAST)
             else:

@@ -238,6 +238,40 @@ def main():
         e = eng.get_engine()
         h = e.allreduce_async("ht", np.full((5,), mine, np.float32), False)
         np.testing.assert_allclose(e.synchronize(h), np.full((5,), 12.0))
+    elif scenario == "torch_errors":
+        # Reference error-path tests drive mismatches through the TORCH
+        # API and assert the coordinator error surfaces as an exception on
+        # every rank (test_torch.py:265-349).
+        import torch
+
+        import horovod_tpu.torch as hvt
+
+        hvt.init()
+
+        def expect(fn, needle):
+            try:
+                fn()
+            except hvt.EngineError as err:
+                assert needle in str(err), (needle, str(err))
+                print(f"proc {pid}: torch {needle} OK", flush=True)
+            else:
+                raise SystemExit(f"torch API surfaced no error for {needle}")
+
+        dt = torch.float32 if pid == 0 else torch.float64
+        expect(lambda: hvt.allreduce(torch.ones(4, dtype=dt),
+                                     average=False, name="dt"),
+               "Mismatched data types")
+        shape = (4,) if pid == 0 else (2, 2)
+        expect(lambda: hvt.allreduce(torch.ones(shape), average=False,
+                                     name="shp"),
+               "Mismatched tensor shapes")
+        expect(lambda: hvt.broadcast(torch.ones(2), root_rank=pid,
+                                     name="rt"),
+               "Mismatched root ranks")
+        # And the API still works afterwards.
+        out = hvt.allreduce(torch.ones(3), average=False, name="after")
+        np.testing.assert_allclose(out.numpy(),
+                                   np.full((3,), 4.0 * nproc))
     elif scenario == "mismatch":
         os.environ["HVD_CONSISTENCY_CHECKS"] = "1"
         from horovod_tpu.common.topology import HorovodInternalError

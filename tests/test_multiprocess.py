@@ -135,6 +135,18 @@ def test_two_process_stall_names_missing_process(engine):
                for out in outs), outs[0][-3000:]
 
 
+def test_two_process_torch_api_errors():
+    """Mismatches surfaced through the torch API as exceptions on every
+    rank — the reference's error-path tests drive the torch surface, not
+    the raw engine (test_torch.py:265-349)."""
+    outs = _run_world("torch_errors")
+    for out in outs:
+        for needle in ("torch Mismatched data types OK",
+                       "torch Mismatched tensor shapes OK",
+                       "torch Mismatched root ranks OK"):
+            assert needle in out, out[-3000:]
+
+
 def test_two_process_hierarchical_allreduce():
     """HVD_HIERARCHICAL_ALLREDUCE on a 2-process world: the (dcn, ici)
     mesh is built from process grouping and eager/compiled/engine

@@ -87,6 +87,35 @@ def test_broadcast_gradient_root():
     np.testing.assert_allclose(g.numpy(), np.full(2, 8.0))
 
 
+def test_allreduce_gradient_average_and_cotangent():
+    """Non-uniform upstream cotangents, both reduction modes (reference
+    multiplies by a random tensor before reducing,
+    test_tensorflow.py:321-346)."""
+    c = tf.constant([3.0, 5.0])
+    x = tf.Variable([1.0, 2.0])
+    with tf.GradientTape() as tape:
+        loss = tf.reduce_sum(hvd_tf.allreduce(x, average=False) * c)
+    g = tape.gradient(loss, x)
+    np.testing.assert_allclose(g.numpy(), c.numpy() * 8)
+    with tf.GradientTape() as tape:
+        loss = tf.reduce_sum(hvd_tf.allreduce(x, average=True) * c)
+    g = tape.gradient(loss, x)
+    # average mode: backward averages the cotangent over ranks -> exactly c.
+    np.testing.assert_allclose(g.numpy(), c.numpy(), rtol=1e-6)
+
+
+def test_allgather_gradient_cotangent_slices():
+    """Backward of allgather reduces the cotangent then slices this
+    rank's rows (reference: mpi_ops.py:127-148)."""
+    x = tf.Variable([[1.0, 2.0]])
+    w = tf.reshape(tf.range(1.0, 17.0), (8, 2))
+    with tf.GradientTape() as tape:
+        loss = tf.reduce_sum(hvd_tf.allgather(x) * w)
+    g = tape.gradient(loss, x)
+    # Every rank contributes w; rank 0's row slice is w[0:1] * 8.
+    np.testing.assert_allclose(g.numpy(), w[0:1].numpy() * 8)
+
+
 def test_sparse_allreduce_indexed_slices():
     """Reference sparse path: IndexedSlices -> allgather
     (tensorflow/__init__.py:48-94)."""

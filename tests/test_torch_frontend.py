@@ -68,6 +68,46 @@ def test_allreduce_grad():
     np.testing.assert_allclose(x.grad.numpy(), np.full((4,), float(hvt.size())))
 
 
+def test_allreduce_grad_average_and_cotangent():
+    """Reference grad oracle with non-uniform upstream cotangents
+    (test_torch.py:351-403 multiplies by a random tensor before summing):
+    the registered backward is itself an allreduce of the cotangent, so
+    d/dx sum(allreduce(x) * c) = allreduce(c)."""
+    c = torch.arange(1.0, 5.0)
+    x = torch.ones(4, requires_grad=True)
+    (hvt.allreduce(x, average=False) * c).sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(),
+                               c.numpy() * hvt.size(), rtol=1e-6)
+    # average=True: backward averages the cotangent over ranks, so with
+    # every rank contributing c the gradient is exactly c.
+    x2 = torch.ones(4, requires_grad=True)
+    (hvt.allreduce(x2, average=True) * c).sum().backward()
+    np.testing.assert_allclose(x2.grad.numpy(), c.numpy(), rtol=1e-6)
+
+
+def test_allgather_grad_cotangent_slices():
+    """Backward of allgather slices the cotangent: each rank receives the
+    gradient rows of ITS contribution (reference: test_torch.py:523-565,
+    mpi_ops.py HorovodAllgather backward)."""
+    n = hvt.size()
+    x = torch.ones(2, 3, requires_grad=True)
+    out = hvt.allgather(x)  # (2n, 3): rank r's rows at [2r, 2r+2)
+    w = torch.arange(1.0, 2 * n * 3 + 1).reshape(2 * n, 3)
+    (out * w).sum().backward()
+    # Backward = allreduce(cotangent, SUM) then take this rank's rows:
+    # every rank contributes w, so rank 0's slice is w[0:2] * size.
+    np.testing.assert_allclose(x.grad.numpy(), w[0:2].numpy() * n)
+
+
+def test_broadcast_grad_average_path():
+    c = torch.tensor([2.0, 0.5, 4.0])
+    x = torch.ones(3, requires_grad=True)
+    (hvt.broadcast(x, root_rank=0) * c).sum().backward()
+    # Root (rank 0 here) receives allreduce(c) = c * size.
+    np.testing.assert_allclose(x.grad.numpy(), c.numpy() * hvt.size(),
+                               rtol=1e-6)
+
+
 def test_allgather():
     x = torch.arange(6, dtype=torch.float32).reshape(2, 3)
     out = hvt.allgather(x)
