@@ -13,7 +13,19 @@ Runs on whatever mesh is visible: one real chip today, a pod slice
 unmodified. On a single chip the collective is a self-reduction, so the
 numbers are an upper bound / plumbing check.
 
+Modes:
+- default: compiled in-SPMD collective (the hot path).
+- ``--engine``: the background-engine path — host numpy buffers through
+  enqueue→fuse→stage→collective→host, the reference's CudaOnCPU staging
+  shape (torch/mpi_ops_v2.cc:78-110). Scored in bytes/µs, the autotuner's
+  objective (reference: parameter_manager.h:34-43).
+- ``--engine --tensors K``: K equal tensors submitted together per
+  iteration — the tensor-fusion stress (reference: docs/tensor-fusion.md);
+  compare HVD_FUSION_THRESHOLD=0 vs default 64 MB.
+
 Run: PYTHONPATH=. python examples/allreduce_benchmark.py --sizes-mb 1 16 64
+     PYTHONPATH=. python examples/allreduce_benchmark.py --engine \
+         --sizes-kb 1 64 1024 65536 --tensors 16
 """
 
 import argparse
@@ -28,12 +40,54 @@ import horovod_tpu as hvd
 from horovod_tpu.ops.collectives import HVD_AXIS, ranked_allreduce
 
 
+def run_engine(args):
+    """Engine-path sweep: bytes/µs through the async host engine."""
+    from horovod_tpu.core import engine as eng
+
+    e = eng.get_engine()
+    kind = type(e).__name__
+    print(f"# engine path ({kind}), fusion_threshold="
+          f"{e.fusion_threshold}, tensors/iter={args.tensors}")
+    print(f"# {'size/tensor':>12s} {'total':>10s} {'time':>10s} "
+          f"{'bytes/us':>9s} {'host_bw':>9s}")
+    for kb in args.sizes_kb:
+        elems = max(1, int(kb * 1024 / 4))
+        tensors = [np.ones((elems,), np.float32) for _ in range(args.tensors)]
+        total = sum(t.nbytes for t in tensors)
+
+        def one_iter(it):
+            handles = [
+                e.allreduce_async(f"bench/{it}/{i}", t, average=False)
+                for i, t in enumerate(tensors)
+            ]
+            for h in handles:
+                e.synchronize(h)
+
+        for w in range(args.warmup):
+            one_iter(f"w{w}")
+        t0 = time.perf_counter()
+        for i in range(args.iters):
+            one_iter(i)
+        dt = (time.perf_counter() - t0) / args.iters
+        print(f"  {kb:10.1f}kB {total/1e6:8.2f}MB {dt*1e3:8.3f}ms "
+              f"{total/dt/1e6:9.1f} {total/dt/1e9:7.2f}GB/s")
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--sizes-mb", type=float, nargs="+",
                     default=[1, 4, 16, 64])
     ap.add_argument("--iters", type=int, default=20)
     ap.add_argument("--warmup", type=int, default=3)
+    ap.add_argument("--engine", action="store_true",
+                    help="measure the background-engine (host/async) path "
+                         "instead of the compiled in-SPMD path")
+    ap.add_argument("--sizes-kb", type=float, nargs="+",
+                    default=[1, 16, 64, 256, 1024, 16384, 65536, 262144],
+                    help="per-tensor sizes for --engine (kB)")
+    ap.add_argument("--tensors", type=int, default=1,
+                    help="tensors submitted together per iteration "
+                         "(--engine; exercises runtime fusion)")
     ap.add_argument("--hierarchical", action="store_true",
                     help="route through reduce-scatter(ICI) -> psum(DCN) "
                          "-> all-gather(ICI) (reference: "
@@ -47,6 +101,9 @@ def main():
     if args.hierarchical:
         os.environ["HVD_HIERARCHICAL_ALLREDUCE"] = "1"
     hvd.init()
+    if args.engine:
+        run_engine(args)
+        return
     n = hvd.size()
     mesh = hvd.mesh()
     from horovod_tpu.ops.collectives import _hier_allreduce_active

@@ -60,6 +60,17 @@ _IDLE_BACKOFF_CAP_S = float(os.environ.get(
 
 OPS = ("allreduce", "allgather", "broadcast")
 
+# (namespace, key) pairs left behind by closed coordinators of earlier
+# generations (final round keys + tombstones, ≤3 per generation). A
+# lagging peer may still need them, so deletion is deferred until the next
+# generation's first successful round proves every peer has moved on.
+# Entries sharing the reclaimer's own namespace are skipped: production
+# generations always get fresh namespaces (make_coordinator), so a
+# same-namespace entry means an unrelated world (unit tests) — deleting
+# would race its live rounds.
+_residue: List[Tuple[str, str]] = []
+_residue_lock = threading.Lock()
+
 
 def negotiation_enabled() -> bool:
     """HVD_NEGOTIATION=0 disables the protocol (multi-controller runs then
@@ -335,10 +346,24 @@ class Coordinator:
 
     def close(self):
         """Publish the shutdown tombstone (peers blocked on our next round
-        key discover it between poll slices)."""
+        key discover it between poll slices).
+
+        Keys this generation leaves behind — the final round key(s) and
+        the tombstone — cannot be deleted here: a lagging peer may still
+        need them to finish its round or fail fast. They are recorded as
+        residue and reclaimed by the NEXT generation's first successful
+        round (every peer publishing the new generation's round 0 proves
+        the old generation is fully consumed everywhere). Only the last
+        generation's ≤3 keys outlive the job's final engine."""
         if self._closed:
             return
         self._closed = True
+        with _residue_lock:
+            _residue.append((self.ns, self._tomb_key(self.pid)))
+            _residue.append((self.ns, self._round_key(self.round, self.pid)))
+            if self.round > 0:
+                _residue.append(
+                    (self.ns, self._round_key(self.round - 1, self.pid)))
         try:
             self.kv.set(self._tomb_key(self.pid), str(self.round))
         except Exception:
@@ -411,6 +436,17 @@ class Coordinator:
         # fully consumed — reclaim ours.
         if rnd > 0:
             self.kv.delete(self._round_key(rnd - 1, self.pid))
+        elif rnd == 0:
+            # Every peer is in THIS generation now, so no one can ever
+            # read a prior generation's keys again — reclaim the residue
+            # its close() recorded (round keys + tombstones).
+            with _residue_lock:
+                stale = [k for ns, k in _residue if ns != self.ns]
+                # Same-namespace entries stay queued for a future
+                # different-namespace generation to reclaim.
+                _residue[:] = [e for e in _residue if e[0] == self.ns]
+            for key in stale:
+                self.kv.delete(key)
 
         cycle_s, fusion = (params if params else
                            (self.cycle_time_s, self.fusion_threshold))

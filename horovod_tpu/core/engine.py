@@ -81,7 +81,16 @@ class _Handle:
 class JaxExecutor:
     """Data plane: host numpy buffers → eager XLA collectives over the mesh
     (reference analogue: PerformOperation's MPI/NCCL calls,
-    operations.cc:1401-1531)."""
+    operations.cc:1401-1531).
+
+    When ``measure_staging`` is on (set by the engines while a timeline is
+    being recorded), each call times the host→device staging step and
+    leaves it in ``last_stage_s`` — the engines turn it into the
+    ``WAIT_FOR_DATA`` span the reference records while waiting for input
+    data to become available (operations.cc:783-807)."""
+
+    measure_staging = False
+    last_stage_s = 0.0
 
     @staticmethod
     def _ctx(arr: np.ndarray):
@@ -95,29 +104,73 @@ class JaxExecutor:
             return jax.enable_x64()
         return contextlib.nullcontext()
 
-    def allreduce(self, flat: np.ndarray, average: bool) -> np.ndarray:
+    def _stage(self, arr: np.ndarray):
+        """Host→device transfer (the WAIT_FOR_DATA phase)."""
         import jax.numpy as jnp
 
+        if not self.measure_staging:
+            self.last_stage_s = 0.0
+            return jnp.asarray(arr)
+        t0 = time.perf_counter()
+        staged = jnp.asarray(arr)
+        try:
+            staged.block_until_ready()
+        except Exception:
+            pass
+        self.last_stage_s = time.perf_counter() - t0
+        return staged
+
+    # Fused-buffer execution granularity. Runtime fusion concatenates
+    # whatever happened to share a cycle, so raw lengths are effectively
+    # unique — every length would recompile the eager collective program.
+    # Executing in fixed CHUNK-sized slices plus one pow2-bucketed tail
+    # bounds the program count to ~12 per dtype (and chunking large
+    # buffers also keeps any one staging transfer bounded).
+    CHUNK_ELEMS = 1 << 22  # 16 MB of f32 — ~the reference's fusion scale
+
+    @staticmethod
+    def _bucket(n: int) -> int:
+        """Round a tail length up to the next power of two (≥1 KiB of
+        elements): ≤11 distinct tail programs below CHUNK_ELEMS."""
+        return max(1024, 1 << (n - 1).bit_length())
+
+    def allreduce(self, flat: np.ndarray, average: bool) -> np.ndarray:
         from horovod_tpu.ops import collectives as C
 
+        n = flat.shape[0]
+        out = np.empty_like(flat)
+        stage_s = 0.0
         with self._ctx(flat):
-            return np.asarray(C.allreduce(jnp.asarray(flat), average=average))
+            off = 0
+            while off < n:
+                take = min(self.CHUNK_ELEMS, n - off)
+                chunk = flat[off: off + take]
+                bucket = (take if take == self.CHUNK_ELEMS
+                          else self._bucket(take))
+                if bucket != take:
+                    # Zero padding is reduction-neutral (sum of zeros;
+                    # average divides by world size only).
+                    chunk = np.concatenate(
+                        [chunk, np.zeros((bucket - take,), flat.dtype)])
+                res = np.asarray(
+                    C.allreduce(self._stage(chunk), average=average))
+                stage_s += self.last_stage_s
+                out[off: off + take] = res[:take]
+                off += take
+        self.last_stage_s = stage_s
+        return out
 
     def allgather(self, tensor: np.ndarray) -> np.ndarray:
-        import jax.numpy as jnp
-
         from horovod_tpu.ops import collectives as C
 
         with self._ctx(tensor):
-            return np.asarray(C.allgather(jnp.asarray(tensor)))
+            return np.asarray(C.allgather(self._stage(tensor)))
 
     def broadcast(self, tensor: np.ndarray, root_rank: int) -> np.ndarray:
-        import jax.numpy as jnp
-
         from horovod_tpu.ops import collectives as C
 
         with self._ctx(tensor):
-            return np.asarray(C.broadcast(jnp.asarray(tensor), root_rank))
+            return np.asarray(C.broadcast(self._stage(tensor), root_rank))
 
 
 def _multi_controller() -> bool:
@@ -219,6 +272,10 @@ class Engine:
         self.stall_check_disabled = stall_warning_s == 0.0
         self.executor = executor or JaxExecutor()
         self.timeline = timeline if timeline is not None else tl.from_env()
+        if self.timeline.enabled:
+            # Staging time feeds the WAIT_FOR_DATA spans; only measured
+            # (it costs a device sync) while a timeline is recording.
+            self.executor.measure_staging = True
         self._param_manager = make_autotuner(self)
         self._queue: "queue.Queue[_Entry]" = queue.Queue()
         self._handles: Dict[int, _Handle] = {}
@@ -322,6 +379,11 @@ class Engine:
             if sleep > 0:
                 self._wake.wait(sleep)
             self._wake.clear()
+        # The loop may have built the coordinator after shutdown() checked
+        # for one — publish the tombstone here too so peers never wait out
+        # the full negotiation timeout on a cleanly exiting process.
+        if self._coordinator is not None:
+            self._coordinator.close()
         # Fail whatever is left (reference: operations.cc:1833-1848).
         self._drain_with_error(ShutdownError("Horovod engine has been shut down"))
 
@@ -400,9 +462,16 @@ class Engine:
         try:
             decision = c.negotiate(metas)
         except Exception as exc:
-            err = (ShutdownError(str(exc))
-                   if isinstance(exc, coord.PeerShutdown)
-                   else EngineError(str(exc)))
+            # Post-poison rounds re-raise KVError(self.dead) whose message
+            # still names the peer shutdown — map by substring exactly like
+            # the native engine does (native_engine.synchronize), so both
+            # twins raise ShutdownError for every completion after a peer
+            # shut down, not just the first batch.
+            msg = str(exc)
+            shutdownish = (isinstance(exc, coord.PeerShutdown)
+                           or "shut down" in msg       # peer tombstone
+                           or "shutting down" in msg)  # local shutdown
+            err = ShutdownError(msg) if shutdownish else EngineError(msg)
             for e in self._negotiating:
                 self.timeline.end(e.name, f"NEGOTIATE_{e.op.upper()}")
                 self._complete(e, None, err)
@@ -480,54 +549,65 @@ class Engine:
             if batch:
                 self._exec_allreduce_batch(batch)
 
+    def _emit_exec_spans(self, entries, activity, t0_us):
+        """Retro-emit WAIT_FOR_DATA (host→device staging, reference:
+        operations.cc:783-807) + the op activity for one executor call.
+        The executor measured its own staging time; the split point lands
+        between the two spans."""
+        t1 = self.timeline.now_us()
+        stage_us = int(getattr(self.executor, "last_stage_s", 0.0) * 1e6)
+        split = min(t0_us + stage_us, t1)
+        for e in entries:
+            self.timeline.start(e.name, tl.WAIT_FOR_DATA, ts_us=t0_us)
+            self.timeline.end(e.name, tl.WAIT_FOR_DATA, ts_us=split)
+            self.timeline.start(e.name, activity,
+                                {"dtype": str(e.tensor.dtype),
+                                 "shape": list(e.tensor.shape)}, ts_us=split)
+            self.timeline.end(e.name, activity, ts_us=t1)
+
     def _exec_allreduce_batch(self, batch):
         names = [e.name for e in batch]
+        fused = len(batch) > 1
         try:
-            if len(batch) == 1:
-                e = batch[0]
-                self.timeline.start(e.name, tl.ALLREDUCE,
-                                    {"dtype": str(e.tensor.dtype),
-                                     "shape": list(e.tensor.shape)})
-                flat = e.tensor.reshape(-1)
-                if e.prescale != 1.0:
-                    flat = flat * e.prescale
-                out = self.executor.allreduce(flat, e.average)
-                self.timeline.end(e.name, tl.ALLREDUCE)
-                self._complete(e, out.reshape(e.tensor.shape), None)
-                return
-            for n in names:
-                self.timeline.start(n, tl.MEMCPY_IN_FUSION_BUFFER)
-            flat = np.concatenate(
-                [(e.tensor.reshape(-1) * e.prescale if e.prescale != 1.0
-                  else e.tensor.reshape(-1)) for e in batch]
-            )
-            for e in batch:
-                self.timeline.end(e.name, tl.MEMCPY_IN_FUSION_BUFFER)
-                self.timeline.start(e.name, tl.ALLREDUCE,
-                                    {"dtype": str(e.tensor.dtype),
-                                     "shape": list(e.tensor.shape)})
+            if fused:
+                for n in names:
+                    self.timeline.start(n, tl.MEMCPY_IN_FUSION_BUFFER)
+                flat = np.concatenate(
+                    [(e.tensor.reshape(-1) * e.prescale if e.prescale != 1.0
+                      else e.tensor.reshape(-1)) for e in batch]
+                )
+                for n in names:
+                    self.timeline.end(n, tl.MEMCPY_IN_FUSION_BUFFER)
+            else:
+                flat = batch[0].tensor.reshape(-1)
+                if batch[0].prescale != 1.0:
+                    flat = flat * batch[0].prescale
+            t0 = self.timeline.now_us()
             out = self.executor.allreduce(flat, batch[0].average)
+            self._emit_exec_spans(batch, tl.ALLREDUCE, t0)
             off = 0
             for e in batch:
                 n = e.tensor.size
-                self.timeline.end(e.name, tl.ALLREDUCE)
-                self._complete(e, out[off: off + n].reshape(e.tensor.shape), None)
+                if fused:
+                    self.timeline.start(e.name, tl.MEMCPY_OUT_FUSION_BUFFER)
+                result = out[off: off + n].reshape(e.tensor.shape)
+                if fused:
+                    self.timeline.end(e.name, tl.MEMCPY_OUT_FUSION_BUFFER)
+                self._complete(e, result, None)
                 off += n
         except Exception as exc:  # surfaced at synchronize()
             for e in batch:
                 self._complete(e, None, EngineError(str(exc)))
 
     def _exec_single(self, e: _Entry):
-        args = {"dtype": str(e.tensor.dtype), "shape": list(e.tensor.shape)}
         try:
+            t0 = self.timeline.now_us()
             if e.op == "allgather":
-                self.timeline.start(e.name, tl.ALLGATHER, args)
                 out = self.executor.allgather(e.tensor)
-                self.timeline.end(e.name, tl.ALLGATHER)
+                self._emit_exec_spans([e], tl.ALLGATHER, t0)
             elif e.op == "broadcast":
-                self.timeline.start(e.name, tl.BROADCAST, args)
                 out = self.executor.broadcast(e.tensor, e.root_rank)
-                self.timeline.end(e.name, tl.BROADCAST)
+                self._emit_exec_spans([e], tl.BROADCAST, t0)
             else:
                 raise EngineError(f"unknown op {e.op}")
             self._complete(e, out, None)
@@ -598,6 +678,12 @@ class Engine:
         self._shutdown.set()
         self._wake.set()  # break an idle sleep immediately
         self._thread.join(timeout=5)
+        # If the loop thread was inside _maybe_build_coordinator when the
+        # check above ran, the coordinator exists only now. Close it again:
+        # a blocked negotiate() aborts at its next poll slice once _closed
+        # is set (close() is idempotent), and the tombstone is published.
+        if self._coordinator is not None:
+            self._coordinator.close()
         with self._lock:
             handles = list(self._handles.values())
             self._handles.clear()

@@ -68,6 +68,9 @@ class HvdResult(ctypes.Structure):
         ("nbytes", ctypes.c_longlong),
         ("ndim", ctypes.c_int),
         ("shape", ctypes.c_longlong * 8),
+        # Executor-measured host->device staging seconds; the engine turns
+        # it into the WAIT_FOR_DATA timeline span.
+        ("stage_s", ctypes.c_double),
         ("error", ctypes.c_char * 256),
     ]
 

@@ -87,6 +87,10 @@ struct hvd_result {
   long long nbytes;
   int ndim;
   long long shape[8];
+  // Host->device staging seconds inside the executor (only measured while
+  // a timeline is recording): the engine splits it out of the call span as
+  // the WAIT_FOR_DATA phase (reference: operations.cc:783-807).
+  double stage_s;
   char error[256];
 };
 
@@ -164,10 +168,24 @@ class Timeline {
   // (e.g. dtype/shape — reference: timeline.cc:98-188 WriteEvent args).
   void Begin(const std::string& name, const char* phase,
              const std::string& args = "") {
-    Emit(name, phase, 'B', args);
+    Emit(name, phase, 'B', args, -1);
   }
   void End(const std::string& name, const char* phase) {
-    Emit(name, phase, 'E', "");
+    Emit(name, phase, 'E', "", -1);
+  }
+
+  // Retro-emission at explicit timestamps: a phase boundary learned only
+  // after the fact (WAIT_FOR_DATA split out of an executor round-trip).
+  void BeginAt(const std::string& name, const char* phase, long long ts_us,
+               const std::string& args = "") {
+    Emit(name, phase, 'B', args, ts_us);
+  }
+  void EndAt(const std::string& name, const char* phase, long long ts_us) {
+    Emit(name, phase, 'E', "", ts_us);
+  }
+
+  long long NowUs() {
+    return active_ ? (long long)(SecondsSince(start_) * 1e6) : 0;
   }
 
   void Close() {
@@ -188,11 +206,12 @@ class Timeline {
   }
 
   void Emit(const std::string& name, const char* phase, char ph,
-            const std::string& args) {
+            const std::string& args, long long ts_us) {
     if (!active_) return;
     std::lock_guard<std::mutex> g(mu_);
     if (!active_) return;
-    long long ts = (long long)(SecondsSince(start_) * 1e6);
+    long long ts =
+        ts_us >= 0 ? ts_us : (long long)(SecondsSince(start_) * 1e6);
     int pid;
     auto it = lanes_.find(name);
     if (it == lanes_.end()) {
@@ -460,18 +479,15 @@ class Engine {
         std::unique_lock<std::mutex> lk(mu_);
         double cycle = cycle_s_ + extra_wait_;
         extra_wait_ = 0.0;
-        bool active = neg_active_ && neg_fn_ != nullptr;
-        if (active) {
-          // Rounds must tick even with nothing local to submit: peers
-          // block on our round message (reference: every rank gathers a
-          // possibly-empty request list each tick, operations.cc:2117).
-          // A fresh enqueue still cuts an idle-backoff stretch short.
-          cv_.wait_for(lk, std::chrono::duration<double>(cycle),
-                       [&] { return shutdown_ || !queue_.empty(); });
-        } else {
-          cv_.wait_for(lk, std::chrono::duration<double>(cycle),
-                       [&] { return shutdown_ || !queue_.empty(); });
-        }
+        // One wait serves both modes. Negotiated mode must tick rounds
+        // even with nothing local to submit — peers block on our round
+        // message (reference: every rank gathers a possibly-empty request
+        // list each tick, operations.cc:2117) — and its idle pacing comes
+        // from the control plane's 'w' backoff folded into `cycle` above,
+        // not from a different wait here. A fresh enqueue or shutdown
+        // cuts either mode's sleep short.
+        cv_.wait_for(lk, std::chrono::duration<double>(cycle),
+                     [&] { return shutdown_ || !queue_.empty(); });
         // On shutdown, leave queued entries for the failure drain below:
         // executing them could call into Python during teardown.
         if (shutdown_) break;
@@ -542,7 +558,14 @@ class Engine {
       }
       table += "],\"a\":" + std::to_string(e.average);
       table += ",\"r\":" + std::to_string(e.root_rank);
-      table += ",\"p\":" + std::to_string(e.prescale);
+      // %.17g round-trips the double exactly; std::to_string's fixed 6
+      // decimals would collapse small prescales to 0 and fingerprint
+      // differently from the python twin's full-precision JSON floats
+      // (a spurious "Mismatched reduction options" across mixed engines).
+      char pbuf[32];
+      snprintf(pbuf, sizeof(pbuf), "%.17g", e.prescale);
+      table += ",\"p\":";
+      table += pbuf;
       table += ",\"t\":" + std::to_string(SecondsSince(e.enqueued));
       table += ",\"b\":" + std::to_string((long long)e.data.size()) + "}";
     }
@@ -745,13 +768,23 @@ class Engine {
     req.ndim = 1;
     req.shape[0] = total;
     hvd_result res{};
-    if (timeline_.Active())
-      for (auto* e : batch)
-        timeline_.Begin(e->name, "ALLREDUCE",
-                        TensorArgs(e->dtype_num, e->shape));
+    long long t0 = timeline_.NowUs();
     int rc = CallExecutor(&req, &res);
-    if (timeline_.Active())
-      for (auto* e : batch) timeline_.End(e->name, "ALLREDUCE");
+    if (timeline_.Active()) {
+      // WAIT_FOR_DATA = the host->device staging slice the executor
+      // measured; the rest of the round-trip is the collective proper
+      // (reference: operations.cc:783-807 then the MPI/NCCL op).
+      long long t1 = timeline_.NowUs();
+      long long split = t0 + (long long)(res.stage_s * 1e6);
+      if (split > t1) split = t1;
+      for (auto* e : batch) {
+        timeline_.BeginAt(e->name, "WAIT_FOR_DATA", t0);
+        timeline_.EndAt(e->name, "WAIT_FOR_DATA", split);
+        timeline_.BeginAt(e->name, "ALLREDUCE", split,
+                          TensorArgs(e->dtype_num, e->shape));
+        timeline_.EndAt(e->name, "ALLREDUCE", t1);
+      }
+    }
     if (rc != 0) {
       for (auto* e : batch) Complete(*e, nullptr, 0, nullptr, res.error);
       return;
@@ -765,7 +798,8 @@ class Engine {
     off = 0;
     for (auto* e : batch) {
       Complete(*e, (char*)res.data + off, (long long)e->data.size(),
-               &e->shape, nullptr);
+               &e->shape, nullptr,
+               batch.size() > 1 ? "MEMCPY_OUT_FUSION_BUFFER" : nullptr);
       off += (long long)e->data.size();
     }
     if (res.data && res.data != req.data) free(res.data);
@@ -787,10 +821,17 @@ class Engine {
       req.shape[i] = e.shape[i];
     const char* phase = e.op == HVD_ALLGATHER ? "ALLGATHER" : "BROADCAST";
     hvd_result res{};
-    if (timeline_.Active())
-      timeline_.Begin(e.name, phase, TensorArgs(e.dtype_num, e.shape));
+    long long t0 = timeline_.NowUs();
     int rc = CallExecutor(&req, &res);
-    if (timeline_.Active()) timeline_.End(e.name, phase);
+    if (timeline_.Active()) {
+      long long t1 = timeline_.NowUs();
+      long long split = t0 + (long long)(res.stage_s * 1e6);
+      if (split > t1) split = t1;
+      timeline_.BeginAt(e.name, "WAIT_FOR_DATA", t0);
+      timeline_.EndAt(e.name, "WAIT_FOR_DATA", split);
+      timeline_.BeginAt(e.name, phase, split, TensorArgs(e.dtype_num, e.shape));
+      timeline_.EndAt(e.name, phase, t1);
+    }
     if (rc != 0) {
       Complete(e, nullptr, 0, nullptr, res.error);
       return;
@@ -800,8 +841,12 @@ class Engine {
     if (res.data && res.data != req.data) free(res.data);
   }
 
+  // `copy_phase` (e.g. MEMCPY_OUT_FUSION_BUFFER) wraps just the result
+  // copy-out so the span nests inside the still-open QUEUE span
+  // (reference: out-copy spans, operations.cc:1359-1374).
   void Complete(Entry& e, const char* data, long long nbytes,
-                const std::vector<long long>* shape, const char* error) {
+                const std::vector<long long>* shape, const char* error,
+                const char* copy_phase = nullptr) {
     std::shared_ptr<HandleState> hs;
     {
       std::lock_guard<std::mutex> g(mu_);
@@ -813,8 +858,11 @@ class Engine {
     if (error) {
       hs->error = error;
     } else {
+      bool trace_copy = copy_phase && timeline_.Active();
+      if (trace_copy) timeline_.Begin(e.name, copy_phase);
       hs->result.assign(data, data + nbytes);
       if (shape) hs->shape = *shape;
+      if (trace_copy) timeline_.End(e.name, copy_phase);
     }
     if (timeline_.Active()) timeline_.End(e.name, "QUEUE");
     {

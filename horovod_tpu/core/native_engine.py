@@ -122,6 +122,7 @@ def _make_callback(executor):
             buf = np.frombuffer(
                 (ctypes.c_char * nbytes).from_address(req.data),
                 dtype=dtype).copy()
+            executor.last_stage_s = 0.0
             if req.op == 0:  # allreduce (possibly fused)
                 if req.prescale != 1.0:
                     buf = buf * req.prescale
@@ -153,6 +154,8 @@ def _make_callback(executor):
                     res.shape[i] = s
             else:
                 raise ValueError(f"unknown op {req.op}")
+            # Staging time the executor measured (WAIT_FOR_DATA span).
+            res.stage_s = float(getattr(executor, "last_stage_s", 0.0))
             return 0
         except Exception as exc:  # surfaced at synchronize()
             msg = str(exc).encode()[:255]
@@ -178,6 +181,10 @@ class NativeEngine:
 
         self._lib = native.load_library()
         self._executor = executor or JaxExecutor()
+        if timeline_path:
+            # Staging time feeds the WAIT_FOR_DATA spans; only measured
+            # (it costs a device sync) while a timeline is recording.
+            self._executor.measure_staging = True
         self._cb = _make_callback(self._executor)  # keep trampoline alive
         self._ptr = self._lib.hvd_engine_create(
             float(self.cycle_time_s), int(self.fusion_threshold),

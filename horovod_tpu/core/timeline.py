@@ -65,24 +65,32 @@ class Timeline:
             self._fh.flush()
             self._last_flush = now
 
+    def now_us(self) -> int:
+        """Current timeline clock, for retro-emitted spans (a caller that
+        learns a phase boundary only after the fact — e.g. WAIT_FOR_DATA
+        split out of an executor round-trip — records explicit ts)."""
+        return self._ts_us() if self.enabled else 0
+
     def _event(self, phase: str, tensor: str, activity: str,
-               args: Optional[dict]):
+               args: Optional[dict], ts_us: Optional[int] = None):
         if not self.enabled:
             return
         with self._lock:
             if self._fh is None:  # closed between the check and the lock
                 return
             ev = {"name": activity, "ph": phase, "pid": self._pid(tensor),
-                  "ts": self._ts_us()}
+                  "ts": self._ts_us() if ts_us is None else ts_us}
             if args:
                 ev["args"] = args
             self._emit(ev)
 
-    def start(self, tensor: str, activity: str, args: Optional[dict] = None):
-        self._event("B", tensor, activity, args)
+    def start(self, tensor: str, activity: str, args: Optional[dict] = None,
+              ts_us: Optional[int] = None):
+        self._event("B", tensor, activity, args, ts_us)
 
-    def end(self, tensor: str, activity: str, args: Optional[dict] = None):
-        self._event("E", tensor, activity, args)
+    def end(self, tensor: str, activity: str, args: Optional[dict] = None,
+            ts_us: Optional[int] = None):
+        self._event("E", tensor, activity, args, ts_us)
 
     def close(self):
         if not self.enabled:

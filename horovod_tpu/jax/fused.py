@@ -1,0 +1,169 @@
+"""Trace-time fusion of the optimizer update — tensor fusion (reference:
+horovod/common/fusion_buffer_manager.cc, operations.cc:2035-2074) applied
+to the *parameter update* instead of the wire.
+
+Why this exists: a ResNet-50 step updates ~160 parameter tensors, ~110 of
+them tiny (BN scales/biases are 64-2048 floats). XLA lowers one fusion per
+tensor, and on TPU each carries a fixed dispatch + HBM round-trip cost.
+Concatenating the small ones of each dtype into a single flat vector turns
+~110 launches into a couple of big bandwidth-bound fusions — the
+economics of the reference's 64 MB fusion buffer, resolved at compile
+time.
+
+Why only the SMALL ones: large tensors gain nothing from packing (they
+are already bandwidth-bound) and lose a lot — XLA fuses a weight-grad
+convolution directly into its momentum/param update when the update
+consumes the conv's output per-tensor; routing it through a concatenated
+buffer severs that producer-consumer fusion and adds a full extra
+HBM round-trip per step (measured: whole-tree packing REGRESSED ResNet-50
+bs32 from 12.1 to 13.5 ms/step; small-only packing is the win). The
+``threshold_elems`` knob is the compile-time analogue of the reference's
+runtime fusion-threshold byte knob.
+
+Correctness domain: any *elementwise* gradient transformation — one where
+the update for element ``i`` depends only on gradient/state element ``i``
+(sgd, momentum, adam(w), rmsprop, lion, ...). Global-norm clipping also
+composes (the norm is global either way). Transforms that inspect
+per-parameter *shapes* (adafactor's factored second moments, layerwise
+LARS/LAMB trust ratios) must keep the unfused path.
+"""
+
+from __future__ import annotations
+
+from typing import Any, NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+import optax
+
+DEFAULT_THRESHOLD_ELEMS = 4096
+
+
+class _FusedLayout(NamedTuple):
+    """Static description of how leaves pack into per-dtype buffers."""
+
+    treedef: Any
+    dtypes: tuple            # leaf dtype names, flatten order
+    shapes: tuple            # leaf shapes, flatten order
+    group_keys: tuple        # sorted dtype-name keys, one buffer each
+    # per leaf: (group key, offset) for packed leaves, or None for
+    # passthrough (large) leaves
+    slots: tuple
+
+
+def _nelems(shp) -> int:
+    n = 1
+    for d in shp:
+        n *= d
+    return n
+
+
+def _layout_of(tree, threshold: int) -> _FusedLayout:
+    leaves, treedef = jax.tree_util.tree_flatten(tree)
+    dtypes = tuple(jnp.asarray(l).dtype.name for l in leaves)
+    shapes = tuple(tuple(jnp.shape(l)) for l in leaves)
+    offsets: dict = {}
+    slots = []
+    for dt, shp in zip(dtypes, shapes):
+        n = _nelems(shp)
+        if n >= threshold:
+            slots.append(None)
+            continue
+        off = offsets.get(dt, 0)
+        slots.append((dt, off))
+        offsets[dt] = off + n
+    return _FusedLayout(treedef, dtypes, shapes,
+                        tuple(sorted(offsets)), tuple(slots))
+
+
+def _pack(tree, layout: _FusedLayout, cast_small: bool = False):
+    """Pytree → ``{"buf": {dtype_name: flat vector}, "big": [leaves]}``.
+    ``cast_small`` casts packed leaves to the layout dtype (gradients of
+    bf16-computed small params join the parameter-dtype buffer — standard
+    master-weight mixed precision)."""
+    leaves = jax.tree_util.tree_leaves(tree)
+    groups: dict = {k: [] for k in layout.group_keys}
+    big = []
+    for i, leaf in enumerate(leaves):
+        slot = layout.slots[i]
+        if slot is None:
+            big.append(leaf)
+            continue
+        dt = slot[0]
+        leaf = jnp.asarray(leaf, dt) if cast_small else jnp.asarray(leaf)
+        groups[dt].append(leaf.ravel())
+    return {
+        "buf": {k: (jnp.concatenate(v) if len(v) > 1 else v[0])
+                for k, v in groups.items() if v},
+        "big": big,
+    }
+
+
+def _unpack(packed, layout: _FusedLayout):
+    """Inverse of :func:`_pack`: rebuild the original pytree."""
+    leaves = []
+    big = iter(packed["big"])
+    for slot, shp in zip(layout.slots, layout.shapes):
+        if slot is None:
+            leaves.append(next(big))
+            continue
+        dt, off = slot
+        n = _nelems(shp)
+        leaves.append(packed["buf"][dt][off: off + n].reshape(shp))
+    return jax.tree_util.tree_unflatten(layout.treedef, leaves)
+
+
+def fuse(optimizer: optax.GradientTransformation,
+         threshold_elems: int = DEFAULT_THRESHOLD_ELEMS,
+         ) -> optax.GradientTransformationExtraArgs:
+    """Wrap an elementwise optax transform so tensors smaller than
+    ``threshold_elems`` update through per-dtype fused buffers (see module
+    docstring); larger tensors keep their per-tensor path, preserving
+    XLA's grad-producer→update fusion.
+
+    The optimizer state becomes the wrapped transform's state over the
+    packed structure (small-tensor momenta fuse too). ``update`` accepts
+    ``params``; ``**extra_args`` are forwarded UNCHANGED (transforms whose
+    extra args mirror the parameter tree need the unfused path).
+    """
+    optimizer = optax.with_extra_args_support(optimizer)
+    # init()'s layout is keyed by PARAM dtypes; update() must reuse it even
+    # when called without params (standard optax convention) — a layout
+    # recomputed from grads would group by GRAD dtype and mismatch the
+    # state structure whenever the two differ (bf16 grads, f32 masters).
+    # Keyed by (treedef, shapes) so one fuse()d transform serves several
+    # param trees.
+    layouts: dict = {}
+
+    def _layout_key(tree):
+        leaves, treedef = jax.tree_util.tree_flatten(tree)
+        return treedef, tuple(tuple(jnp.shape(l)) for l in leaves)
+
+    def _remember(tree):
+        key = _layout_key(tree)
+        layout = layouts.get(key)
+        if layout is None:
+            layout = layouts[key] = _layout_of(tree, threshold_elems)
+        return layout
+
+    def init(params):
+        return optimizer.init(_pack(params, _remember(params)))
+
+    def update(grads, state, params=None, **extra_args):
+        if params is not None:
+            layout = _remember(params)
+        else:
+            # grads share the params' treedef/shapes; the cached layout
+            # (param dtypes) is found by that key, with a grads-derived
+            # fallback when init ran in another process.
+            layout = layouts.get(_layout_key(grads)) or _remember(grads)
+        # Small grads join the parameter-dtype buffers (bf16 compute
+        # grads meet f32 master weights here, like the reference's fp16
+        # compression decompressing into f32 before apply).
+        pgrads = _pack(grads, layout, cast_small=True)
+        pparams = None if params is None else _pack(params, layout)
+        pupd, new_state = optimizer.update(pgrads, state, pparams,
+                                           **extra_args)
+        return _unpack(pupd, layout), new_state
+
+    return optax.GradientTransformationExtraArgs(init, update)

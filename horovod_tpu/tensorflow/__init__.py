@@ -59,13 +59,54 @@ def broadcast_variables(variables, root_rank: int = 0):
         var.assign(broadcast(tf.convert_to_tensor(var), root_rank))
 
 
+def bcast(root_rank: int, variables):
+    """Graph-mode broadcast op over explicit variables (reference:
+    horovod/tensorflow/__init__.py:106-115). Returns a grouped assign op
+    to ``session.run``; under eager execution the assigns run immediately
+    and the group is a no-op tensor."""
+    v1 = tf.compat.v1
+    return tf.group(*[v1.assign(var, broadcast(
+        tf.convert_to_tensor(var), root_rank)) for var in variables])
+
+
 def broadcast_global_variables(root_rank: int = 0):
-    """TF1-style parity name; in TF2 pass explicit variables to
-    :func:`broadcast_variables`."""
-    raise NotImplementedError(
-        "TF2 has no global variable collection; call "
-        "broadcast_variables(model.variables, root_rank) instead "
-        "(reference API: horovod/tensorflow/__init__.py:96-115)")
+    """Broadcast all global variables from ``root_rank`` (reference:
+    horovod/tensorflow/__init__.py:96-104).
+
+    Works whenever a ``tf.compat.v1`` graph/collection holds the
+    variables — i.e. the reference's session-era scripts run unmodified.
+    Pure-eager TF2 code has no global collection; pass explicit variables
+    to :func:`broadcast_variables` instead."""
+    gvars = tf.compat.v1.global_variables()
+    if not gvars:
+        raise NotImplementedError(
+            "no tf.compat.v1 global-variable collection exists (pure-eager "
+            "TF2); call broadcast_variables(model.variables, root_rank) "
+            "instead (reference API: horovod/tensorflow/__init__.py:96-115)")
+    return bcast(root_rank, gvars)
+
+
+class BroadcastGlobalVariablesHook(tf.compat.v1.train.SessionRunHook):
+    """SessionRunHook broadcasting all global variables from root once the
+    session is created — the reference's startup-consistency hook for
+    MonitoredTrainingSession scripts (reference:
+    horovod/tensorflow/__init__.py:118-149). ``device`` is accepted for
+    signature parity; collectives always ride the XLA mesh here."""
+
+    def __init__(self, root_rank: int, device: str = ""):
+        super().__init__()
+        self.root_rank = root_rank
+        self.bcast_op = None
+        self.device = device
+
+    def begin(self):
+        if (self.bcast_op is None
+                or self.bcast_op.graph is not tf.compat.v1.get_default_graph()):
+            with tf.device(self.device or "/cpu:0"):
+                self.bcast_op = broadcast_global_variables(self.root_rank)
+
+    def after_create_session(self, session, coord):
+        session.run(self.bcast_op)
 
 
 class BroadcastGlobalVariablesCallback(tf.keras.callbacks.Callback):
@@ -120,7 +161,12 @@ def DistributedOptimizer(optimizer, name: Optional[str] = None,
     """Wrap a keras optimizer so gradients are allreduced before being
     applied (reference: horovod/tensorflow/__init__.py:152-250 — there it
     overrides compute_gradients; TF2's integration point is
-    apply_gradients)."""
+    apply_gradients). Session-era ``tf.compat.v1.train`` optimizers are
+    wrapped at compute_gradients exactly like the reference, so v1 graph
+    scripts (e.g. the reference's tensorflow_mnist.py) run unmodified."""
+    if isinstance(optimizer, tf.compat.v1.train.Optimizer):
+        return _distributed_v1_optimizer(optimizer, average, compression,
+                                         sparse_as_dense)
 
     class _Distributed(optimizer.__class__):
         _hvd_wrapped = True
@@ -143,3 +189,33 @@ def DistributedOptimizer(optimizer, name: Optional[str] = None,
     # apply_gradients (keras 3 semantics). Wrap BEFORE any training, as the
     # reference requires (its optimizer is likewise wrapped pre-training).
     return _Distributed.from_config(optimizer.get_config())
+
+
+def _distributed_v1_optimizer(optimizer, average, compression,
+                              sparse_as_dense):
+    """Dynamic subclass of a v1 optimizer overriding compute_gradients —
+    the reference's integration point (horovod/tensorflow/__init__.py:
+    152-250): minimize() calls compute_gradients, each gradient gets an
+    allreduce node, apply_gradients consumes the reduced values."""
+
+    class _DistributedV1(optimizer.__class__):
+        _hvd_wrapped = True
+
+        def __init__(self):
+            # State was fully built by the user's constructor; reuse it.
+            self.__dict__.update(optimizer.__dict__)
+
+        def compute_gradients(self, *args, **kwargs):
+            gradients = super().compute_gradients(*args, **kwargs)
+            out = []
+            for grad, var in gradients:
+                if grad is None:
+                    out.append((None, var))
+                    continue
+                if isinstance(grad, tf.IndexedSlices) and sparse_as_dense:
+                    grad = tf.convert_to_tensor(grad)
+                out.append((allreduce(grad, average=average,
+                                      compression=compression), var))
+            return out
+
+    return _DistributedV1()

@@ -172,6 +172,45 @@ class TestRounds:
         with pytest.raises(PeerShutdown):
             c.negotiate([meta("x")])
 
+    def test_prior_generation_residue_reclaimed(self):
+        """A closed generation's leftover keys (final rounds + tombstone)
+        are deleted once the NEXT generation completes its first round —
+        proof every peer moved on (bounded KV usage across engine
+        init/shutdown generations)."""
+        from horovod_tpu.core import coordinator as coord
+
+        store = {}
+        old = [Coordinator(LocalKV(store), 2, p, 0.001, 0, timeout_s=5.0,
+                           namespace="hvd/neg/gen-old") for p in (0, 1)]
+        results, errors = {}, {}
+
+        def round_of(cs, pid):
+            try:
+                results[(cs[pid].ns, pid)] = cs[pid].negotiate([])
+            except Exception as exc:  # pragma: no cover - surfaced below
+                errors[(cs[pid].ns, pid)] = exc
+
+        ts = [threading.Thread(target=round_of, args=(old, p)) for p in (0, 1)]
+        [t.start() for t in ts]
+        [t.join(5) for t in ts]
+        for c in old:
+            c.close()
+        assert not errors
+        assert any("gen-old" in k for k in store if isinstance(k, str))
+
+        new = [Coordinator(LocalKV(store), 2, p, 0.001, 0, timeout_s=5.0,
+                           namespace="hvd/neg/gen-new") for p in (0, 1)]
+        ts = [threading.Thread(target=round_of, args=(new, p)) for p in (0, 1)]
+        [t.start() for t in ts]
+        [t.join(5) for t in ts]
+        assert not errors
+        leftover = [k for k in store
+                    if isinstance(k, str) and "gen-old" in k]
+        assert not leftover, leftover
+        with coord._residue_lock:
+            assert not any(ns == "hvd/neg/gen-old"
+                           for ns, _ in coord._residue)
+
     def test_key_cleanup_after_rounds(self):
         store = {}
         results = [None, None]

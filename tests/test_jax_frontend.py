@@ -159,3 +159,75 @@ def test_gradient_through_spmd_collective():
     # as allreduce — tensorflow/mpi_ops.py:94-105): every rank's unit
     # cotangent flows to every rank's x with weight 1/n, summed over n ranks.
     np.testing.assert_allclose(np.asarray(g), np.ones((n, 2)), rtol=1e-6)
+
+
+def _mixed_tree(seed=0):
+    """Pytree mixing dtypes/shapes, like a real model's params."""
+    k = jax.random.PRNGKey(seed)
+    ks = jax.random.split(k, 5)
+    return {
+        "dense": {"kernel": jax.random.normal(ks[0], (8, 16), jnp.float32),
+                  "bias": jax.random.normal(ks[1], (16,), jnp.float32)},
+        "embed": jax.random.normal(ks[2], (32, 4), jnp.bfloat16),
+        "scale": jax.random.normal(ks[3], (4,), jnp.float32),
+        # Above the fuse() threshold: exercises the per-tensor passthrough
+        # beside the packed buffers.
+        "wide": jax.random.normal(ks[4], (512, 9), jnp.float32),
+    }
+
+
+@pytest.mark.parametrize("make_opt", [
+    lambda: optax.sgd(0.05, momentum=0.9),
+    lambda: optax.adam(1e-2),
+    lambda: optax.chain(optax.clip_by_global_norm(1.0), optax.sgd(0.1)),
+], ids=["sgd_momentum", "adam", "global_clip_sgd"])
+def test_fused_update_matches_unfused(make_opt):
+    """hj.fuse() collapses per-parameter updates into per-dtype buffers
+    without changing the math for elementwise transforms (and global-norm
+    clipping, which is global either way). 5 steps, mixed f32/bf16 tree."""
+    params_f = _mixed_tree()
+    params_u = _mixed_tree()
+    fused, plain = hj.fuse(make_opt()), make_opt()
+    sf, su = fused.init(params_f), plain.init(params_u)
+    for step in range(5):
+        grads = jax.tree.map(
+            lambda p: jnp.asarray(
+                np.random.RandomState(step).normal(size=p.shape), p.dtype),
+            params_u)
+        uf, sf = fused.update(grads, sf, params_f)
+        uu, su = plain.update(grads, su, params_u)
+        params_f = optax.apply_updates(params_f, uf)
+        params_u = optax.apply_updates(params_u, uu)
+    for a, b in zip(jax.tree.leaves(params_f), jax.tree.leaves(params_u)):
+        assert a.dtype == b.dtype and a.shape == b.shape
+        np.testing.assert_allclose(np.asarray(a, np.float32),
+                                   np.asarray(b, np.float32), rtol=2e-6)
+
+
+def test_distributed_optimizer_fused_update_spmd(hvd):
+    """fused_update=True inside the compiled SPMD step gives the same
+    trajectory as the default path (the profile-driven fast path for
+    bench.py; VERDICT r3 item 1)."""
+    xs, ys = _toy_data()
+    n = hj.size()
+
+    def run(fused):
+        opt = hj.DistributedOptimizer(optax.sgd(0.1, momentum=0.9),
+                                      fused_update=fused)
+        p = {"w": jnp.ones((2,)), "b": jnp.zeros(())}
+        s = opt.init(p)
+
+        @hj.jit(in_specs=(P(), P(), P("hvd", None), P("hvd")),
+                out_specs=(P(), P()))
+        def step(p, s, x, y):
+            g = jax.grad(_loss_fn)(p, x, y)
+            u, s2 = opt.update(g, s, p)
+            return optax.apply_updates(p, u), s2
+
+        for _ in range(3):
+            p, s = step(p, s, xs, ys)
+        return p
+
+    pf, pu = run(True), run(False)
+    for a, b in zip(jax.tree.leaves(pf), jax.tree.leaves(pu)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-6)
