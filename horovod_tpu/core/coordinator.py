@@ -450,7 +450,14 @@ class Coordinator:
 
         cycle_s, fusion = (params if params else
                            (self.cycle_time_s, self.fusion_threshold))
-        self.cycle_time_s, self.fusion_threshold = cycle_s, int(fusion)
+        if self.pid != 0:
+            self.cycle_time_s, self.fusion_threshold = cycle_s, int(fusion)
+        # Process 0's OWN attributes are the source of truth (the
+        # autotuner / set_params writes them): adopting the round's echo
+        # here would stomp a value set mid-round and lose it forever.
+        # The DECISION still uses the round's published params on every
+        # process — batch composition must be computed from identical
+        # inputs everywhere; a newer local value joins the next round.
         groups = decide(tables, entries, int(fusion))
         self.last_tables = {pid: {m.name for m in metas}
                             for pid, metas in tables.items()}

@@ -417,6 +417,11 @@ class Engine:
             self._coordinator.cycle_time_s = self.cycle_time_s
             self._coordinator.fusion_threshold = self.fusion_threshold
 
+    def current_params(self):
+        """(cycle_time_s, fusion_threshold) — same surface as the native
+        engine's readback."""
+        return self.cycle_time_s, self.fusion_threshold
+
     def _maybe_build_coordinator(self):
         """Lazily stand up negotiation once topology is known (the engine
         may be constructed before hvd.init())."""

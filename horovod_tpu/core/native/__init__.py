@@ -99,6 +99,9 @@ def load_library():
                                             ctypes.c_void_p]
     lib.hvd_engine_set_params.argtypes = [ctypes.c_void_p, ctypes.c_double,
                                           ctypes.c_longlong]
+    lib.hvd_engine_get_params.argtypes = [
+        ctypes.c_void_p, ctypes.POINTER(ctypes.c_double),
+        ctypes.POINTER(ctypes.c_longlong)]
     lib.hvd_engine_set_sort_by_name.argtypes = [ctypes.c_void_p,
                                                 ctypes.c_int]
     lib.hvd_engine_set_negotiator.argtypes = [ctypes.c_void_p, NEG_FN,

@@ -341,6 +341,14 @@ class Engine {
     if (fusion_bytes >= 0) fusion_bytes_ = fusion_bytes;
   }
 
+  // Readback for the Python mirror (negotiated rounds update the C++
+  // values directly via the decision's 'p' line).
+  void GetParams(double* cycle_s, long long* fusion_bytes) {
+    std::lock_guard<std::mutex> g(mu_);
+    if (cycle_s) *cycle_s = cycle_s_;
+    if (fusion_bytes) *fusion_bytes = fusion_bytes_;
+  }
+
   // Fallback ordering when negotiation is disabled: sort each drained
   // cycle by tensor name so thread-racy enqueue order within a cycle
   // cannot diverge across controller processes. Per-cycle only — this
@@ -959,6 +967,10 @@ void hvd_engine_set_executor(void* e, hvd_exec_fn fn, void* ctx) {
 
 void hvd_engine_set_params(void* e, double cycle_s, long long fusion_bytes) {
   static_cast<Engine*>(e)->SetParams(cycle_s, fusion_bytes);
+}
+
+void hvd_engine_get_params(void* e, double* cycle_s, long long* fusion_bytes) {
+  static_cast<Engine*>(e)->GetParams(cycle_s, fusion_bytes);
 }
 
 void hvd_engine_set_sort_by_name(void* e, int on) {

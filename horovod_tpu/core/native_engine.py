@@ -317,6 +317,18 @@ class NativeEngine:
             self._coordinator.cycle_time_s = self.cycle_time_s
             self._coordinator.fusion_threshold = self.fusion_threshold
 
+    def current_params(self):
+        """(cycle_time_s, fusion_threshold) as the C++ loop sees them —
+        negotiated rounds update the native values directly, so the
+        Python-side mirrors can lag."""
+        if self._ptr is None:
+            return self.cycle_time_s, self.fusion_threshold
+        cyc = ctypes.c_double()
+        fus = ctypes.c_longlong()
+        self._lib.hvd_engine_get_params(
+            self._ptr, ctypes.byref(cyc), ctypes.byref(fus))
+        return float(cyc.value), int(fus.value)
+
     def shutdown(self):
         if self._ptr is None:
             return

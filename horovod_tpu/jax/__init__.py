@@ -44,6 +44,7 @@ from horovod_tpu.ops.collectives import (  # noqa: F401
     reducescatter,
     alltoall,
     broadcast_pytree,
+    fetch,
     grouped_allreduce,
 )
 from horovod_tpu.jax.compression import Compression, Compressor  # noqa: F401

@@ -153,10 +153,14 @@ def fuse(optimizer: optax.GradientTransformation,
         if params is not None:
             layout = _remember(params)
         else:
-            # grads share the params' treedef/shapes; the cached layout
-            # (param dtypes) is found by that key, with a grads-derived
-            # fallback when init ran in another process.
-            layout = layouts.get(_layout_key(grads)) or _remember(grads)
+            # grads share the params' treedef/shapes, so init()'s cached
+            # layout (param dtypes) is found by that key. The grads-derived
+            # fallback (init ran in another process AND no params passed)
+            # is deliberately NOT cached: its dtype grouping may be wrong
+            # for the state, and caching it under the shared key would
+            # poison later params-carrying calls.
+            layout = (layouts.get(_layout_key(grads))
+                      or _layout_of(grads, threshold_elems))
         # Small grads join the parameter-dtype buffers (bf16 compute
         # grads meet f32 master weights here, like the reference's fp16
         # compression decompressing into f32 before apply).

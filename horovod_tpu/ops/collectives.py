@@ -386,6 +386,34 @@ def _maybe_consistency_check(op_code: int, tensor, root: int = -1,
 # Public verbs — context-polymorphic (SPMD tracer or eager host value)
 # ---------------------------------------------------------------------------
 
+def _localize(x):
+    """Re-home an eager collective's replicated GLOBAL output as an
+    ordinary process-local array. In a multi-controller world the raw
+    output is committed to the whole device set; feeding it to any
+    subsequent local eager op fails jax's addressability checks (a
+    reference user never sees this — each mpirun rank only ever holds
+    local tensors). The local shard of a replicated result holds the full
+    value, so one host hop restores composability. Single-controller runs
+    return the array untouched."""
+    st = _topo._require_init()
+    if st.num_processes == 1:
+        return x
+    return jnp.asarray(np.asarray(x))
+
+
+def fetch(x) -> np.ndarray:
+    """Device→host of a possibly multi-process-sharded global array: the
+    full global value on every process. Replicated/addressable arrays
+    fetch directly; cross-process-sharded ones go through an allgather
+    (``multihost_utils.process_allgather``)."""
+    try:
+        return np.asarray(x)
+    except RuntimeError:
+        from jax.experimental import multihost_utils
+
+        return np.asarray(multihost_utils.process_allgather(x, tiled=True))
+
+
 def allreduce(tensor, average: bool = True, name: Optional[str] = None):
     """Allreduce (reference API: horovod/tensorflow/mpi_ops.py:78-91 and
     horovod/common/operations.cc:1401-1496).
@@ -402,7 +430,8 @@ def allreduce(tensor, average: bool = True, name: Optional[str] = None):
         return _spmd_allreduce(tensor, average, ax)
     tensor = jnp.asarray(tensor)
     _maybe_consistency_check(0, tensor, flags=int(average))
-    return ranked_allreduce(_replicated_stack(tensor), average=average)
+    return _localize(ranked_allreduce(_replicated_stack(tensor),
+                                      average=average))
 
 
 def allgather(tensor, name: Optional[str] = None):
@@ -422,7 +451,7 @@ def allgather(tensor, name: Optional[str] = None):
     _maybe_consistency_check(1, tensor[:0])
     st = _topo._require_init()
     if st.num_processes == 1:
-        return ranked_allgather(_replicated_stack(tensor))
+        return ranked_allgather(_replicated_stack(tensor))  # already local
     # Cross-process variable first dim: exchange per-rank sizes (each local
     # chip one-hots its own global rank), pad to the max, gather, strip.
     n = tensor.shape[0]
@@ -456,7 +485,7 @@ def broadcast(tensor, root_rank: int, name: Optional[str] = None):
         return _root_select_psum(tensor, root_rank, axis=ax)
     tensor = jnp.asarray(tensor)
     _maybe_consistency_check(2, tensor, root_rank)
-    return ranked_broadcast(_replicated_stack(tensor), root_rank)
+    return _localize(ranked_broadcast(_replicated_stack(tensor), root_rank))
 
 
 def reducescatter(tensor, name: Optional[str] = None):
@@ -470,7 +499,8 @@ def reducescatter(tensor, name: Optional[str] = None):
         return lax.psum_scatter(tensor, ax, scatter_dimension=0, tiled=True)
     tensor = jnp.asarray(tensor)
     _maybe_consistency_check(3, tensor)
-    return _local_row(ranked_reducescatter(_replicated_stack(tensor)))
+    return _localize(
+        _local_row(ranked_reducescatter(_replicated_stack(tensor))))
 
 
 def alltoall(tensor, name: Optional[str] = None):
@@ -483,7 +513,7 @@ def alltoall(tensor, name: Optional[str] = None):
         return lax.all_to_all(tensor, ax, split_axis=0, concat_axis=0, tiled=True)
     tensor = jnp.asarray(tensor)
     _maybe_consistency_check(4, tensor)
-    return _local_row(ranked_alltoall(_replicated_stack(tensor)))
+    return _localize(_local_row(ranked_alltoall(_replicated_stack(tensor))))
 
 
 # ---------------------------------------------------------------------------

@@ -12,8 +12,10 @@ def main():
     nproc = int(sys.argv[3])
     scenario = sys.argv[4]
 
-    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
-                               + " --xla_force_host_platform_device_count=4")
+    local_devices = int(os.environ.get("HVD_TEST_LOCAL_DEVICES", "4"))
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + f" --xla_force_host_platform_device_count={local_devices}")
     if scenario.startswith("engine"):
         # Timeline must be configured before hvd.init() (the engine is
         # created there in multi-controller worlds).
@@ -30,21 +32,22 @@ def main():
     import horovod_tpu as hvd
 
     hvd.init()
-    assert hvd.size() == 4 * nproc, hvd.size()
+    assert hvd.size() == local_devices * nproc, hvd.size()
     assert hvd.num_processes() == nproc
     assert hvd.cross_rank() == pid
-    assert hvd.local_size() == 4
+    assert hvd.local_size() == local_devices
 
     if scenario == "collectives":
         # allreduce: each process's chips contribute its value.
         mine = float(pid + 1)
         out = np.asarray(hvd.allreduce(jnp.full((3,), mine), average=False))
-        expect = 4 * sum(range(1, nproc + 1))
+        expect = local_devices * sum(range(1, nproc + 1))
         np.testing.assert_allclose(out, np.full((3,), expect))
 
         # broadcast from a chip owned by process 1.
         val = jnp.full((2,), float(pid) + 10.0)
-        out = np.asarray(hvd.broadcast(val, root_rank=4))  # proc 1's chip
+        out = np.asarray(hvd.broadcast(
+            val, root_rank=local_devices))  # proc 1's first chip
         np.testing.assert_allclose(out, np.full((2,), 11.0))
 
         # allgather with DIFFERENT first dims per process (the
@@ -53,7 +56,7 @@ def main():
         g = np.asarray(hvd.allgather(
             jnp.full((rows, 2), float(pid))))
         # Each of the 4 local chips contributes this controller's tensor.
-        expect_rows = sum(4 * (p + 1) for p in range(nproc))
+        expect_rows = sum(local_devices * (p + 1) for p in range(nproc))
         assert g.shape == (expect_rows, 2), g.shape
 
         # broadcast_object (pickle path).
@@ -74,7 +77,7 @@ def main():
               for i in range(3)]
         for h in hs:
             np.testing.assert_allclose(e.synchronize(h),
-                                       np.full((4,), 4.0 * nproc))
+                                       np.full((4,), float(local_devices * nproc)))
     elif scenario == "collectives_nonegotiation":
         # HVD_NEGOTIATION=0 (set by the test): the fallback multi-
         # controller engine path must force fusion OFF and still agree.
@@ -86,7 +89,7 @@ def main():
               for i in range(3)]
         for h in hs:
             np.testing.assert_allclose(e.synchronize(h),
-                                       np.full((4,), 4.0 * nproc))
+                                       np.full((4,), float(local_devices * nproc)))
     elif scenario == "engine_fusion":
         # Negotiated fusion across controllers (reference: the rank-0
         # coordinator's fused responses, operations.cc:2035-2074): both
@@ -106,11 +109,12 @@ def main():
                                                 np.float32), 0)
         outs = [e.synchronize(h) for h in hs]
         for i, out in enumerate(outs):
-            # 4 chips per process contribute each process's value.
-            expect = 4 * sum(10 * i + p + 1 for p in range(nproc))
+            # Each process's chips contribute its value once each.
+            expect = local_devices * sum(10 * i + p + 1 for p in range(nproc))
             np.testing.assert_array_equal(out, np.full((8,), expect))
         g = e.synchronize(hg)
-        assert g.shape == (sum(4 * (p + 1) for p in range(nproc)), 2)
+        assert g.shape == (
+            sum(local_devices * (p + 1) for p in range(nproc)), 2)
         np.testing.assert_array_equal(e.synchronize(hb),
                                       np.full((3,), 5.0))
         # Bitwise agreement across processes (the test compares lines).
@@ -161,7 +165,7 @@ def main():
         # Engine must still work after entry-level errors.
         h = e.allreduce_async("after", np.ones((4,), np.float32), False)
         np.testing.assert_allclose(e.synchronize(h),
-                                   np.full((4,), 4.0 * nproc))
+                                   np.full((4,), float(local_devices * nproc)))
     elif scenario == "engine_stall":
         # Missing-rank stall attribution (reference: CheckForStalledTensors
         # names missing ranks, operations.cc:1535-1581): process 1 delays
@@ -177,7 +181,7 @@ def main():
             time.sleep(4.0)
             h = e.allreduce_async("late", np.ones((2,), np.float32), False)
         np.testing.assert_allclose(e.synchronize(h),
-                                   np.full((2,), 4.0 * nproc))
+                                   np.full((2,), float(local_devices * nproc)))
     elif scenario == "engine_peer_shutdown":
         # Cooperative shutdown propagation (reference: shutdown flag in the
         # request list → SHUT_DOWN_ERROR for stragglers,
@@ -202,8 +206,8 @@ def main():
             else:
                 raise SystemExit("peer shutdown did not surface")
     elif scenario == "hierarchical":
-        # HVD_HIERARCHICAL_ALLREDUCE=1 (set by the test): 2 processes x 4
-        # chips form the (dcn=2, ici=4) two-tier mesh from process
+        # HVD_HIERARCHICAL_ALLREDUCE=1 (set by the test): N processes x M
+        # chips form the (dcn=N, ici=M) two-tier mesh from process
         # grouping; eager, compiled and engine allreduces all route
         # reduce-scatter(ICI) -> psum(DCN) -> all-gather(ICI)
         # (reference: operations.cc:1194-1346, env gate :1760-1778).
@@ -214,12 +218,15 @@ def main():
         from horovod_tpu.ops import collectives as C
 
         tt = topology.two_tier()
-        assert tt is not None and tt.devices.shape == (2, 4), tt
+        assert tt is not None and tt.devices.shape == (
+            nproc, local_devices), tt
         assert C._hier_allreduce_active()
 
         mine = float(pid + 1)
+        # Each process's M chips contribute its value once each.
+        expect = local_devices * sum(range(1, nproc + 1))
         out = np.asarray(hvd.allreduce(jnp.full((7,), mine), average=False))
-        np.testing.assert_allclose(out, np.full((7,), 4.0 * 3))  # 4*(1+2)
+        np.testing.assert_allclose(out, np.full((7,), float(expect)))
 
         @hvd_jax.jit(in_specs=(P(hvd_jax.HVD_AXIS),), out_specs=P())
         def compiled(x):
@@ -229,15 +236,110 @@ def main():
         shards = [jax.device_put(jnp.full((1, 3), mine), d)
                   for d in jax.local_devices()]
         x = jax.make_array_from_single_device_arrays(
-            (8, 3), NamedSharding(mesh, P(hvd_jax.HVD_AXIS)), shards)
+            (hvd.size(), 3), NamedSharding(mesh, P(hvd_jax.HVD_AXIS)),
+            shards)
         np.testing.assert_allclose(np.asarray(compiled(x)),
-                                   np.full((3,), 4.0 * 3))
+                                   np.full((3,), float(expect)))
 
         from horovod_tpu.core import engine as eng
 
         e = eng.get_engine()
         h = e.allreduce_async("ht", np.full((5,), mine, np.float32), False)
-        np.testing.assert_allclose(e.synchronize(h), np.full((5,), 12.0))
+        np.testing.assert_allclose(e.synchronize(h),
+                                   np.full((5,), float(expect)))
+    elif scenario == "engine_peer_sigkill":
+        # A peer dying WITHOUT a tombstone (SIGKILL mid-round) must not
+        # hang the survivors: negotiation times out naming the dead
+        # process (HVD_NEGOTIATION_TIMEOUT is shortened by the test;
+        # reference behavior: an MPI peer death aborts the job — here the
+        # survivors get a clean, attributed error instead).
+        import signal
+        import time
+
+        from horovod_tpu.core import engine as eng
+        from horovod_tpu.core.engine import EngineError, ShutdownError
+
+        e = eng.get_engine()
+        # Clear any stale done-flags from an earlier run that reused this
+        # port BEFORE the warm round: flags are only created after the
+        # warm-round barrier, so deletion strictly precedes creation.
+        for p in range(nproc):
+            try:
+                os.unlink(f"/tmp/hvd_sigkill_done_{port}_{p}")
+            except OSError:
+                pass
+        if pid == nproc - 1:
+            # Join one round so everyone's coordinator is live, then die
+            # silently before the next.
+            h = e.allreduce_async("warm", np.ones((2,), np.float32), False)
+            e.synchronize(h)
+            os.kill(os.getpid(), signal.SIGKILL)
+        h = e.allreduce_async("warm", np.ones((2,), np.float32), False)
+        e.synchronize(h)
+        time.sleep(1.0)  # let the victim die
+        h = e.allreduce_async("orphan", np.ones((2,), np.float32), False)
+        try:
+            e.synchronize(h)
+        except ShutdownError:
+            raise SystemExit(
+                "SIGKILL must not look like a clean shutdown")
+        except EngineError as err:
+            msg = str(err)
+            assert "timed out" in msg and str(nproc - 1) in msg, msg
+            print(f"proc {pid}: sigkill surfaced as timeout naming "
+                  f"process {nproc - 1}", flush=True)
+        else:
+            raise SystemExit("dead peer did not surface")
+        # The engine surfaced the failure — that is this scenario's
+        # contract. Skip the interpreter's atexit teardown: the JAX
+        # coordination service's shutdown barrier can never pass with a
+        # SIGKILLed member and would turn this PASS into a fatal abort.
+        # Process 0 HOSTS the coordination service, so it must outlive
+        # the other survivors (file flags; the KV itself dies with p0).
+        print(f"proc {pid}: SCENARIO {scenario} PASSED", flush=True)
+        flag = f"/tmp/hvd_sigkill_done_{port}"
+        if pid != 0:
+            open(f"{flag}_{pid}", "w").close()
+            os._exit(0)
+        deadline = time.monotonic() + 30.0
+        survivors = [p for p in range(1, nproc - 1)]
+        while time.monotonic() < deadline:
+            if all(os.path.exists(f"{flag}_{p}") for p in survivors):
+                break
+            time.sleep(0.1)
+        os._exit(0)
+    elif scenario == "autotune_propagation":
+        # Process 0's engine parameters (the autotuner's output) must
+        # reach every peer through the negotiation round params
+        # (reference: rank 0 tunes and broadcasts a Params struct,
+        # parameter_manager.cc:63-77,203-236).
+        import time
+
+        from horovod_tpu.core import engine as eng
+
+        e = eng.get_engine()
+        if pid == 0:
+            e.set_params(cycle_time_s=0.0123, fusion_threshold=777216)
+        # Tick a few rounds so params ride to everyone.
+        for i in range(3):
+            h = e.allreduce_async(f"tick{i}", np.ones((2,), np.float32),
+                                  False)
+            np.testing.assert_allclose(
+                e.synchronize(h),
+                np.full((2,), float(local_devices * nproc)))
+            time.sleep(0.05)
+        # Params ride EVERY negotiation round, and rounds tick even when
+        # idle (peers block on our round message otherwise) — so just
+        # poll; no further collectives needed.
+        deadline = time.monotonic() + 30.0
+        while time.monotonic() < deadline:
+            cyc, fus = e.current_params()
+            if abs(cyc - 0.0123) < 1e-9 and fus == 777216:
+                break
+            time.sleep(0.1)
+        cyc, fus = e.current_params()
+        assert abs(cyc - 0.0123) < 1e-9 and fus == 777216, (cyc, fus)
+        print(f"proc {pid}: params propagated", flush=True)
     elif scenario == "torch_errors":
         # Reference error-path tests drive mismatches through the TORCH
         # API and assert the coordinator error surfaces as an exception on
@@ -271,7 +373,7 @@ def main():
         # And the API still works afterwards.
         out = hvt.allreduce(torch.ones(3), average=False, name="after")
         np.testing.assert_allclose(out.numpy(),
-                                   np.full((3,), 4.0 * nproc))
+                                   np.full((3,), float(local_devices * nproc)))
     elif scenario == "mismatch":
         os.environ["HVD_CONSISTENCY_CHECKS"] = "1"
         from horovod_tpu.common.topology import HorovodInternalError

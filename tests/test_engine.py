@@ -199,7 +199,7 @@ def test_timeline_written(tmp_path):
 
 
 def test_fused_many_small_beats_unfused(hvd):
-    """Runtime tensor fusion must WIN, not just exist: 64 small
+    """Runtime tensor fusion must WIN, not just exist: 256 small
     allreduces through the real staging executor complete faster (and in
     far fewer data-plane calls) with the 64 MB fusion buffer than with
     fusion disabled — the reference's raison d'être for C5
@@ -237,7 +237,7 @@ def test_fused_many_small_beats_unfused(hvd):
         tensors = [np.ones((1024,), np.float32) for _ in range(256)]
 
         def one_round(tag):
-            # Plug the dispatch thread so all 64 tensors land in one
+            # Plug the dispatch thread so all 256 tensors land in one
             # drain — deterministic fusion composition (same trick as the
             # timeline fusion test).
             CountingJax.gate = threading.Event()

@@ -20,7 +20,10 @@ def _free_port() -> int:
 
 
 def _run_world(scenario: str, nproc: int = 2, timeout: int = 240,
-               extra_env: dict = None):
+               extra_env: dict = None, expect_dead: tuple = ()):
+    """Spawn an nproc-controller world. ``expect_dead`` names process ids
+    allowed (expected) to die without printing PASSED — e.g. a SIGKILL
+    victim in failure-injection scenarios."""
     port = _free_port()
     env = dict(os.environ)
     env.pop("JAX_PLATFORMS", None)
@@ -45,6 +48,8 @@ def _run_world(scenario: str, nproc: int = 2, timeout: int = 240,
         for p in procs:
             p.kill()
     for i, (p, out) in enumerate(zip(procs, outs)):
+        if i in expect_dead:
+            continue
         assert p.returncode == 0, \
             f"proc {i} failed (rc={p.returncode}):\n{out[-3000:]}"
         assert f"SCENARIO {scenario} PASSED" in out, out[-3000:]
@@ -164,3 +169,85 @@ def test_two_process_peer_shutdown_propagates(engine):
     outs = _run_world("engine_peer_shutdown",
                       extra_env={"HVD_ENGINE": engine})
     assert any("peer shutdown surfaced" in out for out in outs)
+
+
+# ---------------------------------------------------------------------------
+# np=4 tier (VERDICT r2 item 5): negotiation with 3+ peers, failure
+# injection, parameter propagation, and a >2-process two-tier mesh.
+# 2 virtual chips per process keep the 4-process world at 8 devices.
+# ---------------------------------------------------------------------------
+
+_NP4 = {"HVD_TEST_LOCAL_DEVICES": "2"}
+
+
+def test_four_process_collectives():
+    _run_world("collectives", nproc=4, extra_env=_NP4)
+
+
+@pytest.mark.parametrize("engine", ENGINES)
+def test_four_process_negotiated_fusion(engine):
+    """Fusion composition agreed across FOUR controllers; results bitwise
+    identical everywhere (negotiation beyond the 2-peer case was
+    previously only unit-tested against a fake KV)."""
+    outs = _run_world("engine_fusion", nproc=4,
+                      extra_env={**_NP4, "HVD_ENGINE": engine})
+    results = [line for out in outs for line in out.splitlines()
+               if line.startswith("RESULT ")]
+    assert len(results) == 4 and len(set(results)) == 1, results
+
+
+def test_four_process_two_tier_hierarchical():
+    """(dcn=4, ici=2) two-tier mesh from process grouping; eager,
+    compiled and engine allreduces ride the hierarchical composition
+    (reference: operations.cc:1194-1346)."""
+    _run_world("hierarchical", nproc=4,
+               extra_env={**_NP4, "HVD_HIERARCHICAL_ALLREDUCE": "1"})
+
+
+@pytest.mark.parametrize("engine", ENGINES)
+def test_four_process_sigkill_peer_times_out_not_hangs(engine):
+    """SIGKILL one peer mid-round (no tombstone): survivors surface an
+    attributed negotiation timeout instead of hanging for the full 600 s
+    default or mistaking it for a clean shutdown."""
+    outs = _run_world(
+        "engine_peer_sigkill", nproc=4,
+        extra_env={**_NP4, "HVD_ENGINE": engine,
+                   "HVD_NEGOTIATION_TIMEOUT": "6"},
+        expect_dead=(3,), timeout=300)
+    assert sum("sigkill surfaced as timeout naming process 3" in out
+               for out in outs) == 3, outs[0][-2000:]
+
+
+@pytest.mark.parametrize("engine", ENGINES)
+def test_four_process_autotune_param_propagation(engine):
+    """Process 0's engine parameters reach all 3 peers through round
+    params (reference: ParameterManager::SyncParams broadcast,
+    parameter_manager.cc:63-77,203-236)."""
+    outs = _run_world("autotune_propagation", nproc=4,
+                      extra_env={**_NP4, "HVD_ENGINE": engine})
+    assert sum("params propagated" in out for out in outs) == 4
+
+
+# ---------------------------------------------------------------------------
+# The reference's "same suite, N processes" tier (SURVEY §4;
+# /root/reference/test/common.py:24-56): the single-process frontend test
+# FILES run unmodified inside a 2-controller world via the launcher.
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("suite", ["test_jax_frontend.py",
+                                   "test_torch_frontend.py"])
+def test_frontend_suite_under_launcher_np2(suite):
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ)
+    env.pop("JAX_PLATFORMS", None)
+    env.pop("XLA_FLAGS", None)
+    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+    proc = subprocess.run(
+        [sys.executable, "-m", "horovod_tpu.run", "-np", "2", "--cpu",
+         "--", sys.executable, "-m", "pytest",
+         os.path.join(repo, "tests", suite), "-q", "--no-header", "-p",
+         "no:cacheprovider"],
+        capture_output=True, text=True, timeout=600, env=env, cwd=repo)
+    assert proc.returncode == 0, proc.stdout[-4000:] + proc.stderr[-1000:]
+    # Every process ran the whole file green.
+    assert proc.stdout.count(" passed") >= 2, proc.stdout[-2000:]

@@ -94,18 +94,24 @@ def test_allgather_grad_cotangent_slices():
     out = hvt.allgather(x)  # (2n, 3): rank r's rows at [2r, 2r+2)
     w = torch.arange(1.0, 2 * n * 3 + 1).reshape(2 * n, 3)
     (out * w).sum().backward()
-    # Backward = allreduce(cotangent, SUM) then take this rank's rows:
-    # every rank contributes w, so rank 0's slice is w[0:2] * size.
-    np.testing.assert_allclose(x.grad.numpy(), w[0:2].numpy() * n)
+    # Backward = allreduce(cotangent, SUM) then take THIS rank's rows:
+    # every rank contributes w, so rank r's slice is w[2r:2r+2] * size
+    # (rank-aware like the reference's multi-rank runs, test_torch.py:
+    # 523-565 under mpirun).
+    r = hvt.rank()
+    np.testing.assert_allclose(x.grad.numpy(),
+                               w[2 * r: 2 * r + 2].numpy() * n)
 
 
 def test_broadcast_grad_average_path():
     c = torch.tensor([2.0, 0.5, 4.0])
     x = torch.ones(3, requires_grad=True)
     (hvt.broadcast(x, root_rank=0) * c).sum().backward()
-    # Root (rank 0 here) receives allreduce(c) = c * size.
-    np.testing.assert_allclose(x.grad.numpy(), c.numpy() * hvt.size(),
-                               rtol=1e-6)
+    # Root receives allreduce(c) = c * size; non-root ranks get zeros
+    # (reference: broadcast's registered gradient, mpi_ops.py:168-183).
+    expect = (c.numpy() * hvt.size() if hvt.rank() == 0
+              else np.zeros(3, np.float32))
+    np.testing.assert_allclose(x.grad.numpy(), expect, rtol=1e-6)
 
 
 def test_allgather():
@@ -141,8 +147,10 @@ def test_broadcast_grad():
     x = torch.ones(3, requires_grad=True)
     out = hvt.broadcast(x, root_rank=0)
     out.sum().backward()
-    # rank()==0 here, which is the root: grad = allreduce(ones, sum) = size.
-    np.testing.assert_allclose(x.grad.numpy(), np.full((3,), float(hvt.size())))
+    # Root: grad = allreduce(ones, sum) = size; non-root ranks get zeros.
+    expect = (np.full((3,), float(hvt.size())) if hvt.rank() == 0
+              else np.zeros(3, np.float32))
+    np.testing.assert_allclose(x.grad.numpy(), expect)
 
 
 def _train(opt_factory, steps=60, seed=0):
