@@ -30,28 +30,15 @@ import time
 
 BASELINE_IMG_PER_SEC_PER_DEVICE = 1656.82 / 16  # reference docs/benchmarks.md:33-38
 
-# Peak dense bf16 TFLOPS per chip, by jax device_kind substring.
-PEAK_BF16_FLOPS = {
-    "v5 lite": 197e12,   # TPU v5e
-    "v5e": 197e12,
-    "v4": 275e12,
-    "v5p": 459e12,
-    "v6 lite": 918e12,   # Trillium
-    "v6e": 918e12,
-}
-
-
-def peak_flops(device) -> float:
-    kind = getattr(device, "device_kind", "").lower()
-    for key, val in PEAK_BF16_FLOPS.items():
-        if key in kind:
-            return val
-    return 0.0  # unknown platform (e.g. CPU) -> MFU reported as null
-
 
 def main():
     p = argparse.ArgumentParser(description="horovod_tpu synthetic benchmark")
     p.add_argument("--model", default="resnet50")
+    p.add_argument("--stem", default=None,
+                   choices=["conv7", "space_to_depth"],
+                   help="ResNet stem: classic 7x7/s2 conv, or the exact "
+                        "space-to-depth reparameterization (MXU-friendly; "
+                        "see models/resnet.py)")
     p.add_argument("--batch-size", type=int, default=32,
                    help="per-chip batch size (reference default 32)")
     p.add_argument("--image-size", type=int, default=224)
@@ -87,11 +74,15 @@ def main():
     import horovod_tpu as hvd
     import horovod_tpu.jax as hvd_jax
     from horovod_tpu import models
+    # Deliberately imported here, not at module top: `bench.py --help`
+    # and argparse errors must not pay the framework+jax import.
+    from horovod_tpu.utils.hardware import peak_flops, peak_hbm_bw
 
     hvd.init()
     nchips = hvd.size()
 
-    model = models.get_model(args.model)
+    model_kw = {"stem": args.stem} if args.stem else {}
+    model = models.get_model(args.model, **model_kw)
     compression = (hvd_jax.Compression.fp16 if args.fp16_allreduce
                    else hvd_jax.Compression.none)
     # fused_update: the ~160 per-parameter update fusions collapse into
@@ -178,6 +169,7 @@ def main():
     # same program a second time.
     step_fn = train_step
     flops_per_step = 0.0
+    bytes_per_step = 0.0
     copts = {}
     for kv in args.xla_option:
         if "=" not in kv:
@@ -193,6 +185,7 @@ def main():
         if isinstance(ca, (list, tuple)):
             ca = ca[0] if ca else {}
         flops_per_step = float(ca.get("flops", 0.0))
+        bytes_per_step = float(ca.get("bytes accessed", 0.0))
     except Exception as e:  # pragma: no cover - cost analysis is best-effort
         if copts:
             # Silently benchmarking WITHOUT the requested compiler options
@@ -243,14 +236,26 @@ def main():
     per_chip = float(np.median(rates))
     step_time = args.batch_size / per_chip
     peak = peak_flops(jax.devices()[0])
+    peak_bw = peak_hbm_bw(jax.devices()[0])
     if peak and flops_per_step / step_time > peak:
         # Guard against a cost-analysis that multiplied by the scan trip
         # count (would make MFU read > 1 on a sane measurement).
         flops_per_step /= spc
         print("# note: cost_analysis FLOPs exceeded chip peak; assuming it "
               f"counted the scan body {spc}x and dividing", file=sys.stderr)
+    if peak_bw and bytes_per_step / step_time > 2 * peak_bw:
+        bytes_per_step /= spc  # same scan-body pitfall as FLOPs
+        print("# note: cost_analysis bytes exceeded 2x chip HBM peak; "
+              f"assuming scan body counted {spc}x and dividing",
+              file=sys.stderr)
     mfu = (flops_per_step / step_time / peak
            ) if peak and flops_per_step else None
+    # XLA's "bytes accessed" counts each op's operands+results; VMEM-
+    # resident fusion intermediates inflate it above true HBM traffic,
+    # so membw_util is an UPPER estimate of bandwidth pressure. MFU + a
+    # high membw_util together locate the step on the roofline.
+    membw = (bytes_per_step / step_time / peak_bw
+             ) if peak_bw and bytes_per_step else None
     result = {
         "metric": f"{args.model}_train_images_per_sec_per_chip"
                   f"_bs{args.batch_size}",
@@ -260,6 +265,8 @@ def main():
         "step_time_ms": round(step_time * 1e3, 3),
         "gflops_per_step": round(flops_per_step / 1e9, 1),
         "mfu": round(mfu, 4) if mfu is not None else None,
+        "hbm_gb_per_step": round(bytes_per_step / 1e9, 2),
+        "membw_util": round(membw, 3) if membw is not None else None,
     }
     print(json.dumps(result))
     print(f"# {nchips} chip(s), spread {min(rates):.0f}-{max(rates):.0f} "

@@ -64,8 +64,17 @@ def main():
         LearningRateScheduleCallback(1e-2, start_epoch=60, end_epoch=80),
         LearningRateScheduleCallback(1e-3, start_epoch=80),
     ]
+    import time
+
+    hist = trainer.fit(x, y, batch_size=args.batch_size, epochs=1,
+                       callbacks=callbacks, verbose=1)  # compile warmup
+    t0 = time.perf_counter()
     hist = trainer.fit(x, y, batch_size=args.batch_size, epochs=args.epochs,
                        callbacks=callbacks, verbose=1)
+    dt = time.perf_counter() - t0
+    images = args.steps * args.batch_size * args.epochs
+    print(f"images/sec/chip: {images / dt:.1f} "
+          f"(keras trainer path, {hvd.size()} chip(s))")
     if args.checkpoint_dir:
         trainer.save(args.checkpoint_dir)
     assert "loss" in hist

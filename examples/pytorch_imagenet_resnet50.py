@@ -83,6 +83,10 @@ def main():
             g["lr"] = args.base_lr * lr_scaler * adj
 
     model.train()
+    import time
+
+    sync_s = 0.0  # time inside optimizer.step() = allreduce drain point
+    t_train0 = time.perf_counter()
     for epoch in range(resume_from_epoch, args.epochs):
         train_loss = Metric("train_loss")
         for b in range(args.steps):
@@ -92,12 +96,23 @@ def main():
                 loss = F.cross_entropy(model(data), target)
                 train_loss.update(loss.item())
                 (loss / args.batches_per_allreduce).backward()
+            t0 = time.perf_counter()
             optimizer.step()
+            sync_s += time.perf_counter() - t0
         print(f"epoch {epoch}: train_loss={train_loss.avg:.4f} "
               f"(averaged over {hvd.size()} ranks)")
         if hvd.rank() == 0:
             torch.save({"model": model.state_dict()},
                        ckpt_format.format(epoch=epoch + 1))
+    dt = time.perf_counter() - t_train0
+    nimg = ((args.epochs - resume_from_epoch) * args.steps
+            * args.batch_size * args.batches_per_allreduce)
+    if dt > 0 and nimg:
+        # NB: forward/backward run on host-CPU torch; this measures the
+        # engine-path integration, not TPU compute (see docs/concepts.md
+        # "Differences from Horovod" #2).
+        print(f"images/sec: {nimg / dt:.1f}  "
+              f"allreduce-sync share: {100 * sync_s / dt:.0f}% of step")
 
 
 if __name__ == "__main__":

@@ -76,6 +76,28 @@ class BasicBlock(nn.Module):
         return self.act(residual + y)
 
 
+def space_to_depth_2x2(x):
+    """NHWC [N,H,W,C] → [N,H/2,W/2,4C]: each output channel block is one
+    subpixel of the 2x2 macro-pixel (row-major: (row_sub, col_sub, c))."""
+    n, h, w, c = x.shape
+    x = x.reshape(n, h // 2, 2, w // 2, 2, c)
+    x = x.transpose(0, 1, 3, 2, 4, 5)
+    return x.reshape(n, h // 2, w // 2, 4 * c)
+
+
+def conv7_kernel_to_s2d(k7):
+    """Exact reparameterization of a 7x7/s2 'SAME' conv kernel [7,7,C,O]
+    as the equivalent 4x4/s1 kernel [4,4,4C,O] over space-to-depth input
+    (zero-pad 7→8 taps, fold each tap's parity into the subpixel
+    channels). Used by the equivalence test; training uses the 4x4 form
+    directly."""
+    c, o = k7.shape[2], k7.shape[3]
+    k8 = jnp.pad(k7, ((0, 1), (0, 1), (0, 0), (0, 0)))
+    # [8,8,C,O] -> [4,2,4,2,C,O] -> [4,4,2,2,C,O] -> [4,4,4C,O]
+    k = k8.reshape(4, 2, 4, 2, c, o).transpose(0, 2, 1, 3, 4, 5)
+    return k.reshape(4, 4, 4 * c, o)
+
+
 class ResNet(nn.Module):
     """ResNet over NHWC images.
 
@@ -83,6 +105,14 @@ class ResNet(nn.Module):
     batch), matching the reference's data-parallel semantics where BN state
     is never allreduced — only initially broadcast (reference:
     horovod/tensorflow/__init__.py:96-115).
+
+    ``stem``: ``"conv7"`` is the classic 7x7/s2 convolution; contracting
+    over only 3 input channels it wastes most of the MXU's 128 lanes.
+    ``"space_to_depth"`` reshapes the image to [H/2, W/2, 12] and trains
+    the mathematically equivalent 4x4/s1 kernel instead (exactness:
+    :func:`conv7_kernel_to_s2d`; the standard TPU ResNet stem). Same
+    function class, different parameterization — checkpoints are not
+    interchangeable between stems.
     """
 
     stage_sizes: Sequence[int]
@@ -91,6 +121,7 @@ class ResNet(nn.Module):
     num_filters: int = 64
     dtype: Any = jnp.bfloat16
     act: Callable = nn.relu
+    stem: str = "conv7"
 
     @nn.compact
     def __call__(self, x, train: bool = True):
@@ -99,7 +130,22 @@ class ResNet(nn.Module):
         norm = partial(nn.BatchNorm, use_running_average=not train,
                        momentum=0.9, epsilon=1e-5, dtype=self.dtype)
         x = jnp.asarray(x, self.dtype)
-        x = conv(self.num_filters, (7, 7), (2, 2), name="conv_init")(x)
+        if self.stem not in ("conv7", "space_to_depth"):
+            # Silent fallback would train a different parameterization
+            # than the user asked for (checkpoints are not
+            # interchangeable between stems).
+            raise ValueError(f"unknown stem {self.stem!r}; expected "
+                             "'conv7' or 'space_to_depth'")
+        if self.stem == "space_to_depth":
+            x = space_to_depth_2x2(x)
+            # Pad (1,2): macro-row span of the 7x7/s2 taps (see
+            # conv7_kernel_to_s2d) — NOT flax 'SAME', which would center
+            # the 4x4 window differently and break equivalence.
+            x = nn.Conv(self.num_filters, (4, 4), use_bias=False,
+                        dtype=self.dtype, padding=((1, 2), (1, 2)),
+                        name="conv_init")(x)
+        else:
+            x = conv(self.num_filters, (7, 7), (2, 2), name="conv_init")(x)
         x = norm(name="bn_init")(x)
         x = self.act(x)
         x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")

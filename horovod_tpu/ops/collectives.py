@@ -499,8 +499,8 @@ def reducescatter(tensor, name: Optional[str] = None):
         return lax.psum_scatter(tensor, ax, scatter_dimension=0, tiled=True)
     tensor = jnp.asarray(tensor)
     _maybe_consistency_check(3, tensor)
-    return _localize(
-        _local_row(ranked_reducescatter(_replicated_stack(tensor))))
+    # _local_row is already process-local — no _localize round trip.
+    return _local_row(ranked_reducescatter(_replicated_stack(tensor)))
 
 
 def alltoall(tensor, name: Optional[str] = None):
@@ -513,7 +513,7 @@ def alltoall(tensor, name: Optional[str] = None):
         return lax.all_to_all(tensor, ax, split_axis=0, concat_axis=0, tiled=True)
     tensor = jnp.asarray(tensor)
     _maybe_consistency_check(4, tensor)
-    return _localize(_local_row(ranked_alltoall(_replicated_stack(tensor))))
+    return _local_row(ranked_alltoall(_replicated_stack(tensor)))
 
 
 # ---------------------------------------------------------------------------

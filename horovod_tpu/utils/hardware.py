@@ -1,0 +1,46 @@
+"""Per-chip hardware peaks, keyed by jax ``device_kind`` substring.
+
+Used by the benchmarks to report MFU (model FLOPs utilization) and HBM
+bandwidth pressure next to raw throughput, so a physically impossible
+number is self-evident (the honesty contract of bench.py). Public
+figures: TPU v4 275 TFLOPS bf16 / 1.23 TB/s; v5e 197 / 0.82; v5p 459 /
+2.77; v6e (Trillium) 918 / 1.64.
+"""
+
+from __future__ import annotations
+
+# Peak dense bf16 TFLOPS per chip.
+PEAK_BF16_FLOPS = {
+    "v5 lite": 197e12,   # TPU v5e
+    "v5e": 197e12,
+    "v4": 275e12,
+    "v5p": 459e12,
+    "v6 lite": 918e12,   # Trillium
+    "v6e": 918e12,
+}
+
+# Peak HBM bandwidth per chip (bytes/s).
+PEAK_HBM_BW = {
+    "v5 lite": 819e9,    # TPU v5e
+    "v5e": 819e9,
+    "v4": 1228e9,
+    "v5p": 2765e9,
+    "v6 lite": 1640e9,   # Trillium
+    "v6e": 1640e9,
+}
+
+
+def _by_device_kind(device, table) -> float:
+    kind = getattr(device, "device_kind", "").lower()
+    for key, val in table.items():
+        if key in kind:
+            return val
+    return 0.0  # unknown platform (e.g. CPU) -> callers report null
+
+
+def peak_flops(device) -> float:
+    return _by_device_kind(device, PEAK_BF16_FLOPS)
+
+
+def peak_hbm_bw(device) -> float:
+    return _by_device_kind(device, PEAK_HBM_BW)

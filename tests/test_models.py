@@ -162,3 +162,41 @@ def test_transformer_rejects_overlong_sequence():
 def test_get_model_unknown():
     with pytest.raises(ValueError):
         models.get_model("alexnet")
+
+
+def test_space_to_depth_stem_is_exact_reparameterization():
+    """The s2d stem computes EXACTLY the classic 7x7/s2 'SAME' conv when
+    its 4x4 kernel is derived from the 7x7 weights (the standard TPU
+    ResNet stem transform) — same function class, MXU-friendly layout."""
+    from jax import lax
+
+    from horovod_tpu.models.resnet import (conv7_kernel_to_s2d,
+                                           space_to_depth_2x2)
+
+    rng = jax.random.PRNGKey(0)
+    k1, k2 = jax.random.split(rng)
+    x = jax.random.normal(k1, (2, 16, 16, 3), jnp.float32)
+    k7 = jax.random.normal(k2, (7, 7, 3, 8), jnp.float32)
+
+    dn = ("NHWC", "HWIO", "NHWC")
+    y_ref = lax.conv_general_dilated(
+        x, k7, window_strides=(2, 2), padding=[(2, 3), (2, 3)],
+        dimension_numbers=dn)
+    y_s2d = lax.conv_general_dilated(
+        space_to_depth_2x2(x), conv7_kernel_to_s2d(k7),
+        window_strides=(1, 1), padding=[(1, 2), (1, 2)],
+        dimension_numbers=dn)
+    assert y_s2d.shape == y_ref.shape == (2, 8, 8, 8)
+    np.testing.assert_allclose(np.asarray(y_s2d), np.asarray(y_ref),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_resnet_space_to_depth_stem_trains():
+    m = models.get_model("resnet18", num_classes=10, dtype=jnp.float32,
+                         stem="space_to_depth")
+    x = jnp.zeros((2, 64, 64, 3))
+    variables, out = _init_and_apply(m, x)
+    logits = out[0] if isinstance(out, tuple) else out
+    assert logits.shape == (2, 10)
+    k = variables["params"]["conv_init"]["kernel"]
+    assert k.shape == (4, 4, 12, 64), k.shape
