@@ -40,6 +40,8 @@ def main():
     ap.add_argument("--steps-per-call", type=int, default=5,
                     help="steps fused into one dispatch via lax.scan "
                          "(amortizes per-call host latency; see bench.py)")
+    ap.add_argument("--unroll", type=int, default=1,
+                    help="scan unroll factor (see bench.py --unroll)")
     ap.add_argument("--bf16", action="store_true", default=True)
     ap.add_argument("--remat", action="store_true",
                     help="checkpoint each layer (HBM for FLOPs)")
@@ -105,7 +107,8 @@ def main():
             return (params, opt_state), loss
 
         (params, opt_state), losses = jax.lax.scan(
-            body, (params, opt_state), None, length=spc)
+            body, (params, opt_state), None, length=spc,
+            unroll=max(1, args.unroll))
         return params, opt_state, losses[-1]
 
     toks = jnp.asarray(tokens)
@@ -120,7 +123,12 @@ def main():
         ca = compiled.cost_analysis()
         if isinstance(ca, (list, tuple)):
             ca = ca[0] if ca else {}
-        flops_per_step = float(ca.get("flops", 0.0))
+        from horovod_tpu.utils.hardware import scan_cost_analysis_steps
+
+        # Scan body + peeled remainder each counted once (bench.py's
+        # on-chip-verified rule, shared via utils.hardware).
+        flops_per_step = float(ca.get("flops", 0.0)) / \
+            scan_cost_analysis_steps(spc, args.unroll)
     except Exception as exc:  # pragma: no cover
         print(f"# cost_analysis unavailable: {exc}", file=sys.stderr)
 

@@ -44,3 +44,24 @@ def peak_flops(device) -> float:
 
 def peak_hbm_bw(device) -> float:
     return _by_device_kind(device, PEAK_HBM_BW)
+
+
+def scan_cost_analysis_steps(steps_per_call: int, unroll: int) -> int:
+    """How many *steps* XLA's cost analysis counts for a
+    ``lax.scan(body, length=steps_per_call, unroll=unroll)`` program.
+
+    The while body is counted ONCE (verified on chip, see bench.py) and
+    holds ``unroll`` steps; jax peels a remainder of
+    ``steps_per_call % unroll`` steps outside the loop (also counted
+    once). When ``unroll >= steps_per_call`` there is no while loop at
+    all — the program is just ``steps_per_call`` peeled steps
+    (jax _scan_impl: num_trips, remainder = divmod(length, unroll)).
+    """
+    spc = max(1, steps_per_call)
+    if spc == 1:
+        return 1  # no scan emitted by the callers in that case
+    unroll = max(1, unroll)
+    num_trips, remainder = divmod(spc, unroll)
+    if num_trips == 0:
+        return remainder
+    return unroll + remainder

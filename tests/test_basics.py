@@ -36,3 +36,18 @@ def test_mesh(hvd):
     assert m.devices.size == 8
     assert m.axis_names == (hvd.device_rank_axis(),)
     assert len(hvd.devices()) == 8
+
+
+def test_scan_cost_analysis_steps_formula():
+    """The on-chip-verified rule for how many scan steps XLA cost
+    analysis counts (body once + peeled remainder once; pure-peel when
+    unroll >= length)."""
+    from horovod_tpu.utils.hardware import scan_cost_analysis_steps as f
+
+    assert f(1, 1) == 1 and f(1, 8) == 1      # no scan emitted
+    assert f(50, 1) == 1                       # plain scan: body once
+    assert f(50, 2) == 2                       # 25 trips, no remainder
+    assert f(50, 4) == 6                       # 12 trips + 2 peeled
+    assert f(50, 5) == 5                       # 10 trips, no remainder
+    assert f(3, 5) == 3                        # num_trips=0: pure peel
+    assert f(5, 2) == 3                        # 2 trips + 1 peeled
