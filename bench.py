@@ -177,6 +177,7 @@ def main():
     # same program a second time.
     step_fn = train_step
     flops_per_step = 0.0
+    counted = 1  # scan steps cost_analysis holds (set with flops below)
     bytes_per_step = None  # None = unavailable (cost analysis failed
     # or the body is unrolled — see below); never a fake measured zero.
     copts = {}
@@ -258,11 +259,13 @@ def main():
     peak = peak_flops(jax.devices()[0])
     peak_bw = peak_hbm_bw(jax.devices()[0])
     if peak and flops_per_step / step_time > peak:
-        # Guard against a cost-analysis that multiplied by the scan trip
-        # count (would make MFU read > 1 on a sane measurement).
-        flops_per_step /= spc
+        # Guard against a cost-analysis that counted the full scan (all
+        # spc steps, would make MFU read > 1 on a sane measurement): the
+        # value was already divided by `counted`, so recover one step's
+        # FLOPs as raw/spc = flops_per_step * counted / spc.
+        flops_per_step *= counted / spc
         print("# note: cost_analysis FLOPs exceeded chip peak; assuming it "
-              f"counted the scan body {spc}x and dividing", file=sys.stderr)
+              f"counted all {spc} scan steps and rescaling", file=sys.stderr)
     if (bytes_per_step and peak_bw
             and bytes_per_step / step_time > 2 * peak_bw):
         bytes_per_step /= spc  # same scan-body pitfall as FLOPs

@@ -44,7 +44,11 @@ class ParameterManager:
                  warmups: int = WARMUPS,
                  cycles_per_sample: int = CYCLES_PER_SAMPLE,
                  samples_per_step: int = SAMPLES_PER_STEP,
-                 max_steps: int = MAX_STEPS, seed: int = 0):
+                 max_steps: int = MAX_STEPS, seed: int = 0,
+                 clock=time.monotonic):
+        # ``clock`` is a seam for deterministic tests: patching the time
+        # module globally would warp live engine/coordinator threads.
+        self._clock = clock
         self.engine = engine
         self.bo = BayesianOptimization(
             [FUSION_MB_BOUNDS, CYCLE_MS_BOUNDS], seed=seed)
@@ -59,7 +63,7 @@ class ParameterManager:
         ])
         self._cycle_count = 0
         self._bytes = 0
-        self._t0 = time.monotonic()
+        self._t0 = self._clock()
         self._scores: list = []
         self._steps = 0
         self._log = None
@@ -96,11 +100,11 @@ class ParameterManager:
         self._cycle_count += 1
         if self._cycle_count < self.cycles_per_sample:
             return False
-        elapsed_us = max((time.monotonic() - self._t0) * 1e6, 1.0)
+        elapsed_us = max((self._clock() - self._t0) * 1e6, 1.0)
         score = self._bytes / elapsed_us
         self._cycle_count = 0
         self._bytes = 0
-        self._t0 = time.monotonic()
+        self._t0 = self._clock()
         if self.warmups_left > 0:
             self.warmups_left -= 1
             return False

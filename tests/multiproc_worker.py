@@ -340,6 +340,36 @@ def main():
         cyc, fus = e.current_params()
         assert abs(cyc - 0.0123) < 1e-9 and fus == 777216, (cyc, fus)
         print(f"proc {pid}: params propagated", flush=True)
+    elif scenario == "engine_idle_backoff":
+        # After an all-quiet stretch every process's negotiation loop has
+        # backed off to HVD_NEGOTIATION_IDLE_MAX. Peers back off
+        # CONCURRENTLY and a local enqueue wakes the local loop, so the
+        # first op after the stretch must land within ~one backoff cap —
+        # NOT nproc × cap compounding (reference analogue: the MPI
+        # coordinator ticks every rank each cycle regardless of idleness,
+        # operations.cc:2117 — it has no backoff to compound).
+        import time
+
+        from horovod_tpu.core import engine as eng
+
+        e = eng.get_engine()
+        # Warm the negotiated path: coordinator built, round 0 consumed.
+        np.testing.assert_allclose(
+            e.synchronize(e.allreduce_async("warm", np.ones((2,), np.float32),
+                                            False)),
+            np.full((2,), float(local_devices * nproc)))
+        cap = float(os.environ.get("HVD_NEGOTIATION_IDLE_MAX", "1.0"))
+        time.sleep(max(3.0, 2 * cap))  # enough idle rounds to max the backoff
+        t0 = time.monotonic()
+        out = e.synchronize(
+            e.allreduce_async("after_idle", np.ones((2,), np.float32), False))
+        dt = time.monotonic() - t0
+        np.testing.assert_allclose(
+            out, np.full((2,), float(local_devices * nproc)))
+        # Generous slack for process skew + round trip; the failure mode
+        # being pinned (serial compounding) would cost >= (nproc-1) * cap.
+        assert dt < cap + 2.0, f"first op after idle took {dt:.2f}s"
+        print(f"proc {pid}: IDLE_LATENCY {dt:.3f}", flush=True)
     elif scenario == "torch_errors":
         # Reference error-path tests drive mismatches through the TORCH
         # API and assert the coordinator error surfaces as an exception on

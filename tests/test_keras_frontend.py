@@ -84,10 +84,17 @@ def test_momentum_correction_scales_trace(hvd):
 
 
 def test_save_and_load_model(hvd, tmp_path):
+    import horovod_tpu.jax as hvd_jax
+
     x, y = _data(64)
     t = hvd_keras.Trainer(MnistMLP(hidden=16), optax.adam(1e-2))
     t.fit(x, y, batch_size=2, epochs=2)
-    path = t.save(str(tmp_path))
+    # Multi-controller worlds (the launcher runs this file under -np 2 the
+    # way the reference runs its suite under mpirun): save() writes on
+    # process 0 only and returns None elsewhere. Its path is valid on
+    # every process (single-host launcher => shared FS), and the
+    # broadcast doubles as the write->read barrier.
+    path = hvd_jax.broadcast_object(t.save(str(tmp_path)))
     assert path is not None
     ref_logs = t.evaluate(x, y, batch_size=2)
 
@@ -101,12 +108,19 @@ def test_save_and_load_model(hvd, tmp_path):
 
 
 def test_latest_checkpoint(hvd, tmp_path):
+    import horovod_tpu.jax as hvd_jax
     from horovod_tpu.utils import latest_checkpoint, save_checkpoint
 
-    assert latest_checkpoint(str(tmp_path)) is None
-    save_checkpoint(str(tmp_path), {"a": np.zeros(2)}, step=1)
-    save_checkpoint(str(tmp_path), {"a": np.ones(2)}, step=10)
-    p = latest_checkpoint(str(tmp_path))
+    # Share process 0's directory (writes happen there only); peers must
+    # not probe the empty-dir case on it — process 0 may already have
+    # saved by the time they look.
+    shared = hvd_jax.broadcast_object(str(tmp_path))
+    if hvd.cross_rank() == 0:
+        assert latest_checkpoint(shared) is None
+    save_checkpoint(shared, {"a": np.zeros(2)}, step=1)
+    save_checkpoint(shared, {"a": np.ones(2)}, step=10)
+    hvd_jax.broadcast_object(None)  # write->read barrier for peers
+    p = latest_checkpoint(shared)
     assert p is not None and p.endswith("checkpoint_10.msgpack")
 
 

@@ -83,8 +83,11 @@ def test_broadcast_gradient_root():
         y = hvd_tf.broadcast(x, root_rank=0)
         loss = tf.reduce_sum(y)
     g = tape.gradient(loss, x)
-    # This controller is rank 0 (root): receives the allreduced grad.
-    np.testing.assert_allclose(g.numpy(), np.full(2, 8.0))
+    # The root's controller receives the allreduced grad; every other
+    # controller zeros (reference: mpi_ops.py:168-183 — under the
+    # launcher's -np 2 world this file also runs as a non-root process).
+    expect = 8.0 if hvd_tf.rank() == 0 else 0.0
+    np.testing.assert_allclose(g.numpy(), np.full(2, expect))
 
 
 def test_allreduce_gradient_average_and_cotangent():
@@ -112,8 +115,12 @@ def test_allgather_gradient_cotangent_slices():
     with tf.GradientTape() as tape:
         loss = tf.reduce_sum(hvd_tf.allgather(x) * w)
     g = tape.gradient(loss, x)
-    # Every rank contributes w; rank 0's row slice is w[0:1] * 8.
-    np.testing.assert_allclose(g.numpy(), w[0:1].numpy() * 8)
+    # Every rank contributes w; this controller's slice is its first
+    # chip's row (host-side API semantics: the controller acts as its
+    # first chip — rank 0 single-process, rank 4 for the launcher's
+    # second process).
+    r = hvd_tf.rank()
+    np.testing.assert_allclose(g.numpy(), w[r:r + 1].numpy() * 8)
 
 
 def test_sparse_allreduce_indexed_slices():
@@ -150,6 +157,11 @@ def test_distributed_optimizer_trains():
         [tf.keras.layers.Dense(4, activation="relu", input_shape=(3,)),
          tf.keras.layers.Dense(1)])
     opt = hvd_tf.DistributedOptimizer(tf.keras.optimizers.SGD(0.05))
+    # Controllers initialize with different random weights; start agreed
+    # (the reference's canonical startup, horovod/tensorflow/__init__.py
+    # BroadcastGlobalVariablesHook) — without this, averaged gradients in
+    # the launcher's -np 2 world descend a mixture of two models.
+    hvd_tf.broadcast_variables(model.trainable_variables, root_rank=0)
     x = tf.constant(np.random.RandomState(1).randn(32, 3), tf.float32)
     y = tf.reduce_sum(x, axis=1, keepdims=True)
     losses = []
