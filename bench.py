@@ -83,7 +83,6 @@ def main():
     # Deliberately imported here, not at module top: `bench.py --help`
     # and argparse errors must not pay the framework+jax import.
     from horovod_tpu.utils import hardware as hw
-    from horovod_tpu.utils.hardware import peak_flops, peak_hbm_bw
 
     hvd.init()
     nchips = hvd.size()
@@ -256,8 +255,8 @@ def main():
 
     per_chip = float(np.median(rates))
     step_time = args.batch_size / per_chip
-    peak = peak_flops(jax.devices()[0])
-    peak_bw = peak_hbm_bw(jax.devices()[0])
+    peak = hw.peak_flops(jax.devices()[0])
+    peak_bw = hw.peak_hbm_bw(jax.devices()[0])
     if peak and flops_per_step / step_time > peak:
         # Guard against a cost-analysis that counted the full scan (all
         # spc steps, would make MFU read > 1 on a sane measurement): the
@@ -287,7 +286,10 @@ def main():
         "unit": "images/sec/chip",
         "vs_baseline": round(per_chip / BASELINE_IMG_PER_SEC_PER_DEVICE, 3),
         "step_time_ms": round(step_time * 1e3, 3),
-        "gflops_per_step": round(flops_per_step / 1e9, 1),
+        # None (not 0.0) when cost analysis failed — same no-fake-zero
+        # rule as hbm_gb_per_step.
+        "gflops_per_step": (round(flops_per_step / 1e9, 1)
+                            if flops_per_step else None),
         "mfu": round(mfu, 4) if mfu is not None else None,
         "hbm_gb_per_step": (round(bytes_per_step / 1e9, 2)
                             if bytes_per_step is not None else None),

@@ -48,6 +48,17 @@ def main():
     ap.add_argument("--flash", action="store_true",
                     help="use the pallas flash-attention kernel "
                          "(forward + backward) instead of stock attention")
+    ap.add_argument("--fused-loss", action="store_true",
+                    help="chunked LM-head cross-entropy: never "
+                         "materializes the [tokens, vocab] logits "
+                         "(ops/chunked_loss.py)")
+    ap.add_argument("--loss-chunk", type=int, default=1024,
+                    help="vocab tile width for --fused-loss (1024 is the "
+                         "largest that fits the 16 MB scoped-VMEM stack)")
+    ap.add_argument("--profile", metavar="DIR", default=None,
+                    help="capture an XLA profiler trace of one timed "
+                         "window (summarize: python -m "
+                         "horovod_tpu.utils.xplane DIR)")
     args = ap.parse_args()
 
     hvd.init()
@@ -81,11 +92,23 @@ def main():
                    jax.tree_util.tree_leaves(params))
     print(f"# params: {n_params/1e6:.1f}M, {hvd.size()} chip(s)")
 
-    def loss_fn(params, toks):
-        logits = model.apply({"params": params}, toks)
-        tgt = jnp.roll(toks, -1, axis=1)
-        return optax.softmax_cross_entropy_with_integer_labels(
-            logits, tgt).mean()
+    if args.fused_loss:
+        from horovod_tpu.ops.chunked_loss import fused_softmax_cross_entropy
+
+        def loss_fn(params, toks):
+            hidden = model.apply({"params": params}, toks,
+                                 return_hidden=True)
+            tgt = jnp.roll(toks, -1, axis=1)
+            head = params["lm_head"]
+            return fused_softmax_cross_entropy(
+                hidden, head["kernel"], head["bias"], tgt,
+                block_v=args.loss_chunk).mean()
+    else:
+        def loss_fn(params, toks):
+            logits = model.apply({"params": params}, toks)
+            tgt = jnp.roll(toks, -1, axis=1)
+            return optax.softmax_cross_entropy_with_integer_labels(
+                logits, tgt).mean()
 
     def one_step(params, opt_state, toks):
         loss, g = jax.value_and_grad(loss_fn)(params, toks)
@@ -116,6 +139,7 @@ def main():
     # the printout carries MFU (cost analysis counts a scan body once —
     # see bench.py for the on-chip verification of that invariant).
     flops_per_step = 0.0
+    counted = 1  # scan steps cost_analysis holds (set with flops below)
     step_fn = step
     try:
         compiled = step.lower(params, opt_state, toks).compile()
@@ -127,8 +151,8 @@ def main():
 
         # Scan body + peeled remainder each counted once (bench.py's
         # on-chip-verified rule, shared via utils.hardware).
-        flops_per_step = float(ca.get("flops", 0.0)) / \
-            scan_cost_analysis_steps(spc, args.unroll)
+        counted = scan_cost_analysis_steps(spc, args.unroll)
+        flops_per_step = float(ca.get("flops", 0.0)) / counted
     except Exception as exc:  # pragma: no cover
         print(f"# cost_analysis unavailable: {exc}", file=sys.stderr)
 
@@ -140,6 +164,16 @@ def main():
     # Real device->host fetch: block_until_ready is not an execution
     # barrier on the tunneled axon platform (see bench.py).
     float(np.asarray(loss))
+
+    if args.profile:
+        from horovod_tpu.utils import profiler
+
+        with profiler.profile(args.profile):
+            for _ in range(ncalls):
+                params, opt_state, loss = step_fn(params, opt_state, toks)
+            float(np.asarray(loss))  # fetch barrier INSIDE the trace
+        print(f"# profile: {len(profiler.trace_files(args.profile))} "
+              f"xplane file(s) in {args.profile}", file=sys.stderr)
 
     t0 = time.perf_counter()
     for _ in range(ncalls):
@@ -153,7 +187,9 @@ def main():
 
     peak = peak_flops(jax.devices()[0])
     if peak and flops_per_step / step_time > peak:
-        flops_per_step /= spc  # scan-body double count guard (bench.py)
+        # Value was pre-divided by `counted`: recover one step's FLOPs as
+        # raw/spc (the same over-peak guard rescale as bench.py).
+        flops_per_step *= counted / spc
     mfu = flops_per_step / step_time / peak if peak and flops_per_step \
         else float("nan")
     print(f"tokens/sec/chip: {tok_per_sec:.0f}  "

@@ -107,7 +107,8 @@ class TransformerLM(nn.Module):
     cfg: TransformerConfig
 
     @nn.compact
-    def __call__(self, tokens, deterministic: bool = True):
+    def __call__(self, tokens, deterministic: bool = True,
+                 return_hidden: bool = False):
         cfg = self.cfg
         if tokens.shape[-1] > cfg.max_len:
             # Out-of-range gathers are silently clamped under jit; fail
@@ -126,6 +127,12 @@ class TransformerLM(nn.Module):
         for i in range(cfg.num_layers):
             x = layer(cfg, name=f"layer_{i}")(x, None, deterministic)
         x = nn.LayerNorm(dtype=cfg.dtype, name="final_norm")(x)
+        if return_hidden:
+            # Pre-head hidden states, for heads that consume the weights
+            # directly without materializing [.., vocab] logits
+            # (ops/chunked_loss.py). lm_head params still exist: init
+            # runs with return_hidden=False.
+            return x
         # Untied output head, f32 logits.
         return nn.Dense(cfg.vocab_size, dtype=jnp.float32, name="lm_head")(x)
 

@@ -1,0 +1,128 @@
+"""XLA profile (xplane) summarizer — where does the step time go?
+
+The reference answers "where did the time go" with its chrome-tracing
+timeline of host-side engine phases (horovod/common/timeline.cc); on TPU
+the compiled step is one fused XLA program, so the equivalent question is
+answered from the XLA profiler's device plane. This module turns a
+``jax.profiler.trace`` capture (``bench.py --profile DIR``,
+``examples/bert_pretraining_benchmark.py --profile DIR``) into the
+per-op-category breakdown used in docs/benchmarks.md:
+
+    python -m horovod_tpu.utils.xplane /tmp/prof [--top 30]
+
+It parses the ``*.xplane.pb`` protobuf with the proto bindings TF ships
+(tensorflow.tsl.profiler.protobuf) — no tensorboard needed.
+"""
+
+from __future__ import annotations
+
+import collections
+import re
+from typing import Dict, List, Tuple
+
+
+def _load_spaces(logdir: str):
+    from tensorflow.tsl.profiler.protobuf import xplane_pb2
+
+    from horovod_tpu.utils.profiler import trace_files
+
+    spaces = []
+    for path in trace_files(logdir):
+        space = xplane_pb2.XSpace()
+        with open(path, "rb") as f:
+            space.ParseFromString(f.read())
+        spaces.append(space)
+    return spaces
+
+
+def device_op_times(logdir: str, line_name: str = "XLA Ops") -> Dict[str, float]:
+    """Sum device-plane event durations (ms) by op/fusion name across all
+    captured cores, from the ``line_name`` line only.
+
+    The TPU device plane carries hierarchical lines — "Steps" and
+    "XLA Modules" span whole steps, "Async XLA Ops" are DMA spans that
+    overlap compute — so summing everything would double-count wildly.
+    "XLA Ops" is the sequencer's occupancy: its events tile the step
+    back-to-back (a copy-done there is the WAIT the scheduler failed to
+    hide, not the copy itself), which is the decomposition
+    docs/benchmarks.md's tables use."""
+    totals: Dict[str, float] = collections.defaultdict(float)
+    for space in _load_spaces(logdir):
+        for plane in space.planes:
+            if "/device:" not in plane.name and "TPU" not in plane.name:
+                continue
+            meta = {i: m.name for i, m in plane.event_metadata.items()}
+            for line in plane.lines:
+                if line.name != line_name:
+                    continue
+                for ev in line.events:
+                    name = meta.get(ev.metadata_id, str(ev.metadata_id))
+                    totals[name] += ev.duration_ps / 1e9  # ps -> ms
+    return dict(totals)
+
+
+_CATEGORIES: List[Tuple[str, str]] = [
+    # (regex on op name, category label) — first match wins.
+    (r"convolution|conv\d|%conv", "convolution"),
+    (r"convert.*fusion|fusion.*convert", "convert/reduce fusion"),
+    (r"multiply.*add.*fusion|scatter.*fusion", "multiply-add fusion"),
+    (r"fusion", "other fusion"),
+    (r"copy|slice|bitcast|transpose|reshape", "copy/layout"),
+    (r"all-reduce|all-gather|reduce-scatter|collective|permute",
+     "collective"),
+    (r"dot|einsum|matmul", "matmul"),
+    (r"select-and-scatter", "select-and-scatter"),
+    (r"rng|random", "rng"),
+    (r"infeed|outfeed|send|recv", "host transfer"),
+]
+
+
+def categorize(name: str) -> str:
+    low = name.lower()
+    for pat, label in _CATEGORIES:
+        if re.search(pat, low):
+            return label
+    return "other"
+
+
+def summarize(logdir: str, top: int = 25, line_name: str = "XLA Ops") -> str:
+    """Human-readable breakdown: per-category totals plus the `top`
+    heaviest individual ops."""
+    times = device_op_times(logdir, line_name=line_name)
+    if not times:
+        return f"no device-plane events found under {logdir}"
+    total = sum(times.values())
+    by_cat: Dict[str, float] = collections.defaultdict(float)
+    by_cat_n: Dict[str, int] = collections.defaultdict(int)
+    for name, ms in times.items():
+        c = categorize(name)
+        by_cat[c] += ms
+        by_cat_n[c] += 1
+    out = [f"device op time total: {total:.2f} ms (all cores, whole trace)",
+           "", "by category:"]
+    for cat, ms in sorted(by_cat.items(), key=lambda kv: -kv[1]):
+        out.append(f"  {ms:10.2f} ms  {100 * ms / total:5.1f}%  "
+                   f"{cat}  (x{by_cat_n[cat]})")
+    out.append("")
+    out.append(f"top {top} ops:")
+    for name, ms in sorted(times.items(), key=lambda kv: -kv[1])[:top]:
+        out.append(f"  {ms:10.2f} ms  {100 * ms / total:5.1f}%  {name[:90]}")
+    return "\n".join(out)
+
+
+def main(argv=None):
+    import argparse
+
+    ap = argparse.ArgumentParser(
+        description="Summarize a jax.profiler.trace capture by device op")
+    ap.add_argument("logdir")
+    ap.add_argument("--top", type=int, default=25)
+    ap.add_argument("--line", default="XLA Ops",
+                    help="device-plane line to sum (e.g. 'Async XLA Ops' "
+                         "for the overlapped DMA spans)")
+    args = ap.parse_args(argv)
+    print(summarize(args.logdir, top=args.top, line_name=args.line))
+
+
+if __name__ == "__main__":
+    main()

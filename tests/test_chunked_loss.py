@@ -1,0 +1,126 @@
+"""Chunked / pallas LM-head cross-entropy vs the stock logits path.
+
+The reference has no transformer ops; this pins the TPU-native scope
+addition (ops/chunked_loss.py) the way test_flash_attention.py pins the
+flash kernel: exact forward/gradient agreement with the naive
+implementation on CPU (pallas interpret mode), including the padding
+edges (vocab not a chunk multiple, tokens not a block multiple)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from horovod_tpu.ops.chunked_loss import (
+    chunked_softmax_cross_entropy,
+    fused_softmax_cross_entropy,
+)
+
+
+def _data(n_lead=(3, 5), hdim=16, vocab=70, dtype=jnp.float32, seed=0):
+    rng = np.random.RandomState(seed)
+    h = jnp.asarray(rng.randn(*n_lead, hdim), dtype)
+    w = jnp.asarray(rng.randn(hdim, vocab) * 0.1, jnp.float32)
+    b = jnp.asarray(rng.randn(vocab) * 0.1, jnp.float32)
+    lab = jnp.asarray(rng.randint(0, vocab, n_lead), jnp.int32)
+    return h, w, b, lab
+
+
+def _ref_losses(h, w, b, lab):
+    logits = h.astype(jnp.float32) @ w + b
+    lse = jax.nn.logsumexp(logits, axis=-1)
+    gold = jnp.take_along_axis(logits, lab[..., None], axis=-1)[..., 0]
+    return lse - gold
+
+
+@pytest.mark.parametrize("impl,kw", [
+    (chunked_softmax_cross_entropy, {"chunk": 32}),
+    (fused_softmax_cross_entropy, {"block_n": 8, "block_v": 32}),
+])
+def test_forward_matches_reference(impl, kw):
+    h, w, b, lab = _data()  # V=70: not a multiple of 32 -> padding path
+    out = impl(h, w, b, lab, **kw)
+    np.testing.assert_allclose(np.asarray(out),
+                               np.asarray(_ref_losses(h, w, b, lab)),
+                               rtol=1e-5, atol=1e-6)
+
+
+@pytest.mark.parametrize("impl,kw", [
+    (chunked_softmax_cross_entropy, {"chunk": 32}),
+    (fused_softmax_cross_entropy, {"block_n": 8, "block_v": 32}),
+])
+def test_gradients_match_reference(impl, kw):
+    h, w, b, lab = _data()
+    # Non-uniform per-token cotangents (the reference test style:
+    # multiply by a random tensor before reducing).
+    wvec = jnp.asarray(np.random.RandomState(1).rand(3, 5), jnp.float32)
+
+    ref = jax.grad(lambda *a: (_ref_losses(*a, lab) * wvec).mean(),
+                   argnums=(0, 1, 2))(h, w, b)
+    got = jax.grad(lambda *a: (impl(*a, lab, **kw) * wvec).mean(),
+                   argnums=(0, 1, 2))(h, w, b)
+    for r, g in zip(ref, got):
+        np.testing.assert_allclose(np.asarray(g), np.asarray(r),
+                                   rtol=2e-4, atol=1e-6)
+
+
+def test_dx_dtype_follows_hidden():
+    h, w, b, lab = _data(dtype=jnp.bfloat16)
+    g = jax.grad(lambda x: fused_softmax_cross_entropy(
+        x, w, b, lab, 8, 32).mean())(h)
+    assert g.dtype == jnp.bfloat16
+
+
+def test_model_level_equivalence():
+    """return_hidden + chunked head == stock lm_head -> optax CE, through
+    a real TransformerLM (same params, same loss, same grads)."""
+    import optax
+
+    from horovod_tpu.models import TransformerConfig, TransformerLM
+
+    cfg = TransformerConfig(vocab_size=97, num_layers=2, num_heads=2,
+                            hidden_dim=32, mlp_dim=64, max_len=16,
+                            dtype=jnp.float32)
+    model = TransformerLM(cfg)
+    toks = jnp.asarray(np.random.RandomState(0).randint(0, 97, (4, 16)),
+                       jnp.int32)
+    params = model.init(jax.random.PRNGKey(0), toks)["params"]
+    tgt = jnp.roll(toks, -1, axis=1)
+
+    def stock(p):
+        logits = model.apply({"params": p}, toks)
+        return optax.softmax_cross_entropy_with_integer_labels(
+            logits, tgt).mean()
+
+    def fused(p):
+        hidden = model.apply({"params": p}, toks, return_hidden=True)
+        return chunked_softmax_cross_entropy(
+            hidden, p["lm_head"]["kernel"], p["lm_head"]["bias"], tgt,
+            32).mean()
+
+    ls, gs = jax.value_and_grad(stock)(params)
+    lf, gf = jax.value_and_grad(fused)(params)
+    np.testing.assert_allclose(float(lf), float(ls), rtol=1e-6)
+    flat_s = {jax.tree_util.keystr(k): x
+              for k, x in jax.tree_util.tree_leaves_with_path(gs)}
+    flat_f = {jax.tree_util.keystr(k): x
+              for k, x in jax.tree_util.tree_leaves_with_path(gf)}
+    assert set(flat_s) == set(flat_f)
+    for k in flat_s:
+        np.testing.assert_allclose(np.asarray(flat_f[k]),
+                                   np.asarray(flat_s[k]),
+                                   rtol=5e-4, atol=1e-6, err_msg=k)
+
+
+def test_init_param_tree_unchanged_by_return_hidden():
+    """lm_head params exist (init never passes return_hidden) so
+    checkpoints and optimizer states are unaffected by the new kwarg."""
+    from horovod_tpu.models import TransformerConfig, TransformerLM
+
+    cfg = TransformerConfig(vocab_size=50, num_layers=1, num_heads=2,
+                            hidden_dim=16, mlp_dim=32, max_len=8)
+    model = TransformerLM(cfg)
+    toks = jnp.zeros((1, 8), jnp.int32)
+    params = model.init(jax.random.PRNGKey(0), toks)["params"]
+    assert "lm_head" in params
+    assert params["lm_head"]["kernel"].shape == (16, 50)
