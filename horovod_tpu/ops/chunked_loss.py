@@ -152,11 +152,7 @@ def _bwd(chunk, residuals, g):
             None)
 
 
-def _fwd_rule(hidden, kernel, bias, labels, chunk):
-    return _fwd(hidden, kernel, bias, labels, chunk)
-
-
-chunked_softmax_cross_entropy.defvjp(_fwd_rule, _bwd)
+chunked_softmax_cross_entropy.defvjp(_fwd, _bwd)
 
 
 # ---------------------------------------------------------------------------
