@@ -40,8 +40,10 @@ def main():
     ap.add_argument("--steps-per-call", type=int, default=5,
                     help="steps fused into one dispatch via lax.scan "
                          "(amortizes per-call host latency; see bench.py)")
-    ap.add_argument("--unroll", type=int, default=1,
-                    help="scan unroll factor (see bench.py --unroll)")
+    ap.add_argument("--unroll", type=int, default=5,
+                    help="scan unroll factor: lets XLA software-pipeline "
+                         "across step boundaries (bench.py --unroll; "
+                         "measured +3.8%% tokens/sec on BERT-base here)")
     ap.add_argument("--bf16", action="store_true", default=True)
     ap.add_argument("--remat", action="store_true",
                     help="checkpoint each layer (HBM for FLOPs)")

@@ -218,6 +218,14 @@ def test_four_process_sigkill_peer_times_out_not_hangs(engine):
                for out in outs) == 3, outs[0][-2000:]
 
 
+def test_eight_process_collectives():
+    """The widest world one host can stage: 8 controllers x 1 chip.
+    Negotiation readiness/cleanup and the compiled collectives hold at
+    P=8 (reference: the mpirun tier ran the same suite at any -np)."""
+    _run_world("collectives", nproc=8, timeout=420,
+               extra_env={"HVD_TEST_LOCAL_DEVICES": "1"})
+
+
 @pytest.mark.parametrize("engine", ENGINES)
 def test_four_process_idle_backoff_does_not_compound(engine):
     """First op after an all-quiet stretch completes within ~one idle
