@@ -105,9 +105,12 @@ def main():
     images_host = np.random.uniform(
         size=(args.batch_size, args.image_size, args.image_size, 3)
     ).astype(jnp.bfloat16)
-    labels_host = np.random.randint(0, 1000, size=(args.batch_size,))
 
     variables = model.init(rng, jnp.asarray(images_host), False)
+    # Label range from the model's own head width: a hardcoded 1000
+    # NaNs the loss for the 10-class mnist_* models.
+    labels_host = np.random.randint(0, model.num_classes,
+                                    size=(args.batch_size,))
     params, batch_stats = variables["params"], variables.get("batch_stats", {})
     opt_state = opt.init(params)
     # Startup sync, as every reference example does before training
