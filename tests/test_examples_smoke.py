@@ -34,6 +34,11 @@ _CASES = {
     "hybrid_parallel_transformer.py": [],
     "allreduce_benchmark.py": ["--sizes-mb", "0.25", "--iters", "2",
                                "--warmup", "1"],
+    # Exercises the multi-chip mechanics (subset re-init, per-n meshes)
+    # the docstring promises are known-good for real hardware.
+    "scaling_benchmark.py": ["--sizes-mb", "0.25", "--model", "mnist_mlp",
+                             "--image-size", "28", "--batch-size", "8",
+                             "--steps", "2", "--chips", "1", "2", "8"],
 }
 
 
