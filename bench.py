@@ -117,42 +117,50 @@ def main():
     # (reference: BroadcastGlobalVariablesHook).
     params = hvd_jax.broadcast_parameters(params, root_rank=0)
 
-    def loss_fn(params, batch_stats, images, labels):
+    def loss_fn(params, batch_stats, images, labels, dropout_rng):
+        # Unused rng collections are ignored by models without dropout
+        # (resnet/mnist); vgg16/inceptionv3 train with 0.5 dropout and
+        # need it — a benchmark that silently disabled dropout would
+        # overstate them.
         logits, mutated = model.apply(
             {"params": params, "batch_stats": batch_stats}, images, True,
-            mutable=["batch_stats"])
+            mutable=["batch_stats"], rngs={"dropout": dropout_rng})
         loss = optax.softmax_cross_entropy_with_integer_labels(
             logits, labels).mean()
         return loss, mutated["batch_stats"]
 
-    def one_step(params, batch_stats, opt_state, images, labels):
+    def one_step(params, batch_stats, opt_state, key, images, labels):
+        key, sub = jax.random.split(key)
         (loss, new_bs), grads = jax.value_and_grad(
-            loss_fn, has_aux=True)(params, batch_stats, images, labels)
+            loss_fn, has_aux=True)(params, batch_stats, images, labels,
+                                   sub)
         updates, opt_state = opt.update(grads, opt_state, params)
         params = optax.apply_updates(params, updates)
-        return params, new_bs, opt_state, hvd_jax.allreduce(loss)
+        return params, new_bs, opt_state, key, hvd_jax.allreduce(loss)
 
     spc = max(1, args.steps_per_call)
 
     @hvd_jax.jit(
-        in_specs=(P(), P(), P(), P(hvd_jax.HVD_AXIS), P(hvd_jax.HVD_AXIS)),
-        out_specs=(P(), P(), P(), P()),
+        in_specs=(P(), P(), P(), P(),
+                  P(hvd_jax.HVD_AXIS), P(hvd_jax.HVD_AXIS)),
+        out_specs=(P(), P(), P(), P(), P()),
         donate_argnums=(0, 1, 2),
     )
-    def train_step(params, batch_stats, opt_state, images, labels):
+    def train_step(params, batch_stats, opt_state, key, images, labels):
         if spc == 1:
-            return one_step(params, batch_stats, opt_state, images, labels)
+            return one_step(params, batch_stats, opt_state, key, images,
+                            labels)
 
         def body(carry, _):
-            params, batch_stats, opt_state = carry
-            params, batch_stats, opt_state, loss = one_step(
-                params, batch_stats, opt_state, images, labels)
-            return (params, batch_stats, opt_state), loss
+            params, batch_stats, opt_state, key = carry
+            params, batch_stats, opt_state, key, loss = one_step(
+                params, batch_stats, opt_state, key, images, labels)
+            return (params, batch_stats, opt_state, key), loss
 
-        (params, batch_stats, opt_state), losses = jax.lax.scan(
-            body, (params, batch_stats, opt_state), None, length=spc,
+        (params, batch_stats, opt_state, key), losses = jax.lax.scan(
+            body, (params, batch_stats, opt_state, key), None, length=spc,
             unroll=max(1, args.unroll))
-        return params, batch_stats, opt_state, losses[-1]
+        return params, batch_stats, opt_state, key, losses[-1]
 
     # Each chip sees the full per-chip batch: global batch = B * size.
     mesh = hvd.mesh()
@@ -167,6 +175,7 @@ def main():
 
     images = chip_batch(images_host)
     labels = chip_batch(labels_host)
+    step_key = jax.random.PRNGKey(hvd.rank())  # dropout stream (vgg/inception)
 
     # XLA's own FLOP count for the compiled step (reference methodology
     # anchor: tensorflow_synthetic_benchmark.py:96-106 reports img/sec; we
@@ -190,8 +199,8 @@ def main():
         copts[k] = v
     try:
         compiled = train_step.lower(
-            params, batch_stats, opt_state, images, labels).compile(
-                compiler_options=copts or None)
+            params, batch_stats, opt_state, step_key, images,
+            labels).compile(compiler_options=copts or None)
         step_fn = compiled
         ca = compiled.cost_analysis()
         if isinstance(ca, (list, tuple)):
@@ -220,11 +229,11 @@ def main():
         print(f"# cost_analysis unavailable: {e}", file=sys.stderr)
 
     def run_batches(ncalls):
-        nonlocal params, batch_stats, opt_state
+        nonlocal params, batch_stats, opt_state, step_key
         loss = None
         for _ in range(ncalls):
-            params, batch_stats, opt_state, loss = step_fn(
-                params, batch_stats, opt_state, images, labels)
+            params, batch_stats, opt_state, step_key, loss = step_fn(
+                params, batch_stats, opt_state, step_key, images, labels)
         # Real device->host fetch: the only reliable execution barrier on
         # the tunneled platform (block_until_ready returns early there).
         return float(np.asarray(loss))

@@ -44,6 +44,12 @@ def main():
     hvd.init()
     n = args.steps * args.batch_size * hvd.local_size()
     x, y = synthetic_imagenet(n=n, size=args.image_size)
+    import jax.numpy as jnp
+
+    # Feed bf16: the model computes in bf16, and halving the host->device
+    # bytes matters wherever the feed link is the bottleneck (bench.py
+    # does the same; measured 2x on the tunneled chip).
+    x = x.astype(jnp.bfloat16)
 
     trainer = hvd_keras.Trainer(
         ResNet50(),

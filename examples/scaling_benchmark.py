@@ -79,6 +79,9 @@ def main():
     if skipped:
         print(f"# skipping {skipped}: world has only {world} chip(s)")
         chips = [n for n in chips if n <= world]
+    if not chips:
+        raise SystemExit(f"no requested chip count fits the {world}-chip "
+                         "world; nothing to sweep")
 
     e2e_base = None  # per-chip throughput at the SMALLEST swept n
     print(f"# world: {world} chip(s); sweeping {chips}")
@@ -94,9 +97,21 @@ def main():
             if n == 1:
                 row.append("     n/a")  # no wire to measure
                 continue
+            # Compiled in-SPMD allreduce (allreduce_benchmark.py's
+            # default mode): the eager path would re-stage the buffer
+            # host->device inside the timed window and bill staging, not
+            # the ICI collective, to the scaling number.
+            from jax.sharding import NamedSharding, PartitionSpec
+
+            from horovod_tpu.ops.collectives import ranked_allreduce
+
             elems = int(size_mb * 1024 * 1024 / 4)
-            x = jnp.ones((elems,), jnp.float32)
-            fn = lambda: hvd.allreduce(x, average=False)  # noqa: E731
+            x = jax.device_put(
+                jnp.ones((n, elems), jnp.float32),
+                NamedSharding(hvd.mesh(), PartitionSpec("hvd")))
+            fn = lambda: ranked_allreduce(x)  # noqa: E731
+            # Sliced-scalar fetch: a whole-buffer fetch would bill a
+            # multi-MB host transfer to the collective.
             t = _timeit(fn, lambda o: float(np.asarray(o[0])))
             bus = (2 * (n - 1) / n) * elems * 4 / t / 1e9
             row.append(f"{bus:8.2f}")
@@ -135,21 +150,26 @@ def _train_throughput(args, n):
     params, bstats = variables["params"], variables.get("batch_stats", {})
     opt_state = opt.init(params)
 
-    def loss_fn(p, bs, xx, yy):
+    def loss_fn(p, bs, xx, yy, dk):
+        # Dropout models (vgg16/inceptionv3) need an rng; others ignore it
+        # (bench.py threads the same stream).
         logits, mut = model.apply({"params": p, "batch_stats": bs}, xx,
-                                  True, mutable=["batch_stats"])
+                                  True, mutable=["batch_stats"],
+                                  rngs={"dropout": dk})
         return optax.softmax_cross_entropy_with_integer_labels(
             logits, yy).mean(), mut["batch_stats"]
 
-    @hvd_jax.jit(in_specs=(P(), P(), P(), P(hvd_jax.HVD_AXIS),
+    @hvd_jax.jit(in_specs=(P(), P(), P(), P(), P(hvd_jax.HVD_AXIS),
                            P(hvd_jax.HVD_AXIS)),
-                 out_specs=(P(), P(), P(), P()),
+                 out_specs=(P(), P(), P(), P(), P()),
                  donate_argnums=(0, 1, 2))
-    def step(p, bs, s, xx, yy):
+    def step(p, bs, s, key, xx, yy):
+        key, dk = jax.random.split(key)
         (loss, bs), g = jax.value_and_grad(loss_fn, has_aux=True)(
-            p, bs, xx, yy)
+            p, bs, xx, yy, dk)
         up, s = opt.update(g, s, p)
-        return optax.apply_updates(p, up), bs, s, hvd_jax.allreduce(loss)
+        return (optax.apply_updates(p, up), bs, s, key,
+                hvd_jax.allreduce(loss))
 
     mesh = hvd.mesh()
     from jax.sharding import NamedSharding
@@ -163,11 +183,13 @@ def _train_throughput(args, n):
 
     xx, yy = shard(x), shard(np.asarray(y))
 
+    key = jax.random.PRNGKey(0)
+
     def run():
-        nonlocal params, bstats, opt_state
+        nonlocal params, bstats, opt_state, key
         for _ in range(args.steps):
-            params, bstats, opt_state, loss = step(params, bstats,
-                                                   opt_state, xx, yy)
+            params, bstats, opt_state, key, loss = step(
+                params, bstats, opt_state, key, xx, yy)
         return loss
 
     dt = _timeit(run, lambda loss: float(np.asarray(loss)),

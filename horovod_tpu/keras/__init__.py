@@ -214,8 +214,10 @@ class Trainer:
             for cb in callbacks:
                 cb.on_epoch_begin(epoch)
             logs = {}
-            for b, (xb, yb) in enumerate(
-                    self._batches(x, y, batch_size, shuffle, seed=epoch)):
+            batches = self._batches(x, y, batch_size, shuffle, seed=epoch)
+            nxt, b = next(batches, None), 0
+            while nxt is not None:
+                xb, yb = nxt
                 for cb in callbacks:
                     cb.on_batch_begin(b)
                 self.rng, dk = jax.random.split(self.rng)
@@ -223,9 +225,20 @@ class Trainer:
                     self._train_step(self.params, self.batch_stats,
                                      self.opt_state, xb, yb,
                                      jnp.float32(self.lr_scale), dk)
-                logs = {k: float(v) for k, v in logs.items()}
+                # Prefetch: the step above dispatched asynchronously;
+                # pulling the next batch NOW overlaps its host->device
+                # transfers with the running step (the role tf.data
+                # prefetching plays for reference keras users — without
+                # it, per-batch feed+fetch serializes with compute:
+                # measured 10x on the tunneled chip, docs/benchmarks.md).
+                nxt = next(batches, None)
+                # Batch logs stay device-resident (fetching every batch
+                # costs a full host round trip); callbacks that read a
+                # value pay for exactly that value.
                 for cb in callbacks:
                     cb.on_batch_end(b, logs)
+                b += 1
+            logs = {k: float(v) for k, v in logs.items()}
             if validation_data is not None:
                 val = self.evaluate(*validation_data, batch_size=batch_size)
                 logs.update({f"val_{k}": v for k, v in val.items()})

@@ -27,6 +27,13 @@ _CASES = {
         "--model", "mnist_mlp", "--batch-size", "8",
         "--num-warmup-batches", "1", "--num-batches-per-iter", "2",
         "--num-iters", "1", "--image-size", "8"],
+    # Dropout model through the full bench step: pins the rngs plumbing
+    # (vgg/inception need a dropout stream; mnist/resnet ignore it).
+    "jax_synthetic_benchmark.py --model vgg16": [
+        "--model", "vgg16", "--batch-size", "2",
+        "--num-warmup-batches", "1", "--num-batches-per-iter", "1",
+        "--num-iters", "1", "--image-size", "32", "--steps-per-call",
+        "1"],
     "bert_pretraining_benchmark.py": [
         "--layers", "1", "--hidden", "64", "--heads", "2", "--vocab",
         "128", "--seq-len", "32", "--batch-size", "2", "--steps", "2",
@@ -42,8 +49,9 @@ _CASES = {
 }
 
 
-@pytest.mark.parametrize("script", sorted(_CASES), ids=lambda s: s)
-def test_example_runs(script):
+@pytest.mark.parametrize("case", sorted(_CASES), ids=lambda s: s)
+def test_example_runs(case):
+    script = case.split()[0]  # keys may carry a variant suffix for ids
     env = dict(os.environ)
     # Force the virtual CPU mesh. JAX_PLATFORMS alone is NOT enough: the
     # TPU-plugin site dir on PYTHONPATH pre-imports jax and preempts the
@@ -59,7 +67,7 @@ def test_example_runs(script):
                         + " --xla_force_host_platform_device_count=8")
     proc = subprocess.run(
         [sys.executable, os.path.join(_REPO, "examples", script),
-         *_CASES[script]],
+         *_CASES[case]],
         capture_output=True, text=True, timeout=420, env=env, cwd=_REPO)
     assert proc.returncode == 0, (
         f"{script} failed:\n{proc.stdout[-2500:]}\n{proc.stderr[-1500:]}")
