@@ -230,7 +230,8 @@ class Trainer:
                 # transfers with the running step (the role tf.data
                 # prefetching plays for reference keras users — without
                 # it, per-batch feed+fetch serializes with compute:
-                # measured 10x on the tunneled chip, docs/benchmarks.md).
+                # together with the device-resident logs below, measured
+                # 2.1x on the tunneled chip, docs/benchmarks.md).
                 nxt = next(batches, None)
                 # Batch logs stay device-resident (fetching every batch
                 # costs a full host round trip); callbacks that read a

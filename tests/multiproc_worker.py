@@ -340,6 +340,31 @@ def main():
         cyc, fus = e.current_params()
         assert abs(cyc - 0.0123) < 1e-9 and fus == 777216, (cyc, fus)
         print(f"proc {pid}: params propagated", flush=True)
+    elif scenario == "engine_reinit":
+        # Collective engine shutdown + re-init across the WORLD: the new
+        # incarnation negotiates in a fresh KV namespace (generation
+        # counter) and must neither consume the previous generation's
+        # tombstones/final-round keys nor leak them (reference contract:
+        # MPI_Init/Finalize pairing; here coordinator.py's generation +
+        # residue-reclaim design, unit-tested in test_coordinator.py but
+        # never before exercised with real peer processes).
+        from horovod_tpu.core import engine as eng
+
+        for gen in range(3):
+            e = eng.get_engine()
+            hs = [e.allreduce_async(f"g{gen}/t{i}",
+                                    np.full((4,), float(gen + i + 1),
+                                            np.float32), False)
+                  for i in range(3)]
+            for i, h in enumerate(hs):
+                np.testing.assert_allclose(
+                    e.synchronize(h),
+                    np.full((4,), float((gen + i + 1)
+                                        * local_devices * nproc)))
+            # Engine lifecycle is COLLECTIVE (every process shuts down
+            # the same number of times) — same as MPI_Finalize.
+            eng.shutdown_engine()
+        print(f"proc {pid}: three engine generations OK", flush=True)
     elif scenario == "engine_idle_backoff":
         # After an all-quiet stretch every process's negotiation loop has
         # backed off to HVD_NEGOTIATION_IDLE_MAX. Peers back off
@@ -366,9 +391,11 @@ def main():
         dt = time.monotonic() - t0
         np.testing.assert_allclose(
             out, np.full((2,), float(local_devices * nproc)))
-        # Generous slack for process skew + round trip; the failure mode
-        # being pinned (serial compounding) would cost >= (nproc-1) * cap.
-        assert dt < cap + 2.0, f"first op after idle took {dt:.2f}s"
+        # Generous slack for process skew + round trip + a loaded CI host
+        # (the full suite runs subprocess worlds concurrently); the
+        # failure mode being pinned (serial compounding) would cost
+        # >= (nproc-1) * cap, far above this bound at the test's cap.
+        assert dt < cap + 3.0, f"first op after idle took {dt:.2f}s"
         print(f"proc {pid}: IDLE_LATENCY {dt:.3f}", flush=True)
     elif scenario == "torch_errors":
         # Reference error-path tests drive mismatches through the TORCH

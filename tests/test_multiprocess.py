@@ -218,6 +218,16 @@ def test_four_process_sigkill_peer_times_out_not_hangs(engine):
                for out in outs) == 3, outs[0][-2000:]
 
 
+@pytest.mark.parametrize("engine", ENGINES)
+def test_two_process_engine_reinit_generations(engine):
+    """Three collective shutdown/re-init cycles: each generation
+    negotiates in a fresh namespace and reclaims the previous one's
+    leftover keys (previously only unit-tested against a fake KV)."""
+    outs = _run_world("engine_reinit", nproc=2,
+                      extra_env={"HVD_ENGINE": engine})
+    assert sum("three engine generations OK" in out for out in outs) == 2
+
+
 def test_eight_process_collectives():
     """The widest world one host can stage: 8 controllers x 1 chip.
     Negotiation readiness/cleanup and the compiled collectives hold at
@@ -232,9 +242,11 @@ def test_four_process_idle_backoff_does_not_compound(engine):
     backoff cap, not nproc × cap: peer backoffs run concurrently and a
     local enqueue wakes the local loop (VERDICT r2 weak #6 — previously
     untested at np>2)."""
+    # cap=4 puts the pass bound (cap+3=7s) far under the compounding
+    # signature ((nproc-1)*cap=12s) while tolerating a loaded CI host.
     outs = _run_world("engine_idle_backoff", nproc=4, timeout=300,
                       extra_env={**_NP4, "HVD_ENGINE": engine,
-                                 "HVD_NEGOTIATION_IDLE_MAX": "1.5"})
+                                 "HVD_NEGOTIATION_IDLE_MAX": "4.0"})
     assert sum("IDLE_LATENCY" in out for out in outs) == 4, outs[0][-2000:]
 
 
