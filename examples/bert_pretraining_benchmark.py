@@ -50,6 +50,10 @@ def main():
     ap.add_argument("--flash", action="store_true",
                     help="use the pallas flash-attention kernel "
                          "(forward + backward) instead of stock attention")
+    ap.add_argument("--dropout", action="store_true",
+                    help="train with the model's dropout active (0.1): "
+                         "the pretraining-realistic configuration; "
+                         "default off isolates compute throughput")
     ap.add_argument("--fused-loss", action="store_true",
                     help="chunked LM-head cross-entropy: never "
                          "materializes the [tokens, vocab] logits "
@@ -62,6 +66,12 @@ def main():
                          "window (summarize: python -m "
                          "horovod_tpu.utils.xplane DIR)")
     args = ap.parse_args()
+
+    if args.dropout and "JAX_DEFAULT_PRNG_IMPL" not in os.environ:
+        # Counter-based rbg keys: threefry key derivation/mask generation
+        # costs ~17% of the BERT-base step (measured, docs/benchmarks.md);
+        # rbg brings active dropout to ~5%. Env var overrides.
+        jax.config.update("jax_default_prng_impl", "rbg")
 
     hvd.init()
     attention_fn = None
@@ -94,49 +104,68 @@ def main():
                    jax.tree_util.tree_leaves(params))
     print(f"# params: {n_params/1e6:.1f}M, {hvd.size()} chip(s)")
 
+    # deterministic=False + a per-step rng = the pretraining-realistic
+    # dropout configuration (--dropout); the default isolates compute.
+    det = not args.dropout
+
+    def _apply(params, toks, dk, **kw):
+        rngs = {"dropout": dk} if args.dropout else None
+        return model.apply({"params": params}, toks, deterministic=det,
+                           rngs=rngs, **kw)
+
     if args.fused_loss:
         from horovod_tpu.ops.chunked_loss import fused_softmax_cross_entropy
 
-        def loss_fn(params, toks):
-            hidden = model.apply({"params": params}, toks,
-                                 return_hidden=True)
+        def loss_fn(params, toks, dk):
+            hidden = _apply(params, toks, dk, return_hidden=True)
             tgt = jnp.roll(toks, -1, axis=1)
             head = params["lm_head"]
             return fused_softmax_cross_entropy(
                 hidden, head["kernel"], head["bias"], tgt,
                 block_v=args.loss_chunk).mean()
     else:
-        def loss_fn(params, toks):
-            logits = model.apply({"params": params}, toks)
+        def loss_fn(params, toks, dk):
+            logits = _apply(params, toks, dk)
             tgt = jnp.roll(toks, -1, axis=1)
             return optax.softmax_cross_entropy_with_integer_labels(
                 logits, tgt).mean()
 
-    def one_step(params, opt_state, toks):
-        loss, g = jax.value_and_grad(loss_fn)(params, toks)
+    def one_step(params, opt_state, key, toks):
+        if args.dropout:
+            key, dk = jax.random.split(key)
+        else:
+            dk = key  # unused (rngs=None): the stock program keeps its
+            # published shape — no live split in the scan body
+        loss, g = jax.value_and_grad(loss_fn)(params, toks, dk)
         updates, opt_state = opt.update(g, opt_state, params)
-        return optax.apply_updates(params, updates), opt_state, \
+        return optax.apply_updates(params, updates), opt_state, key, \
             hvd_jax.allreduce(loss)
 
     spc = max(1, args.steps_per_call)
 
-    @hvd_jax.jit(in_specs=(P(), P(), P(hvd_jax.HVD_AXIS)),
-                 out_specs=(P(), P(), P()), donate_argnums=(0, 1))
-    def step(params, opt_state, toks):
+    @hvd_jax.jit(in_specs=(P(), P(), P(), P(hvd_jax.HVD_AXIS)),
+                 out_specs=(P(), P(), P(), P()), donate_argnums=(0, 1))
+    def step(params, opt_state, key, toks):
         if spc == 1:
-            return one_step(params, opt_state, toks)
+            return one_step(params, opt_state, key, toks)
 
         def body(carry, _):
-            params, opt_state = carry
-            params, opt_state, loss = one_step(params, opt_state, toks)
-            return (params, opt_state), loss
+            params, opt_state, key = carry
+            params, opt_state, key, loss = one_step(params, opt_state,
+                                                    key, toks)
+            return (params, opt_state, key), loss
 
-        (params, opt_state), losses = jax.lax.scan(
-            body, (params, opt_state), None, length=spc,
+        (params, opt_state, key), losses = jax.lax.scan(
+            body, (params, opt_state, key), None, length=spc,
             unroll=max(1, args.unroll))
-        return params, opt_state, losses[-1]
+        return params, opt_state, key, losses[-1]
 
     toks = jnp.asarray(tokens)
+    # Per-PROCESS dropout stream: data-parallel replicas must not apply
+    # correlated masks (chips within one controller still share a mask —
+    # acceptable for a benchmark; per-chip streams would fold in
+    # ops.axis_rank() inside the step).
+    step_key = jax.random.fold_in(jax.random.PRNGKey(1), hvd.rank())
     # AOT compile: reuse the executable AND read XLA's own FLOP count so
     # the printout carries MFU (cost analysis counts a scan body once —
     # see bench.py for the on-chip verification of that invariant).
@@ -144,7 +173,8 @@ def main():
     counted = 1  # scan steps cost_analysis holds (set with flops below)
     step_fn = step
     try:
-        compiled = step.lower(params, opt_state, toks).compile()
+        compiled = step.lower(params, opt_state, step_key,
+                              toks).compile()
         step_fn = compiled
         ca = compiled.cost_analysis()
         if isinstance(ca, (list, tuple)):
@@ -162,7 +192,8 @@ def main():
     ncalls = max(1, args.steps // spc)
     nsteps = ncalls * spc
     for _ in range(ncalls_warm):
-        params, opt_state, loss = step_fn(params, opt_state, toks)
+        params, opt_state, step_key, loss = step_fn(params, opt_state,
+                                                    step_key, toks)
     # Real device->host fetch: block_until_ready is not an execution
     # barrier on the tunneled axon platform (see bench.py).
     float(np.asarray(loss))
@@ -172,14 +203,16 @@ def main():
 
         with profiler.profile(args.profile):
             for _ in range(ncalls):
-                params, opt_state, loss = step_fn(params, opt_state, toks)
+                params, opt_state, step_key, loss = step_fn(
+                    params, opt_state, step_key, toks)
             float(np.asarray(loss))  # fetch barrier INSIDE the trace
         print(f"# profile: {len(profiler.trace_files(args.profile))} "
               f"xplane file(s) in {args.profile}", file=sys.stderr)
 
     t0 = time.perf_counter()
     for _ in range(ncalls):
-        params, opt_state, loss = step_fn(params, opt_state, toks)
+        params, opt_state, step_key, loss = step_fn(params, opt_state,
+                                                    step_key, toks)
     float(np.asarray(loss))
     dt = time.perf_counter() - t0
     step_time = dt / nsteps

@@ -340,6 +340,61 @@ def main():
         cyc, fus = e.current_params()
         assert abs(cyc - 0.0123) < 1e-9 and fus == 777216, (cyc, fus)
         print(f"proc {pid}: params propagated", flush=True)
+    elif scenario == "engine_fuzz":
+        # The reference's negotiation guarantee: any tensors, enqueued in
+        # any order at any time, complete with identical batch
+        # composition everywhere (SURVEY hard part (c); reference:
+        # ConstructMPIResponse handles arbitrary arrival interleavings).
+        # Each process submits the SAME 40 ops but in its own shuffled
+        # order with random think-time between enqueues — so negotiation
+        # rounds see genuinely divergent partial tables — then everything
+        # must still complete with the right values.
+        import random
+        import time
+
+        from horovod_tpu.core import engine as eng
+
+        e = eng.get_engine()
+        rnd = random.Random(1234 + pid)  # per-process order + timing
+        ops = []
+        for i in range(40):
+            kind = ("allreduce", "broadcast", "allgather")[i % 3]
+            ops.append((kind, i))
+        rnd.shuffle(ops)
+        handles = {}
+        # Per-process-DIVERGENT payloads: identical inputs would let a
+        # no-op broadcast or misordered allgather pass undetected.
+        mine = lambda i: float(i + 1 + pid * 100)  # noqa: E731
+        for kind, i in ops:
+            val = np.full((8,), mine(i), np.float32)
+            if kind == "allreduce":
+                handles[i] = e.allreduce_async(f"fz/{i}", val, False)
+            elif kind == "broadcast":
+                handles[i] = e.broadcast_async(f"fz/{i}", val, 0)
+            else:
+                handles[i] = e.allgather_async(f"fz/{i}", val)
+            if rnd.random() < 0.5:
+                time.sleep(rnd.random() * 0.05)
+        for kind, i in sorted(ops, key=lambda t: t[1]):
+            out = e.synchronize(handles[i])
+            if kind == "allreduce":
+                expect = local_devices * sum(
+                    i + 1 + p * 100 for p in range(nproc))
+                np.testing.assert_array_equal(
+                    out, np.full((8,), float(expect)))
+            elif kind == "broadcast":
+                # Root is process 0's first chip: everyone must receive
+                # process 0's value, not their own.
+                np.testing.assert_array_equal(
+                    out, np.full((8,), float(i + 1)))
+            else:
+                # Rank-ordered concat: controller p's value occupies the
+                # slots of its local_devices chips.
+                expect = np.repeat(
+                    [i + 1 + p * 100 for p in range(nproc)],
+                    local_devices * 8).astype(np.float32)
+                np.testing.assert_array_equal(out.ravel(), expect)
+        print(f"proc {pid}: fuzz 40 ops OK", flush=True)
     elif scenario == "engine_reinit":
         # Collective engine shutdown + re-init across the WORLD: the new
         # incarnation negotiates in a fresh KV namespace (generation

@@ -219,6 +219,17 @@ def test_four_process_sigkill_peer_times_out_not_hangs(engine):
 
 
 @pytest.mark.parametrize("engine", ENGINES)
+def test_four_process_negotiation_fuzz(engine):
+    """40 mixed collectives per process, shuffled order, random
+    think-time: negotiation must converge on identical batches from
+    genuinely divergent partial tables (the reference's any-order
+    guarantee, ConstructMPIResponse)."""
+    outs = _run_world("engine_fuzz", nproc=4, timeout=300,
+                      extra_env={**_NP4, "HVD_ENGINE": engine})
+    assert sum("fuzz 40 ops OK" in out for out in outs) == 4
+
+
+@pytest.mark.parametrize("engine", ENGINES)
 def test_two_process_engine_reinit_generations(engine):
     """Three collective shutdown/re-init cycles: each generation
     negotiates in a fresh namespace and reclaims the previous one's
