@@ -12,6 +12,7 @@ the callback suite (:mod:`horovod_tpu.keras.callbacks`), and
 
 from __future__ import annotations
 
+import collections.abc
 import math
 from typing import Any, Callable, Optional, Sequence
 
@@ -41,6 +42,61 @@ from horovod_tpu.utils import checkpoint as _ckpt
 def _default_loss(logits, labels):
     return optax.softmax_cross_entropy_with_integer_labels(
         logits, labels).mean()
+
+
+class _LazyLogs(collections.abc.MutableMapping):
+    """Per-batch logs whose values stay device-resident until read.
+
+    ``fit`` hands this to ``on_batch_end`` instead of a plain float dict
+    so the training loop never blocks on a device fetch it doesn't need
+    (the fetch would serialize the pipelined step dispatch). Reading a
+    value — ``logs["loss"]``, ``.get``, ``.items()``, ``dict(logs)``,
+    ``{**logs}``, ``logs.copy()`` — yields Python floats, so callbacks
+    that json-serialize, type-check, copy, or accumulate keep the
+    classic Keras contract; each value read costs one host round trip.
+    Writes (``logs["lr"] = ...``, ``.update``) land in a host-side
+    overlay that shadows the device value and, for the epoch's last
+    batch, flows into the epoch logs/history — the same visibility a
+    plain dict gave. Deliberately NOT a dict subclass: CPython's
+    ``dict(d)``/``{**d}`` fast path would bypass ``__getitem__`` and
+    leak device arrays.
+    """
+
+    def __init__(self, raw):
+        self._raw = raw      # device-resident step outputs
+        self._host = {}      # callback-written values (host objects)
+
+    def __getitem__(self, k):
+        if k in self._host:
+            return self._host[k]
+        return float(self._raw[k])
+
+    def __setitem__(self, k, v):
+        self._host[k] = v
+
+    def __delitem__(self, k):
+        found = k in self._host or k in self._raw
+        if not found:
+            raise KeyError(k)
+        # Remove from BOTH layers: deleting a shadowed key must not
+        # resurrect the underlying device value (plain-dict contract).
+        self._host.pop(k, None)
+        self._raw.pop(k, None)
+
+    def __iter__(self):
+        yield from self._raw
+        for k in self._host:
+            if k not in self._raw:
+                yield k
+
+    def __len__(self):
+        return sum(1 for _ in self)
+
+    def copy(self) -> dict:
+        return {k: self[k] for k in self}
+
+    def __repr__(self):
+        return repr(self.copy())
 
 
 class Trainer:
@@ -213,7 +269,7 @@ class Trainer:
             self._epoch = epoch
             for cb in callbacks:
                 cb.on_epoch_begin(epoch)
-            logs = {}
+            lazy = _LazyLogs({})
             batches = self._batches(x, y, batch_size, shuffle, seed=epoch)
             nxt, b = next(batches, None), 0
             while nxt is not None:
@@ -234,12 +290,17 @@ class Trainer:
                 # 2.1x on the tunneled chip, docs/benchmarks.md).
                 nxt = next(batches, None)
                 # Batch logs stay device-resident (fetching every batch
-                # costs a full host round trip); callbacks that read a
-                # value pay for exactly that value.
+                # costs a full host round trip); the proxy converts any
+                # value a callback actually reads to a Python float at
+                # that moment, so float-expecting callbacks keep working
+                # and pay only for what they read.
+                lazy = _LazyLogs(logs)
                 for cb in callbacks:
-                    cb.on_batch_end(b, logs)
+                    cb.on_batch_end(b, lazy)
                 b += 1
-            logs = {k: float(v) for k, v in logs.items()}
+            # Epoch logs come from the last batch's view INCLUDING any
+            # callback writes (plain-dict behavior before _LazyLogs).
+            logs = lazy.copy()
             if validation_data is not None:
                 val = self.evaluate(*validation_data, batch_size=batch_size)
                 logs.update({f"val_{k}": v for k, v in val.items()})

@@ -74,7 +74,16 @@ def chunked_softmax_cross_entropy(hidden, kernel, bias, labels,
 
     hidden: [..., H] (any leading shape; bf16 or f32)
     kernel: [H, V], bias: [V] — the head parameters
-    labels: [...] int32, same leading shape as hidden
+    labels: [...] int32, same leading shape as hidden.
+      Precondition: ``0 <= label < V`` for every position. An
+      out-of-range label (e.g. a -100 ignore-index) is NOT detected:
+      its label-logit carry stays 0, the loss silently degrades to
+      ``lse - 0``, and the backward emits a pure-softmax gradient.
+      Mask ignored positions via the cotangent instead — clip their
+      labels into range and weight the returned per-token losses with 0
+      (that zero flows through ``g`` in the backward, zeroing their
+      gradient); ``tests/test_chunked_loss.py::
+      test_mask_ignored_labels_via_cotangent`` pins the convention.
     Returns f32 losses with the leading shape.
     """
     losses, _ = _fwd(hidden, kernel, bias, labels, chunk)

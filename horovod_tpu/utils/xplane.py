@@ -35,6 +35,19 @@ def _load_spaces(logdir: str):
     return spaces
 
 
+def _device_lines(spaces, line_name):
+    """Yield (plane, line) for every device-plane line named
+    ``line_name`` — the one place the device-plane selection idiom
+    lives (three metrics must not disagree over the same capture)."""
+    for space in spaces:
+        for plane in space.planes:
+            if "/device:" not in plane.name and "TPU" not in plane.name:
+                continue
+            for line in plane.lines:
+                if line.name == line_name:
+                    yield plane, line
+
+
 def device_op_times(logdir: str, line_name: str = "XLA Ops") -> Dict[str, float]:
     """Sum device-plane event durations (ms) by op/fusion name across all
     captured cores, from the ``line_name`` line only.
@@ -47,34 +60,97 @@ def device_op_times(logdir: str, line_name: str = "XLA Ops") -> Dict[str, float]
     hide, not the copy itself), which is the decomposition
     docs/benchmarks.md's tables use."""
     totals: Dict[str, float] = collections.defaultdict(float)
-    for space in _load_spaces(logdir):
-        for plane in space.planes:
-            if "/device:" not in plane.name and "TPU" not in plane.name:
-                continue
-            meta = {i: m.name for i, m in plane.event_metadata.items()}
-            for line in plane.lines:
-                if line.name != line_name:
-                    continue
-                for ev in line.events:
-                    name = meta.get(ev.metadata_id, str(ev.metadata_id))
-                    totals[name] += ev.duration_ps / 1e9  # ps -> ms
+    for plane, line in _device_lines(_load_spaces(logdir), line_name):
+        meta = {i: m.name for i, m in plane.event_metadata.items()}
+        for ev in line.events:
+            name = meta.get(ev.metadata_id, str(ev.metadata_id))
+            totals[name] += ev.duration_ps / 1e9  # ps -> ms
     return dict(totals)
 
 
 _CATEGORIES: List[Tuple[str, str]] = [
-    # (regex on op name, category label) — first match wins.
-    (r"convolution|conv\d|%conv", "convolution"),
+    # (regex on op name, category label) — first match wins. The matmul
+    # pattern sits BEFORE the generic fusion buckets because on TPU
+    # nearly every matmul surfaces as a fusion op; when the fusion's
+    # name carries its root ("%fusion.7 dot.42" / "loop_dot_fusion") it
+    # is classified as matmul here. Anonymous "fusion.N" names give no
+    # such signal and still land in the fusion buckets, so the matmul
+    # row is a LOWER bound on MXU share — docs/benchmarks.md's MFU
+    # numbers come from analytic FLOPs, not this table.
+    # NB: no bare "conv" — it would swallow "%convert_*" names.
+    (r"convolution|conv\d", "convolution"),
+    (r"dot|einsum|matmul|gemm", "matmul"),
     (r"convert.*fusion|fusion.*convert", "convert/reduce fusion"),
     (r"multiply.*add.*fusion|scatter.*fusion", "multiply-add fusion"),
     (r"fusion", "other fusion"),
     (r"copy|slice|bitcast|transpose|reshape", "copy/layout"),
     (r"all-reduce|all-gather|reduce-scatter|collective|permute",
      "collective"),
-    (r"dot|einsum|matmul", "matmul"),
     (r"select-and-scatter", "select-and-scatter"),
     (r"rng|random", "rng"),
     (r"infeed|outfeed|send|recv", "host transfer"),
 ]
+
+
+_DTYPE_BYTES = {
+    "pred": 1, "s8": 1, "u8": 1, "s16": 2, "u16": 2, "f16": 2, "bf16": 2,
+    "s32": 4, "u32": 4, "f32": 4, "s64": 8, "u64": 8, "f64": 8,
+    "c64": 8, "c128": 16, "f8e4m3fn": 1, "f8e5m2": 1,
+}
+
+_SHAPE_RE = re.compile(r"\b(" + "|".join(_DTYPE_BYTES) + r")\[([\d,]*)\]")
+
+
+def _first_shape_bytes(name: str) -> int:
+    """Payload bytes of the FIRST shape literal in an HLO op string.
+
+    Async-copy events are named with their full HLO text, e.g.
+    ``%copy-start = (f32[16777216]{0:T(1024)S(1)}, ...)`` — the first
+    shape is the destination buffer, i.e. the DMA payload. Returns 0
+    when no shape is present (e.g. tuple-only or token ops).
+    """
+    m = _SHAPE_RE.search(name)
+    if not m:
+        return 0
+    dt, dims = m.groups()
+    n = 1
+    for d in dims.split(","):
+        if d:
+            n *= int(d)
+    return n * _DTYPE_BYTES[dt]
+
+
+def dma_bytes(logdir: str, line_name: str = "Async XLA Ops",
+              spaces=None) -> Dict[str, float]:
+    """Sum the DMA payload bytes moved by the async-copy engine.
+
+    The TPU device plane's "Async XLA Ops" line carries one span per
+    in-flight async copy (HBM<->VMEM staging; the copies the scheduler
+    issues ahead of compute). Event stats hold no byte counts, but the
+    event NAME is the HLO text whose first shape literal is the payload
+    — that is what this sums. This measures the *prefetch-engine*
+    traffic only: bytes a fusion loads/stores directly from HBM in its
+    own loop never appear here, so the result is a LOWER bound on true
+    HBM traffic for the capture window.
+
+    Returns {"bytes": total payload bytes, "events": count,
+    "busy_ms": summed span duration}.
+    """
+    total = 0.0
+    nev = 0
+    busy = 0.0
+    if spaces is None:
+        spaces = _load_spaces(logdir)
+    for plane, line in _device_lines(spaces, line_name):
+        meta = {i: m.name for i, m in plane.event_metadata.items()}
+        for ev in line.events:
+            name = meta.get(ev.metadata_id, "")
+            b = _first_shape_bytes(name)
+            if b:
+                total += b
+                nev += 1
+                busy += ev.duration_ps / 1e9
+    return {"bytes": total, "events": nev, "busy_ms": busy}
 
 
 def categorize(name: str) -> str:
@@ -83,6 +159,17 @@ def categorize(name: str) -> str:
         if re.search(pat, low):
             return label
     return "other"
+
+
+def module_ms(logdir: str, spaces=None) -> float:
+    """Total device-occupancy of compiled modules (ms): the "XLA
+    Modules" line spans whole executions, so this is the denominator for
+    achieved-bandwidth numbers over a capture window."""
+    if spaces is None:
+        spaces = _load_spaces(logdir)
+    return sum(ev.duration_ps / 1e9
+               for _, line in _device_lines(spaces, "XLA Modules")
+               for ev in line.events)
 
 
 def summarize(logdir: str, top: int = 25, line_name: str = "XLA Ops") -> str:
@@ -120,8 +207,33 @@ def main(argv=None):
     ap.add_argument("--line", default="XLA Ops",
                     help="device-plane line to sum (e.g. 'Async XLA Ops' "
                          "for the overlapped DMA spans)")
+    ap.add_argument("--dma", action="store_true",
+                    help="report async-DMA payload bytes (a lower bound "
+                         "on HBM traffic) and achieved GB/s over the "
+                         "captured device time")
+    ap.add_argument("--steps", type=int, default=None,
+                    help="training steps in the capture window (with "
+                         "--dma: also prints GB/step)")
     args = ap.parse_args(argv)
-    print(summarize(args.logdir, top=args.top, line_name=args.line))
+    if args.dma:
+        spaces = _load_spaces(args.logdir)  # parse the (large) pbs once
+        d = dma_bytes(args.logdir, spaces=spaces)
+        dev_ms = module_ms(args.logdir, spaces=spaces)
+        if not dev_ms:
+            print(f"no device module events found under {args.logdir} "
+                  f"(empty or failed capture)")
+            return
+        out = [f"async-DMA payload: {d['bytes'] / 1e9:.2f} GB over "
+               f"{d['events']} copies (engine busy {d['busy_ms']:.1f} ms)",
+               f"device module time: {dev_ms:.1f} ms -> achieved "
+               f"{d['bytes'] / 1e9 / (dev_ms / 1e3):.0f} GB/s "
+               f"(prefetch engine only; lower bound on HBM traffic)"]
+        if args.steps:
+            out.append(f"per step ({args.steps}): "
+                       f"{d['bytes'] / 1e9 / args.steps:.2f} GB")
+        print("\n".join(out))
+    else:
+        print(summarize(args.logdir, top=args.top, line_name=args.line))
 
 
 if __name__ == "__main__":

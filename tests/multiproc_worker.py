@@ -438,19 +438,37 @@ def main():
             e.synchronize(e.allreduce_async("warm", np.ones((2,), np.float32),
                                             False)),
             np.full((2,), float(local_devices * nproc)))
-        cap = float(os.environ.get("HVD_NEGOTIATION_IDLE_MAX", "1.0"))
-        time.sleep(max(3.0, 2 * cap))  # enough idle rounds to max the backoff
+        # Per-run baseline: a second active op measures what THIS host
+        # currently charges for one non-idle round trip, so the pass
+        # bound tracks CI load instead of assuming an absolute cost
+        # (ADVICE r3: absolute dt < cap+3 could flake under heavy
+        # concurrent subprocess worlds).
         t0 = time.monotonic()
-        out = e.synchronize(
-            e.allreduce_async("after_idle", np.ones((2,), np.float32), False))
-        dt = time.monotonic() - t0
-        np.testing.assert_allclose(
-            out, np.full((2,), float(local_devices * nproc)))
-        # Generous slack for process skew + round trip + a loaded CI host
-        # (the full suite runs subprocess worlds concurrently); the
-        # failure mode being pinned (serial compounding) would cost
-        # >= (nproc-1) * cap, far above this bound at the test's cap.
-        assert dt < cap + 3.0, f"first op after idle took {dt:.2f}s"
+        e.synchronize(e.allreduce_async("baseline",
+                                        np.ones((2,), np.float32), False))
+        baseline = time.monotonic() - t0
+        cap = float(os.environ.get("HVD_NEGOTIATION_IDLE_MAX", "1.0"))
+        # The failure mode being pinned (serial compounding of peer
+        # backoffs) costs >= (nproc-1)*cap = 12s at this cap; the bound
+        # sits far below that while scaling with measured host load.
+        bound = cap + 3.0 + 2 * baseline
+        # Two unconditional attempts (collectives must stay collective —
+        # a data-dependent retry on one process would deadlock the
+        # world); pass if EITHER lands under the bound. A one-off load
+        # spike flakes one attempt; compounding misses both.
+        dts = []
+        for attempt in range(2):
+            time.sleep(max(3.0, 2 * cap))  # idle long enough to max backoff
+            t0 = time.monotonic()
+            out = e.synchronize(
+                e.allreduce_async(f"after_idle{attempt}",
+                                  np.ones((2,), np.float32), False))
+            dts.append(time.monotonic() - t0)
+            np.testing.assert_allclose(
+                out, np.full((2,), float(local_devices * nproc)))
+        dt = min(dts)
+        assert dt < bound, (f"first op after idle took {dts} twice "
+                            f"(bound {bound:.2f}s, baseline {baseline:.2f}s)")
         print(f"proc {pid}: IDLE_LATENCY {dt:.3f}", flush=True)
     elif scenario == "torch_errors":
         # Reference error-path tests drive mismatches through the TORCH

@@ -124,3 +124,27 @@ def test_init_param_tree_unchanged_by_return_hidden():
     params = model.init(jax.random.PRNGKey(0), toks)["params"]
     assert "lm_head" in params
     assert params["lm_head"]["kernel"].shape == (16, 50)
+
+
+@pytest.mark.parametrize("impl,kw", [
+    (chunked_softmax_cross_entropy, {"chunk": 32}),
+    (fused_softmax_cross_entropy, {"block_n": 8, "block_v": 32}),
+])
+def test_mask_ignored_labels_via_cotangent(impl, kw):
+    """The documented ignore-index convention (op docstring; ADVICE r3):
+    clip out-of-range labels into range, weight their per-token losses
+    with 0 — the zero cotangent must zero those tokens' gradients, and
+    the weighted loss must equal the reference's over kept tokens."""
+    h, w, b, lab = _data(n_lead=(6,))
+    raw = np.asarray(lab).copy()
+    raw[2] = -100  # the usual ignore-index
+    keep = jnp.asarray(raw >= 0, jnp.float32)
+    clipped = jnp.asarray(np.clip(raw, 0, None), jnp.int32)
+
+    masked = (impl(h, w, b, clipped, **kw) * keep).sum()
+    ref = (_ref_losses(h, w, b, clipped) * keep).sum()
+    np.testing.assert_allclose(float(masked), float(ref), rtol=1e-5)
+
+    g = jax.grad(lambda h: (impl(h, w, b, clipped, **kw) * keep).sum())(h)
+    np.testing.assert_allclose(np.asarray(g[2]), 0.0, atol=1e-7)
+    assert np.abs(np.asarray(g)[[0, 1, 3, 4, 5]]).min() > 0

@@ -153,9 +153,21 @@ def test_distributed_gradient_tape_trains():
 
 
 def test_distributed_optimizer_trains():
+    # Seeded PER-RANK initializers: under the launcher's -np 2 world this
+    # test runs in two processes, and an unseeded init made the 25-step
+    # loss-halving assertion nondeterministic (flaked once in the r3
+    # full-suite run). Seeding by rank keeps the controllers genuinely
+    # divergent (so broadcast_variables below still has real work — the
+    # coverage the comment under it claims) while making the
+    # post-broadcast trajectory exactly rank 0's, every run.
+    seed = 7 + 2 * hvd_tf.rank()
     model = tf.keras.Sequential(
-        [tf.keras.layers.Dense(4, activation="relu", input_shape=(3,)),
-         tf.keras.layers.Dense(1)])
+        [tf.keras.layers.Dense(
+            4, activation="relu", input_shape=(3,),
+            kernel_initializer=tf.keras.initializers.GlorotUniform(seed)),
+         tf.keras.layers.Dense(
+             1,
+             kernel_initializer=tf.keras.initializers.GlorotUniform(seed + 1))])
     opt = hvd_tf.DistributedOptimizer(tf.keras.optimizers.SGD(0.05))
     # Controllers initialize with different random weights; start agreed
     # (the reference's canonical startup, horovod/tensorflow/__init__.py
