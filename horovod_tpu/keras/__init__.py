@@ -89,11 +89,27 @@ class _LazyLogs(collections.abc.MutableMapping):
             if k not in self._raw:
                 yield k
 
+    def __contains__(self, k):
+        # Mapping's default is `self[k]` — a blocking device fetch for a
+        # mere membership guard (`if "loss" in logs:`). Keep it free.
+        return k in self._host or k in self._raw
+
     def __len__(self):
         return sum(1 for _ in self)
 
     def copy(self) -> dict:
-        return {k: self[k] for k in self}
+        # Best-effort float coercion of callback-written values too: the
+        # pre-_LazyLogs epoch logs applied float() to every value, and
+        # history/json consumers rely on host floats (values float()
+        # rejects are kept as written).
+        out = {}
+        for k in self:
+            v = self[k]
+            try:
+                out[k] = float(v)
+            except (TypeError, ValueError):
+                out[k] = v
+        return out
 
     def __repr__(self):
         return repr(self.copy())

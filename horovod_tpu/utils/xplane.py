@@ -98,7 +98,16 @@ _DTYPE_BYTES = {
     "c64": 8, "c128": 16, "f8e4m3fn": 1, "f8e5m2": 1,
 }
 
-_SHAPE_RE = re.compile(r"\b(" + "|".join(_DTYPE_BYTES) + r")\[([\d,]*)\]")
+_SHAPE_LAYOUT_RE = re.compile(
+    r"\b(" + "|".join(_DTYPE_BYTES) + r")\[([\d,]*)\](?:\{([^}]*)\})?")
+
+
+def _shape_bytes(dt: str, dims: str) -> int:
+    n = 1
+    for d in dims.split(","):
+        if d:
+            n *= int(d)
+    return n * _DTYPE_BYTES[dt]
 
 
 def _first_shape_bytes(name: str) -> int:
@@ -109,15 +118,11 @@ def _first_shape_bytes(name: str) -> int:
     shape is the destination buffer, i.e. the DMA payload. Returns 0
     when no shape is present (e.g. tuple-only or token ops).
     """
-    m = _SHAPE_RE.search(name)
+    m = _SHAPE_LAYOUT_RE.search(name)
     if not m:
         return 0
-    dt, dims = m.groups()
-    n = 1
-    for d in dims.split(","):
-        if d:
-            n *= int(d)
-    return n * _DTYPE_BYTES[dt]
+    dt, dims, _ = m.groups()
+    return _shape_bytes(dt, dims)
 
 
 def dma_bytes(logdir: str, line_name: str = "Async XLA Ops",
@@ -151,6 +156,151 @@ def dma_bytes(logdir: str, line_name: str = "Async XLA Ops",
                 nev += 1
                 busy += ev.duration_ps / 1e9
     return {"bytes": total, "events": nev, "busy_ms": busy}
+
+
+# Ops whose name-level operand lists alias or re-list buffers that other
+# events already account for (while re-lists its whole carry tuple; GTEs
+# are views; copy-done is the wait for a copy-start counted already).
+_NO_TRAFFIC_OPS = frozenset({
+    "while", "conditional", "call", "tuple", "get-tuple-element",
+    "parameter", "bitcast", "constant", "copy-done", "after-all",
+    "optimization-barrier",
+})
+
+_ID_ROOT_RE = re.compile(r"^%?([A-Za-z][\w.-]*?)(?:\.\d+)?(?:\s|=|$)")
+
+
+def _op_root(name: str) -> str:
+    """Op identifier root of an HLO text: "%while.2 = (...) while(...)"
+    -> "while"; "%convert_reduce_fusion.1215 = ..." ->
+    "convert_reduce_fusion". HLO ids default to the op type, so this is
+    robust where an op-type regex is not (tuple output shapes contain
+    nested parens that defeat simple matching)."""
+    m = _ID_ROOT_RE.match(name)
+    return m.group(1) if m else ""
+
+
+def _hbm_shape_bytes(text: str) -> int:
+    """Sum bytes of every shape literal in ``text`` whose layout does NOT
+    place it in a scoped memory space (``S(n)`` = VMEM/SMEM); unannotated
+    layouts are HBM (space 0)."""
+    total = 0
+    for dt, dims, layout in _SHAPE_LAYOUT_RE.findall(text):
+        if layout and "S(" in layout:
+            continue
+        total += _shape_bytes(dt, dims)
+    return total
+
+
+def hbm_bytes(logdir: str, spaces=None) -> Dict[str, float]:
+    """Per-capture HBM traffic derived from the COMPILED schedule.
+
+    For every executed op on the sequencer's "XLA Ops" line, the event
+    name is the scheduled HLO text: output + operand shape literals,
+    each carrying its assigned memory space (``S(1)`` = VMEM; no ``S``
+    = HBM). Summing the HBM-resident shapes over all executions counts
+    the bytes each op moves to/from HBM — fusions' direct loads/stores
+    included, which the async-DMA accounting (:func:`dma_bytes`) cannot
+    see. Control-flow/aliasing ops (while, get-tuple-element, ...) are
+    skipped — their names re-list buffers the real ops already count —
+    and async copies are counted once at copy-start (copy-done is the
+    wait). Known over-count: an in-place dynamic-update-slice is
+    charged its full buffer. Returns {"bytes", "events"}.
+    """
+    total = 0.0
+    nev = 0
+    if spaces is None:
+        spaces = _load_spaces(logdir)
+    for plane, line in _device_lines(spaces, "XLA Ops"):
+        meta = {i: m.name for i, m in plane.event_metadata.items()}
+        # Per-op-name bytes memoized: 14k unique names, millions of events.
+        cache: Dict[int, int] = {}
+        for ev in line.events:
+            b = cache.get(ev.metadata_id)
+            if b is None:
+                name = meta.get(ev.metadata_id, "")
+                b = (0 if _op_root(name) in _NO_TRAFFIC_OPS
+                     else _hbm_shape_bytes(name))
+                cache[ev.metadata_id] = b
+            if b:
+                total += b
+                nev += 1
+    return {"bytes": total, "events": nev}
+
+
+def hbm_report(logdir: str, steps: int = 1, spaces=None) -> str:
+    """The measured-roofline table (docs/benchmarks.md "The ceiling,
+    measured"): per-category sequencer time, schedule-derived HBM bytes
+    and achieved GB/s, plus the async-DMA payload and the true-traffic
+    sum (DMA + fusion direct streams — disjoint by construction: a
+    VMEM-resident operand is excluded from the fusion term).
+
+    The scan's ``while`` wrapper is excluded — it spans the whole loop
+    the inner ops already tile. Slice/copy -start/-done bytes are
+    excluded from the direct-stream sum (their payloads are what the
+    Async line counts; their name-level source shapes over-count)."""
+    if spaces is None:
+        spaces = _load_spaces(logdir)
+    cat_ms: Dict[str, float] = collections.defaultdict(float)
+    cat_b: Dict[str, float] = collections.defaultdict(float)
+    for plane, line in _device_lines(spaces, "XLA Ops"):
+        meta = {i: m.name for i, m in plane.event_metadata.items()}
+        info: Dict[int, Tuple[str, int]] = {}
+        for ev in line.events:
+            mid = ev.metadata_id
+            if mid not in info:
+                name = meta.get(mid, "")
+                op = _op_root(name)
+                key = name.split(" = ")[0]
+                if op in ("while", "conditional"):
+                    cat = "while wrapper"
+                elif "convert_reduce_fusion" in key:
+                    cat = "conv+BN fusion"
+                elif "multiply_add_fusion" in key:
+                    cat = "wgrad+update fusion"
+                elif "select-and-scatter" in key:
+                    cat = "maxpool bwd"
+                elif re.match(r"%(loop_)?fusion", key):
+                    cat = "elementwise fusion"
+                elif "start" in op or "done" in op or "copy" in key:
+                    cat = "async copy waits"
+                else:
+                    cat = "other"
+                direct = cat in ("conv+BN fusion", "wgrad+update fusion",
+                                 "maxpool bwd", "elementwise fusion")
+                b = (_hbm_shape_bytes(name)
+                     if direct and op not in _NO_TRAFFIC_OPS else 0)
+                info[mid] = (cat, b)
+            cat, b = info[mid]
+            cat_ms[cat] += ev.duration_ps / 1e9
+            cat_b[cat] += b
+    dma = dma_bytes(logdir, spaces=spaces)
+    inner = sum(ms for c, ms in cat_ms.items() if c != "while wrapper")
+    if not inner:
+        return (f"no device 'XLA Ops' events found under {logdir} "
+                f"(empty or failed capture)")
+    direct_gb = sum(cat_b.values()) / 1e9
+    dma_gb = dma["bytes"] / 1e9
+    out = [f"inner-op device time: {inner / steps:.2f} ms/step "
+           f"({steps} steps)",
+           f"{'category':22s} {'ms/step':>8s} {'share':>6s} "
+           f"{'GB/step':>8s} {'GB/s':>6s}"]
+    for c, ms in sorted(cat_ms.items(), key=lambda kv: -kv[1]):
+        if c == "while wrapper":
+            continue
+        gbs = cat_b[c] / 1e9 / (ms / 1e3) if ms and cat_b[c] else 0
+        out.append(f"{c:22s} {ms / steps:8.3f} {100 * ms / inner:5.1f}% "
+                   f"{cat_b[c] / 1e9 / steps:8.2f} "
+                   f"{gbs:6.0f}" if gbs else
+                   f"{c:22s} {ms / steps:8.3f} {100 * ms / inner:5.1f}% "
+                   f"{cat_b[c] / 1e9 / steps:8.2f} {'—':>6s}")
+    out.append(f"async-DMA payload: {dma_gb / steps:.2f} GB/step "
+               f"({dma['events'] // max(steps, 1)} copies/step)")
+    total = (dma_gb + direct_gb) / steps
+    out.append(f"true HBM traffic (DMA + direct streams): {total:.2f} "
+               f"GB/step -> {total / (inner / steps / 1e3):.0f} GB/s "
+               f"achieved over the device step")
+    return "\n".join(out)
 
 
 def categorize(name: str) -> str:
@@ -213,9 +363,15 @@ def main(argv=None):
                          "captured device time")
     ap.add_argument("--steps", type=int, default=None,
                     help="training steps in the capture window (with "
-                         "--dma: also prints GB/step)")
+                         "--dma/--hbm: per-step figures)")
+    ap.add_argument("--hbm", action="store_true",
+                    help="measured-roofline table: per-category time + "
+                         "HBM bytes + achieved GB/s, async-DMA payload, "
+                         "true-traffic sum (docs/benchmarks.md)")
     args = ap.parse_args(argv)
-    if args.dma:
+    if args.hbm:
+        print(hbm_report(args.logdir, steps=args.steps or 1))
+    elif args.dma:
         spaces = _load_spaces(args.logdir)  # parse the (large) pbs once
         d = dma_bytes(args.logdir, spaces=spaces)
         dev_ms = module_ms(args.logdir, spaces=spaces)
