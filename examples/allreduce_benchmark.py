@@ -40,7 +40,38 @@ import horovod_tpu as hvd
 from horovod_tpu.ops.collectives import HVD_AXIS, ranked_allreduce
 
 
-def run_engine(args):
+def _decompose_timeline(path, n_ops):
+    """Phase decomposition of the engine round trip from the engine's
+    own timeline (VERDICT r3 #6 — enqueue→cycle→stage→collective→fetch).
+    Sums B→E durations per activity over every op in the run (warmup
+    included) and reports the per-op average: QUEUE is time on the
+    submission queue before a cycle drained it (queue spans of tensors
+    submitted together OVERLAP — per-op queue time is what a caller
+    experiences, not a wall-clock component), WAIT_FOR_DATA the
+    host→device staging leg, ALLREDUCE the eager collective incl. the
+    device→host fetch, MEMCPY_* the fusion-buffer pack/unpack."""
+    import collections
+    import json
+
+    stack = {}
+    totals = collections.defaultdict(float)
+    for ev in json.load(open(path)):
+        if not ev or ev.get("ph") not in ("B", "E"):
+            continue
+        key = (ev.get("pid"), ev.get("tid"))
+        if ev["ph"] == "B":
+            stack.setdefault(key, []).append((ev.get("name"), ev["ts"]))
+        elif stack.get(key):
+            name, ts0 = stack[key].pop()
+            totals[name] += (ev["ts"] - ts0) / 1e6
+    accounted = sum(totals.values())
+    print(f"# per-op phase decomposition ({n_ops} ops):")
+    for name, s in sorted(totals.items(), key=lambda kv: -kv[1]):
+        print(f"#   {s / n_ops * 1e3:10.2f} ms/op "
+              f"{100 * s / accounted:5.1f}%  {name}")
+
+
+def run_engine(args, tl_path):
     """Engine-path sweep: bytes/µs through the async host engine."""
     from horovod_tpu.core import engine as eng
 
@@ -51,6 +82,9 @@ def run_engine(args):
     print(f"# {'size/tensor':>12s} {'total':>10s} {'time':>10s} "
           f"{'bytes/us':>9s} {'host_bw':>9s}")
     for kb in args.sizes_kb:
+        # --decompose shuts the engine down after each size to flush its
+        # timeline; a fresh singleton picks up cleanly.
+        e = eng.get_engine()
         elems = max(1, int(kb * 1024 / 4))
         tensors = [np.ones((elems,), np.float32) for _ in range(args.tensors)]
         total = sum(t.nbytes for t in tensors)
@@ -68,9 +102,18 @@ def run_engine(args):
         t0 = time.perf_counter()
         for i in range(args.iters):
             one_iter(i)
-        dt = (time.perf_counter() - t0) / args.iters
+        wall = time.perf_counter() - t0
+        dt = wall / args.iters
         print(f"  {kb:10.1f}kB {total/1e6:8.2f}MB {dt*1e3:8.3f}ms "
               f"{total/dt/1e6:9.1f} {total/dt/1e9:7.2f}GB/s")
+        if tl_path:
+            from horovod_tpu.core import engine as _e
+
+            # Flush the timeline for parsing; the next size's fresh
+            # engine reopens the path with mode "w" and truncates it.
+            _e.shutdown_engine()
+            _decompose_timeline(tl_path,
+                                (args.warmup + args.iters) * args.tensors)
 
 
 def main():
@@ -88,6 +131,10 @@ def main():
     ap.add_argument("--tensors", type=int, default=1,
                     help="tensors submitted together per iteration "
                          "(--engine; exercises runtime fusion)")
+    ap.add_argument("--decompose", action="store_true",
+                    help="with --engine: print the per-phase share table "
+                         "of the round trip (queue / stage / collective "
+                         "/ fusion memcpys) from the engine timeline")
     ap.add_argument("--hierarchical", action="store_true",
                     help="route through reduce-scatter(ICI) -> psum(DCN) "
                          "-> all-gather(ICI) (reference: "
@@ -100,9 +147,19 @@ def main():
 
     if args.hierarchical:
         os.environ["HVD_HIERARCHICAL_ALLREDUCE"] = "1"
+    tl_path = None
+    if args.engine and args.decompose:
+        # Must be in the env BEFORE hvd.init(): multi-controller init
+        # eagerly creates the engine (negotiation liveness), and only
+        # engine construction reads HVD_TIMELINE.
+        import tempfile
+
+        tl_path = os.path.join(tempfile.mkdtemp(prefix="hvd_tl_"),
+                               "timeline.json")
+        os.environ["HVD_TIMELINE"] = tl_path
     hvd.init()
     if args.engine:
-        run_engine(args)
+        run_engine(args, tl_path)
         return
     n = hvd.size()
     mesh = hvd.mesh()

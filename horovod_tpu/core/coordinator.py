@@ -333,6 +333,11 @@ class Coordinator:
         self.last_tables: Dict[int, set] = {}
         self._last_stall_warn = 0.0
         self._closed = False
+        # Control-plane cost accounting (docs/running.md "negotiation
+        # cost"): rounds completed, wall time inside negotiate(), and
+        # actual KV get attempts (each blocking poll slice counts — the
+        # O(P) reads/round that make total KV load O(P^2)/round).
+        self.stats = {"rounds": 0, "round_s": 0.0, "kv_gets": 0}
 
     # -- keys ---------------------------------------------------------------
 
@@ -386,6 +391,7 @@ class Coordinator:
                 if remaining <= 0:
                     raise NegotiationTimeout(peer, self.timeout_s)
                 try:
+                    self.stats["kv_gets"] += 1
                     raw = self.kv.get(self._round_key(rnd, peer),
                                       min(_POLL_SLICE_S, remaining))
                     return json.loads(raw)
@@ -401,6 +407,7 @@ class Coordinator:
         engine's negotiated path."""
         if self.dead:
             raise KVError(self.dead)
+        t_round = time.monotonic()
         rnd = self.round
         msg = {"entries": [m.wire() for m in entries]}
         if self.pid == 0:
@@ -468,6 +475,8 @@ class Coordinator:
             backoff = min(cycle_s * (2 ** min(self.idle_rounds, 10)),
                           _IDLE_BACKOFF_CAP_S)
         self._maybe_warn_stalls(entries)
+        self.stats["rounds"] += 1
+        self.stats["round_s"] += time.monotonic() - t_round
         return Decision(groups=groups, cycle_time_s=cycle_s,
                         fusion_threshold=int(fusion),
                         idle_backoff_s=backoff)

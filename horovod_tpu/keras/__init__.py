@@ -164,11 +164,29 @@ class Trainer:
         self.opt_state = self.optimizer.init(self.params)
 
     def broadcast_state(self, root_rank: int = 0):
-        """Reference: BroadcastGlobalVariablesCallback on_train_begin."""
-        self.params = broadcast_pytree(self.params, root_rank)
-        if self.batch_stats:
-            self.batch_stats = broadcast_pytree(self.batch_stats, root_rank)
-        self.opt_state = broadcast_pytree(self.opt_state, root_rank)
+        """Reference: BroadcastGlobalVariablesCallback on_train_begin.
+
+        Hardened (r4, found by the smoke tier): the state is pulled to
+        HOST before broadcasting, and the result is drained before
+        returning. A second fit() used to hand the broadcast mesh-
+        sharded train-step outputs with async work still in flight —
+        the eager broadcast programs then recompiled for the new input
+        layouts and their 8-device all-reduce wedged with only 5
+        executions launched (XLA:CPU aborts the rendezvous after 40 s).
+        ``device_get`` is itself a hard sync, and host leaves make every
+        fit take the identical first-fit program path — no layout-driven
+        recompiles, nothing concurrent in flight. The broadcast runs
+        once per fit, so the host round trip is startup cost, not step
+        cost."""
+        host = jax.device_get((self.params, self.batch_stats,
+                               self.opt_state))
+        params, batch_stats, opt_state = host
+        self.params = broadcast_pytree(params, root_rank)
+        if batch_stats:
+            self.batch_stats = broadcast_pytree(batch_stats, root_rank)
+        self.opt_state = broadcast_pytree(opt_state, root_rank)
+        jax.block_until_ready((self.params, self.batch_stats,
+                               self.opt_state))
 
     def set_lr_scale(self, scale: float, momentum_correction: bool = False):
         """Scale the effective learning rate (callbacks drive this). With

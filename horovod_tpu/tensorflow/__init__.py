@@ -63,10 +63,26 @@ def bcast(root_rank: int, variables):
     """Graph-mode broadcast op over explicit variables (reference:
     horovod/tensorflow/__init__.py:106-115). Returns a grouped assign op
     to ``session.run``; under eager execution the assigns run immediately
-    and the group is a no-op tensor."""
+    and the group is a no-op tensor.
+
+    All variables ride ONE py_function (`mpi_ops._bridge_group`): the
+    graph executor runs py_functions sequentially in a per-process
+    order, so per-variable broadcast nodes could block cross-rank in
+    different members and deadlock (r4, found by the estimator
+    example)."""
     v1 = tf.compat.v1
-    return tf.group(*[v1.assign(var, broadcast(
-        tf.convert_to_tensor(var), root_rank)) for var in variables])
+    variables = list(variables)
+    if not variables:
+        return tf.group()
+    from horovod_tpu.tensorflow import mpi_ops as _ops
+
+    names = _ops._group_names(
+        "broadcast", [f"{i}.{v.name}" for i, v in enumerate(variables)])
+    vals = _ops._bridge_group(
+        "broadcast", [tf.convert_to_tensor(v) for v in variables], names,
+        root=root_rank)
+    return tf.group(*[v1.assign(var, val)
+                      for var, val in zip(variables, vals)])
 
 
 def broadcast_global_variables(root_rank: int = 0):
@@ -143,15 +159,13 @@ class DistributedGradientTape(tf.GradientTape):
 
     def gradient(self, target, sources, output_gradients=None, **kw):
         grads = super().gradient(target, sources, output_gradients, **kw)
-        return [self._reduce(g) for g in grads]
-
-    def _reduce(self, g):
-        if g is None:
-            return None
-        if isinstance(g, tf.IndexedSlices) and self._hvd_sparse_as_dense:
-            g = tf.convert_to_tensor(g)
-        return allreduce(g, average=self._hvd_average,
-                         compression=self._hvd_compression)
+        # One py_function for the whole gradient list (the same
+        # sequential-executor deadlock guard as the optimizers) —
+        # sources stand in as the variables for naming.
+        reduced = _group_reduce_grads(
+            list(zip(grads, sources)), self._hvd_average,
+            self._hvd_compression, self._hvd_sparse_as_dense)
+        return [g for g, _ in reduced]
 
 
 def DistributedOptimizer(optimizer, name: Optional[str] = None,
@@ -173,16 +187,8 @@ def DistributedOptimizer(optimizer, name: Optional[str] = None,
 
         def apply_gradients(self, grads_and_vars, *args, **kwargs):
             gv = list(grads_and_vars)
-            reduced = []
-            for g, v in gv:
-                if g is None:
-                    reduced.append((g, v))
-                    continue
-                if isinstance(g, tf.IndexedSlices) and sparse_as_dense:
-                    g = tf.convert_to_tensor(g)
-                reduced.append(
-                    (allreduce(g, average=average, compression=compression),
-                     v))
+            reduced = _group_reduce_grads(gv, average, compression,
+                                          sparse_as_dense)
             return super().apply_gradients(reduced, *args, **kwargs)
 
     # Fresh instance of the dynamic subclass; slots build lazily on first
@@ -207,15 +213,67 @@ def _distributed_v1_optimizer(optimizer, average, compression,
 
         def compute_gradients(self, *args, **kwargs):
             gradients = super().compute_gradients(*args, **kwargs)
-            out = []
-            for grad, var in gradients:
-                if grad is None:
-                    out.append((None, var))
-                    continue
-                if isinstance(grad, tf.IndexedSlices) and sparse_as_dense:
-                    grad = tf.convert_to_tensor(grad)
-                out.append((allreduce(grad, average=average,
-                                      compression=compression), var))
-            return out
+            return _group_reduce_grads(gradients, average, compression,
+                                       sparse_as_dense)
 
     return _DistributedV1()
+
+
+def _group_reduce_grads(grads_and_vars, average, compression,
+                        sparse_as_dense):
+    """Reduce every gradient of a step through ONE py_function
+    (`mpi_ops._bridge_group` — see its docstring for why per-gradient
+    nodes can deadlock a v1 graph's sequential py_function executor).
+    Dense gradients are allreduced; sparse IndexedSlices ride the
+    reference's allgather-of-values+indices path (reference:
+    horovod/tensorflow/__init__.py:48-94) INSIDE the same group — a
+    separate sparse py_function would re-create the cross-rank wedge
+    the grouping exists to prevent."""
+    from horovod_tpu.tensorflow import mpi_ops as _ops
+
+    gv = [(tf.convert_to_tensor(g), v)
+          if isinstance(g, tf.IndexedSlices) and sparse_as_dense else (g, v)
+          for g, v in grads_and_vars]
+    kinds, tensors, labels, roles = [], [], [], []
+    for i, (g, v) in enumerate(gv):
+        # Position index keeps labels unique (keras-3 variable names are
+        # bare "kernel"/"bias"); positions are rank-consistent because
+        # every controller builds the same gradient list.
+        vname = getattr(v, "name", "t")
+        if g is None:
+            continue
+        if isinstance(g, tf.IndexedSlices):
+            kinds += ["allgather", "allgather"]
+            tensors += [g.values, g.indices]
+            labels += [f"DistributedOptimizer.{i}.{vname}.values",
+                       f"DistributedOptimizer.{i}.{vname}.indices"]
+            roles += [("sparse_values", i), ("sparse_indices", i)]
+        else:
+            t, ctx = compression.compress(g)
+            kinds.append("allreduce")
+            tensors.append(t)
+            labels.append(f"DistributedOptimizer.{i}.{vname}")
+            roles.append(("dense", i, ctx))
+    out = [(g, v) for g, v in gv]
+    if not tensors:
+        return out
+    names = _ops._group_names("allreduce", labels)
+    results = _ops._bridge_group(kinds, tensors, names, average=False)
+    sparse_parts = {}
+    for role, res in zip(roles, results):
+        if role[0] == "dense":
+            _, i, ctx = role
+            g = compression.decompress(res, ctx)
+            if average:
+                g = tf.math.divide(g, float(size()))
+            out[i] = (g, gv[i][1])
+        else:
+            sparse_parts.setdefault(role[1], {})[role[0]] = res
+    for i, parts in sparse_parts.items():
+        values = parts["sparse_values"]
+        if average:
+            values = tf.math.divide(values, float(size()))
+        out[i] = (tf.IndexedSlices(values, parts["sparse_indices"],
+                                   dense_shape=gv[i][0].dense_shape),
+                  gv[i][1])
+    return out

@@ -23,27 +23,118 @@ from horovod_tpu.common import topology as _topo
 from horovod_tpu.ops import collectives as _C
 
 
-def _np_collective(kind: str, t: np.ndarray, *, average=False, root=0):
-    import jax.numpy as jnp
+def _np_collective(kind: str, t: np.ndarray, *, name: str,
+                   average=False, root=0):
+    """Execute through the ENGINE, not the eager compiled collectives.
 
-    x = jnp.asarray(t)
+    TF's graph executor runs independent py_function nodes concurrently
+    and in no fixed order, so two controllers (or two executor threads
+    in one process) would issue eager mesh programs in different orders
+    — observed as a gloo size-mismatch abort / cross-module rendezvous
+    deadlock in the estimator example's 6-gradient graph. Unordered
+    multi-controller submission is exactly what the engine's negotiation
+    protocol exists for (the reference's TF kernels likewise enqueue
+    into its engine: tensorflow/mpi_ops.cc EnqueueTensorAllreduce);
+    requests match across controllers by ``name``. Bonus: concurrently
+    blocked py_functions land in one engine cycle and fuse (C5)."""
+    from horovod_tpu.core import engine as _eng
+
+    e = _eng.get_engine()
     if kind == "allreduce":
-        out = _C.allreduce(x, average=average)
-    elif kind == "allgather":
-        out = _C.allgather(x)
-    elif kind == "broadcast":
-        out = _C.broadcast(x, root)
-    else:
-        raise ValueError(kind)
-    return np.asarray(out)
+        # The engine wire format is >=1-d; restore scalar shape after.
+        h = e.allreduce_async(name, np.atleast_1d(t), average)
+        return e.synchronize(h).reshape(np.shape(t))
+    if kind == "allgather":
+        return e.synchronize(e.allgather_async(name, t))
+    if kind == "broadcast":
+        h = e.broadcast_async(name, np.atleast_1d(t), root)
+        return e.synchronize(h).reshape(np.shape(t))
+    raise ValueError(kind)
+
+
+_BRIDGE_SEQ = {}
+
+
+def _bridge_group(kind: str, tensors, names, *, average=False, root=0):
+    """Run N same-kind collectives through ONE py_function, submitting
+    every engine request before waiting on any.
+
+    TF executes py_function bodies strictly sequentially per process
+    (measured: 4 sleeping py_functions in one session.run never overlap),
+    in a schedule order that differs across processes — so N blocking
+    single-tensor bridges in one graph can wedge as rank A inside op X
+    while rank B sits inside op Y, a cycle no negotiation can resolve
+    (observed: the estimator example's variable broadcast, stalled
+    ".5"/".6" on the two ranks). Submitting the whole group first makes
+    every member visible to the engine regardless of executor order —
+    the property the reference's ASYNC TF kernels have natively
+    (tensorflow/mpi_ops.cc enqueues and returns) — and lands the group
+    in one engine cycle, where it fuses (C5).
+    """
+    tensors = list(tensors)
+    names = list(names)
+    kinds = [kind] * len(tensors) if isinstance(kind, str) else list(kind)
+
+    def fn(*ts):
+        from horovod_tpu.core import engine as _eng
+
+        e = _eng.get_engine()
+        handles = []
+        for k, name, t in zip(kinds, names, ts):
+            a = np.atleast_1d(np.asarray(t.numpy()))
+            if k == "allreduce":
+                handles.append(e.allreduce_async(name, a, average))
+            elif k == "broadcast":
+                handles.append(e.broadcast_async(name, a, root))
+            elif k == "allgather":
+                handles.append(e.allgather_async(name, a))
+            else:
+                raise ValueError(k)
+        outs = [e.synchronize(h) for h in handles]
+        # allgather legitimately changes the first dim; everything else
+        # restores the submitted shape (scalars ride the >=1-d wire).
+        return [o if k == "allgather" else o.reshape(np.shape(t))
+                for k, o, t in zip(kinds, outs, ts)]
+
+    outs = tf.py_function(fn, tensors, Tout=[t.dtype for t in tensors])
+    if not isinstance(outs, (list, tuple)):
+        outs = [outs]
+    for k, o, t in zip(kinds, outs, tensors):
+        if k == "allgather":
+            shape = t.shape.as_list() if t.shape.rank is not None else None
+            if shape:
+                shape[0] = None  # per-rank first dims may differ
+            o.set_shape(shape)
+        else:
+            o.set_shape(t.shape)
+    return list(outs)
+
+
+def _group_names(kind: str, labels) -> list:
+    """Stable engine names for a grouped collective: a per-kind sequence
+    number (identical across processes — every controller constructs the
+    same program in the same order) plus a per-member label (variable
+    name), so request matching survives arbitrary EXECUTION order."""
+    seq = _BRIDGE_SEQ.get("g" + kind, 0)
+    _BRIDGE_SEQ["g" + kind] = seq + 1
+    return [f"tf.{kind}g{seq}.{label}" for label in labels]
 
 
 def _bridge(kind: str, tensor: tf.Tensor, **kw) -> tf.Tensor:
-    """Run an XLA-mesh collective on a TF tensor via py_function so the op
-    works in both eager and tf.function graphs."""
+    """Run an engine collective on a TF tensor via py_function so the op
+    works in both eager and tf.function graphs.
+
+    The engine name is assigned at op-CONSTRUCTION time from a per-kind
+    counter: every controller builds the same graph (or traces/executes
+    the same program) in the same order, so node N gets the same name
+    everywhere — the negotiation key the engine matches requests by —
+    while concurrent EXECUTION order stays free."""
+    seq = _BRIDGE_SEQ.get(kind, 0)
+    _BRIDGE_SEQ[kind] = seq + 1
+    opname = f"tf.{kind}.{seq}"
 
     def fn(t):
-        return _np_collective(kind, t.numpy(), **kw)
+        return _np_collective(kind, t.numpy(), name=opname, **kw)
 
     out = tf.py_function(fn, [tensor], Tout=tensor.dtype)
     if kind != "allgather":

@@ -228,19 +228,15 @@ def hbm_bytes(logdir: str, spaces=None) -> Dict[str, float]:
     return {"bytes": total, "events": nev}
 
 
-def hbm_report(logdir: str, steps: int = 1, spaces=None) -> str:
-    """The measured-roofline table (docs/benchmarks.md "The ceiling,
-    measured"): per-category sequencer time, schedule-derived HBM bytes
-    and achieved GB/s, plus the async-DMA payload and the true-traffic
-    sum (DMA + fusion direct streams — disjoint by construction: a
-    VMEM-resident operand is excluded from the fusion term).
+# Categories whose HBM byte counts are DIRECT streams (single-pass
+# compute fusions — exact at the name level, unlike slice/copy ops
+# whose names over-count their source buffers).
+_DIRECT_CATS = ("conv+BN fusion", "wgrad+update fusion", "maxpool bwd",
+                "elementwise fusion")
 
-    The scan's ``while`` wrapper is excluded — it spans the whole loop
-    the inner ops already tile. Slice/copy -start/-done bytes are
-    excluded from the direct-stream sum (their payloads are what the
-    Async line counts; their name-level source shapes over-count)."""
-    if spaces is None:
-        spaces = _load_spaces(logdir)
+
+def _category_totals(spaces):
+    """Per-category (sequencer ms, direct HBM bytes) over "XLA Ops"."""
     cat_ms: Dict[str, float] = collections.defaultdict(float)
     cat_b: Dict[str, float] = collections.defaultdict(float)
     for plane, line in _device_lines(spaces, "XLA Ops"):
@@ -266,14 +262,42 @@ def hbm_report(logdir: str, steps: int = 1, spaces=None) -> str:
                     cat = "async copy waits"
                 else:
                     cat = "other"
-                direct = cat in ("conv+BN fusion", "wgrad+update fusion",
-                                 "maxpool bwd", "elementwise fusion")
                 b = (_hbm_shape_bytes(name)
-                     if direct and op not in _NO_TRAFFIC_OPS else 0)
+                     if cat in _DIRECT_CATS and op not in _NO_TRAFFIC_OPS
+                     else 0)
                 info[mid] = (cat, b)
             cat, b = info[mid]
             cat_ms[cat] += ev.duration_ps / 1e9
             cat_b[cat] += b
+    return cat_ms, cat_b
+
+
+def fusion_direct_bytes(logdir: str, spaces=None) -> float:
+    """Total bytes the compute fusions stream to/from HBM directly
+    (their non-VMEM operand/output shapes) — the component of true HBM
+    traffic the async-DMA accounting (:func:`dma_bytes`) cannot see.
+    ``dma_bytes()["bytes"] + fusion_direct_bytes()`` is the measured
+    true-traffic figure docs/benchmarks.md's roofline uses."""
+    if spaces is None:
+        spaces = _load_spaces(logdir)
+    _, cat_b = _category_totals(spaces)
+    return float(sum(cat_b.values()))
+
+
+def hbm_report(logdir: str, steps: int = 1, spaces=None) -> str:
+    """The measured-roofline table (docs/benchmarks.md "The ceiling,
+    measured"): per-category sequencer time, schedule-derived HBM bytes
+    and achieved GB/s, plus the async-DMA payload and the true-traffic
+    sum (DMA + fusion direct streams — disjoint by construction: a
+    VMEM-resident operand is excluded from the fusion term).
+
+    The scan's ``while`` wrapper is excluded — it spans the whole loop
+    the inner ops already tile. Slice/copy -start/-done bytes are
+    excluded from the direct-stream sum (their payloads are what the
+    Async line counts; their name-level source shapes over-count)."""
+    if spaces is None:
+        spaces = _load_spaces(logdir)
+    cat_ms, cat_b = _category_totals(spaces)
     dma = dma_bytes(logdir, spaces=spaces)
     inner = sum(ms for c, ms in cat_ms.items() if c != "while wrapper")
     if not inner:

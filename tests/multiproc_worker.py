@@ -472,6 +472,47 @@ def main():
         assert dt < bound, (f"first op after idle took {dts} twice "
                             f"(bound {bound:.2f}s, baseline {baseline:.2f}s)")
         print(f"proc {pid}: IDLE_LATENCY {dt:.3f}", flush=True)
+    elif scenario == "negotiation_latency":
+        # Control-plane cost vs world size (VERDICT r3 #4): per-op
+        # latency of the negotiated path, sequential (1 op : >=1 round)
+        # and burst (K ops land in few rounds — the amortization the
+        # engine cycle + fusion exist for), plus the coordinator's own
+        # round stats. docs/running.md carries the measured curve.
+        import json as _json
+        import time
+
+        from horovod_tpu.core import engine as eng
+
+        e = eng.get_engine()
+        np.testing.assert_allclose(
+            e.synchronize(e.allreduce_async("warm", np.ones((2,), np.float32),
+                                            False)),
+            np.full((2,), float(local_devices * nproc)))
+        m = 20
+        t0 = time.monotonic()
+        for i in range(m):
+            e.synchronize(e.allreduce_async(f"lat{i}",
+                                            np.ones((64,), np.float32),
+                                            False))
+        seq_ms = (time.monotonic() - t0) / m * 1e3
+        k = 32
+        t0 = time.monotonic()
+        hs = [e.allreduce_async(f"burst{i}", np.ones((64,), np.float32),
+                                False) for i in range(k)]
+        for h in hs:
+            e.synchronize(h)
+        burst_ms = (time.monotonic() - t0) / k * 1e3
+        stats = dict(getattr(e, "_coordinator").stats) \
+            if getattr(e, "_coordinator", None) is not None else {}
+        per_round_ms = (stats["round_s"] / stats["rounds"] * 1e3
+                        if stats.get("rounds") else None)
+        print(f"proc {pid}: NEG_LATENCY " + _json.dumps(
+            {"nproc": nproc, "seq_ms": round(seq_ms, 2),
+             "burst_ms": round(burst_ms, 2),
+             "rounds": stats.get("rounds"),
+             "kv_gets": stats.get("kv_gets"),
+             "per_round_ms": (round(per_round_ms, 2)
+                              if per_round_ms else None)}), flush=True)
     elif scenario == "torch_errors":
         # Reference error-path tests drive mismatches through the TORCH
         # API and assert the coordinator error surfaces as an exception on
