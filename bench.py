@@ -255,10 +255,12 @@ def main():
     # not XLA's bytes-accessed estimate.
     measured_gb_per_step = None
 
-    def _measure_from_profile(prof_dir):
+    def _measure_from_profile(prof_dir, new_files):
         from horovod_tpu.utils import xplane
 
-        spaces = xplane._load_spaces(prof_dir)
+        # Only THIS run's capture: a reused --profile dir still holds
+        # earlier xplane files, which would double every byte count.
+        spaces = xplane._load_spaces(prof_dir, files=new_files)
         dma = xplane.dma_bytes(prof_dir, spaces=spaces)
         direct = xplane.fusion_direct_bytes(prof_dir, spaces=spaces)
         window_steps = ncalls_iter * spc
@@ -272,12 +274,16 @@ def main():
         # numbers are best-effort.
         from horovod_tpu.utils import profiler
 
+        before = set(profiler.trace_files(args.profile))
         with profiler.profile(args.profile):
             run_batches(ncalls_iter)
-        print(f"# profile: {len(profiler.trace_files(args.profile))} "
-              f"xplane file(s) in {args.profile}", file=sys.stderr)
+        new_files = [f for f in profiler.trace_files(args.profile)
+                     if f not in before]
+        print(f"# profile: {len(new_files)} new xplane file(s) in "
+              f"{args.profile}", file=sys.stderr)
         try:
-            measured_gb_per_step = _measure_from_profile(args.profile)
+            measured_gb_per_step = _measure_from_profile(args.profile,
+                                                         new_files)
         except Exception as e:  # pragma: no cover - analysis best-effort
             print(f"# profile-based HBM measurement unavailable: {e}",
                   file=sys.stderr)
@@ -292,7 +298,8 @@ def main():
             with tempfile.TemporaryDirectory(prefix="bench_prof_") as td:
                 with profiler.profile(td):
                     run_batches(ncalls_iter)
-                measured_gb_per_step = _measure_from_profile(td)
+                measured_gb_per_step = _measure_from_profile(
+                    td, profiler.trace_files(td))
         except Exception as e:  # pragma: no cover - measurement best-effort
             print(f"# profile-based HBM measurement unavailable: {e}",
                   file=sys.stderr)

@@ -288,7 +288,12 @@ class Trainer:
         """Train; returns a history dict of per-epoch logs. ``x``/``y`` are
         this process's host arrays; ``batch_size`` is per chip (global
         batch = batch_size * size), matching the reference examples'
-        convention."""
+        convention.
+
+        ``on_batch_end`` receives a :class:`_LazyLogs` mapping — values
+        are fetched from device only when read (reads yield Python
+        floats; writes land in a host overlay that reaches the epoch
+        history). ``on_epoch_end`` receives a plain float dict."""
         x, y = np.asarray(x), np.asarray(y)
         self.build(x[:batch_size * max(local_size(), 1)])
         if self._train_step is None:

@@ -21,13 +21,17 @@ import re
 from typing import Dict, List, Tuple
 
 
-def _load_spaces(logdir: str):
+def _load_spaces(logdir: str, files=None):
+    """Parse the capture's xplane protobufs. ``files`` restricts the
+    parse to an explicit list — callers measuring ONE capture window in
+    a reused logdir must pass the files that window produced, or prior
+    captures in the same tree silently inflate every byte count."""
     from tensorflow.tsl.profiler.protobuf import xplane_pb2
 
     from horovod_tpu.utils.profiler import trace_files
 
     spaces = []
-    for path in trace_files(logdir):
+    for path in (trace_files(logdir) if files is None else files):
         space = xplane_pb2.XSpace()
         with open(path, "rb") as f:
             space.ParseFromString(f.read())

@@ -287,6 +287,7 @@ def test_optimizer_mixed_sparse_dense_gradients():
     hvd_tf.broadcast_variables([emb, w], root_rank=0)
     ids = tf.constant([0, 2, 2, 5])
     y = tf.constant([[1.0], [0.0], [0.0], [2.0]])
+    untouched_row = np.asarray(emb)[1].copy()  # never looked up below
 
     opt = hvd_tf.DistributedOptimizer(tf.keras.optimizers.SGD(0.1))
     losses = []
@@ -299,10 +300,10 @@ def test_optimizer_mixed_sparse_dense_gradients():
         opt.apply_gradients(zip(grads, [emb, w]))
         losses.append(float(loss))
     assert losses[-1] < losses[0] * 0.6, losses
-    # Row 1 of the embedding is never looked up: its value must be
-    # untouched by sparse updates on every rank.
-    np.testing.assert_allclose(np.asarray(emb)[1],
-                               np.asarray(emb)[1])
+    # Row 1 of the embedding is never looked up: sparse updates must not
+    # have touched it on any rank (compared against its PRE-training
+    # value — a wrong scatter index or dense-averaging bug would).
+    np.testing.assert_allclose(np.asarray(emb)[1], untouched_row)
 
 
 def test_distributed_gradient_tape_sparse():

@@ -281,3 +281,27 @@ def test_broadcast_parameters_bare_list_rejected():
 def test_broadcast_parameters_named_parameters_generator():
     model = torch.nn.Linear(2, 1)
     hvt.broadcast_parameters(model.named_parameters(), root_rank=0)
+
+
+def test_bf16_handoff_is_bit_exact_and_zero_copy():
+    """torch bf16 -> ml_dtypes bf16 rides a bit-reinterpret, not an
+    f32 round trip (r4; the old path cost two conversion copies per
+    tensor on the engine's host leg). Inf and denormals must survive
+    bit-exactly, and the outbound leg must be a VIEW of the torch
+    storage."""
+    import ml_dtypes
+    from horovod_tpu.torch.mpi_ops import _np_of, _torch_of
+
+    vals = torch.tensor([1.5, -0.0, 3.14159e-40, float("inf"), 1e-3],
+                        dtype=torch.bfloat16)
+    a = _np_of(vals)
+    assert a.dtype == ml_dtypes.bfloat16
+    assert a.view(np.uint16).tolist() == vals.view(torch.uint16).tolist()
+    back = _torch_of(a, vals)
+    assert back.dtype == torch.bfloat16
+    assert back.view(torch.uint16).tolist() == vals.view(torch.uint16).tolist()
+
+    t = torch.ones(4, dtype=torch.bfloat16)
+    n = _np_of(t)
+    t[0] = 2.0  # visible through the view => zero-copy
+    assert float(np.asarray(n.astype(np.float32))[0]) == 2.0
