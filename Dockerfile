@@ -1,0 +1,51 @@
+# horovod_tpu — TPU-VM image (the role of the reference's Dockerfile:
+# a ready-to-run training image with the framework, frontends and
+# examples baked in; reference: Dockerfile:1-84, build-docker-images.sh).
+#
+# The reference's image stacks CUDA + NCCL + MPI + framework wheels.
+# On TPU the stack is radically simpler: libtpu ships inside the
+# `jax[tpu]` wheel, the data plane is XLA, and the launcher replaces
+# mpirun — so this is a slim python image, not an nvidia base.
+#
+# Build:   ./build-image.sh   (or: docker build -t horovod-tpu .)
+# Run on a Cloud TPU VM (one worker per host, all hosts of a pod slice):
+#   docker run --privileged --network host horovod-tpu \
+#       python examples/jax_mnist.py --synthetic
+# `--privileged --network host` grants the container the TPU device
+# nodes (/dev/accel*) and the host networking the ICI/DCN mesh uses —
+# the TPU analogue of the reference's --gpus/--network flags
+# (docs/docker.md). See docs/deploy.md for pod-slice orchestration.
+
+FROM python:3.12-slim AS build
+
+# Native toolchain for the C++ engine (core/native/hvdcore.cc). The
+# runtime stage copies the built artifacts and drops the compilers.
+RUN apt-get update && apt-get install -y --no-install-recommends \
+        g++ make && rm -rf /var/lib/apt/lists/*
+
+WORKDIR /src
+COPY . .
+RUN pip install --no-cache-dir build && python -m build --wheel
+
+FROM python:3.12-slim
+
+# jax[tpu] carries libtpu; torch stays CPU (it is a frontend here, the
+# chips belong to XLA — docs/concepts.md "Differences from Horovod").
+RUN pip install --no-cache-dir \
+        "jax[tpu]" -f https://storage.googleapis.com/jax-releases/libtpu_releases.html \
+        flax optax tensorflow-cpu && \
+    pip install --no-cache-dir torch --index-url https://download.pytorch.org/whl/cpu
+
+COPY --from=build /src/dist/*.whl /tmp/
+RUN pip install --no-cache-dir /tmp/*.whl && rm /tmp/*.whl
+
+# Examples ship in the image like the reference's (they are the
+# de-facto integration tier and double as smoke tests on a fresh VM).
+COPY examples /workspace/examples
+COPY docs /workspace/docs
+WORKDIR /workspace
+
+# Engine knobs documented in docs/running.md; defaults match source.
+ENV HVD_ENGINE=native
+
+CMD ["python", "-c", "import horovod_tpu as hvd; hvd.init(); print(f'horovod_tpu OK: {hvd.size()} chip(s)')"]

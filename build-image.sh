@@ -1,0 +1,11 @@
+#!/usr/bin/env bash
+# Build the horovod_tpu container image (the reference's
+# build-docker-images.sh role, one target instead of a CUDA matrix —
+# TPU capability lives in the jax[tpu] wheel, not the image flavor).
+set -euo pipefail
+cd "$(dirname "$0")"
+
+TAG="${1:-horovod-tpu:latest}"
+docker build -t "$TAG" .
+echo "built $TAG — smoke it with:"
+echo "  docker run --privileged --network host $TAG"

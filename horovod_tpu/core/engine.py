@@ -326,19 +326,24 @@ class Engine:
         self._wake.set()
         return entry.handle
 
+    # Submit-time SNAPSHOT (np.array, not ascontiguousarray): the C++
+    # engine memcpys at enqueue (hvdcore.cc), so a caller mutating its
+    # buffer after an *_async call must not change what gets reduced —
+    # the python twin owes the same observable semantics, and frontends
+    # now hand over zero-copy views (torch .numpy()/bf16 reinterpret).
     def allreduce_async(self, name: str, tensor: np.ndarray, average: bool,
                         prescale: float = 1.0) -> int:
         return self._enqueue(
-            _Entry(-1, name, "allreduce", np.ascontiguousarray(tensor),
+            _Entry(-1, name, "allreduce", np.array(tensor),
                    average=average, prescale=prescale)
         )
 
     def allgather_async(self, name: str, tensor: np.ndarray) -> int:
-        return self._enqueue(_Entry(-1, name, "allgather", np.ascontiguousarray(tensor)))
+        return self._enqueue(_Entry(-1, name, "allgather", np.array(tensor)))
 
     def broadcast_async(self, name: str, tensor: np.ndarray, root_rank: int) -> int:
         return self._enqueue(
-            _Entry(-1, name, "broadcast", np.ascontiguousarray(tensor),
+            _Entry(-1, name, "broadcast", np.array(tensor),
                    root_rank=root_rank)
         )
 

