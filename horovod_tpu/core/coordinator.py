@@ -84,6 +84,20 @@ def negotiation_timeout_s() -> float:
     return float(os.environ.get("HVD_NEGOTIATION_TIMEOUT", "600"))
 
 
+def aggregation_enabled() -> bool:
+    """HVD_NEGOTIATION_AGGREGATE=1 routes each round through a process-0
+    digest key: p0 reads the P-1 peer keys and republishes the combined
+    tables once, every peer reads that ONE key — total KV load per
+    round drops from P·(P-1) reads to 2·(P-1), the reference's
+    gather-tree shape (rank-0 MPI_Gatherv tick + response broadcast,
+    operations.cc:2117-2131). Must be set on EVERY process. Off by
+    default: the symmetric protocol has no master to fail, and its
+    round latency is fine at small P (measured curve: docs/running.md)."""
+    val = (os.environ.get("HVD_NEGOTIATION_AGGREGATE")
+           or os.environ.get("HOROVOD_NEGOTIATION_AGGREGATE") or "0")
+    return val.lower() not in ("0", "false", "off")
+
+
 class KVTimeout(Exception):
     pass
 
@@ -338,11 +352,15 @@ class Coordinator:
         # actual KV get attempts (each blocking poll slice counts — the
         # O(P) reads/round that make total KV load O(P^2)/round).
         self.stats = {"rounds": 0, "round_s": 0.0, "kv_gets": 0}
+        self.aggregate = aggregation_enabled()
 
     # -- keys ---------------------------------------------------------------
 
     def _round_key(self, rnd: int, pid: int) -> str:
         return f"{self.ns}/r{rnd}/p{pid}"
+
+    def _digest_key(self, rnd: int) -> str:
+        return f"{self.ns}/r{rnd}/all"
 
     def _tomb_key(self, pid: int) -> str:
         return f"{self.ns}/dead/p{pid}"
@@ -369,6 +387,11 @@ class Coordinator:
             if self.round > 0:
                 _residue.append(
                     (self.ns, self._round_key(self.round - 1, self.pid)))
+            if self.aggregate and self.pid == 0:
+                _residue.append((self.ns, self._digest_key(self.round)))
+                if self.round > 0:
+                    _residue.append(
+                        (self.ns, self._digest_key(self.round - 1)))
         try:
             self.kv.set(self._tomb_key(self.pid), str(self.round))
         except Exception:
@@ -376,8 +399,17 @@ class Coordinator:
 
     # -- the round ----------------------------------------------------------
 
-    def _read_peer(self, rnd: int, peer: int) -> dict:
-        deadline = time.monotonic() + self.timeout_s
+    def _read_peer(self, rnd: int, peer: int, digest: bool = False,
+                   deadline: Optional[float] = None) -> dict:
+        key = self._digest_key(rnd) if digest else self._round_key(rnd, peer)
+        if deadline is None:
+            deadline = time.monotonic() + self.timeout_s
+            if digest:
+                # Digest readers outlast p0's own (whole-gather) deadline
+                # by a grace margin so p0's error digest — which carries
+                # the TRUE straggler attribution — arrives before this
+                # reader gives up and can only blame p0.
+                deadline += 2 * _POLL_SLICE_S
         self.waiting_on = peer
         try:
             while True:
@@ -392,9 +424,15 @@ class Coordinator:
                     raise NegotiationTimeout(peer, self.timeout_s)
                 try:
                     self.stats["kv_gets"] += 1
-                    raw = self.kv.get(self._round_key(rnd, peer),
-                                      min(_POLL_SLICE_S, remaining))
-                    return json.loads(raw)
+                    raw = self.kv.get(key, min(_POLL_SLICE_S, remaining))
+                    msg = json.loads(raw)
+                    if digest and "error" in msg:
+                        # p0's gather failed; it republished the real
+                        # cause so every peer fails with the true
+                        # attribution instead of blaming p0.
+                        raise KVError(
+                            f"negotiation round failed: {msg['error']}")
+                    return msg
                 except KVTimeout:
                     if self.kv.try_get(self._tomb_key(peer)) is not None:
                         raise PeerShutdown(peer) from None
@@ -412,27 +450,64 @@ class Coordinator:
         msg = {"entries": [m.wire() for m in entries]}
         if self.pid == 0:
             msg["params"] = [self.cycle_time_s, self.fusion_threshold]
-        try:
-            self.kv.set(self._round_key(rnd, self.pid), json.dumps(msg))
-        except KVError as exc:
-            self.dead = str(exc)
-            self.close()  # tombstone: let peers fail fast, not time out
-            raise
+        if not (self.aggregate and self.pid == 0):
+            # In gather-tree mode p0's table rides the digest only —
+            # publishing its per-round key too would be a dead KV write
+            # on exactly the plane aggregation exists to unload.
+            try:
+                self.kv.set(self._round_key(rnd, self.pid), json.dumps(msg))
+            except KVError as exc:
+                self.dead = str(exc)
+                self.close()  # tombstone: let peers fail fast, not time out
+                raise
 
         tables: Dict[int, List[RequestMeta]] = {
             self.pid: list(entries)}
         params = msg.get("params")
         try:
-            for peer in range(self.nproc):
-                if peer == self.pid:
-                    continue
-                peer_msg = self._read_peer(rnd, peer)
-                tables[peer] = [RequestMeta.from_wire(w)
-                                for w in peer_msg.get("entries", [])]
-                if peer == 0:
-                    params = peer_msg.get("params")
+            if self.aggregate and self.pid != 0:
+                # Gather-tree mode, non-root: ONE read — p0's digest of
+                # the whole round. Stall attribution still works (the
+                # digest carries every table); if p0's gather fails it
+                # republishes the true cause as an error digest (below),
+                # which this read surfaces verbatim.
+                digest = self._read_peer(rnd, 0, digest=True)
+                tables = {int(p): [RequestMeta.from_wire(w) for w in ws]
+                          for p, ws in digest["tables"].items()}
+                params = digest.get("params")
+            else:
+                # p0's gather shares ONE deadline across all peers (the
+                # symmetric path's per-peer deadline would let p0 outlast
+                # every digest reader by up to (P-1)x, leaving them only
+                # p0 to blame on timeout).
+                gather_deadline = (time.monotonic() + self.timeout_s
+                                   if self.aggregate else None)
+                for peer in range(self.nproc):
+                    if peer == self.pid:
+                        continue
+                    peer_msg = self._read_peer(rnd, peer,
+                                               deadline=gather_deadline)
+                    tables[peer] = [RequestMeta.from_wire(w)
+                                    for w in peer_msg.get("entries", [])]
+                    if peer == 0:
+                        params = peer_msg.get("params")
+                if self.aggregate:
+                    # Gather-tree mode, root: republish the round once.
+                    self.kv.set(self._digest_key(rnd), json.dumps({
+                        "tables": {p: [m.wire() for m in ms]
+                                   for p, ms in tables.items()},
+                        "params": params}))
         except (PeerShutdown, NegotiationTimeout, KVError) as exc:
             self.dead = str(exc)
+            if self.aggregate and self.pid == 0:
+                # Blocked digest readers can only see p0: hand them the
+                # REAL cause (e.g. which process timed out) before the
+                # tombstone makes them fail generically.
+                try:
+                    self.kv.set(self._digest_key(rnd),
+                                json.dumps({"error": str(exc)}))
+                except Exception:
+                    pass
             # We will never publish another round: tombstone so peers
             # blocked on OUR next message fail fast instead of waiting
             # out the full negotiation timeout.
@@ -443,6 +518,8 @@ class Coordinator:
         # fully consumed — reclaim ours.
         if rnd > 0:
             self.kv.delete(self._round_key(rnd - 1, self.pid))
+            if self.aggregate and self.pid == 0:
+                self.kv.delete(self._digest_key(rnd - 1))
         elif rnd == 0:
             # Every peer is in THIS generation now, so no one can ever
             # read a prior generation's keys again — reclaim the residue

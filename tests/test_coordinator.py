@@ -245,3 +245,114 @@ class TestRounds:
         assert errors == [None, None]
         msgs = [r.getMessage() for r in caplog.records]
         assert any("slowpoke" in m and "process(es): 1" in m for m in msgs)
+
+
+class TestAggregatedRounds:
+    """HVD_NEGOTIATION_AGGREGATE=1 — the gather-tree round shape
+    (reference: rank-0 MPI_Gatherv + response broadcast,
+    operations.cc:2117-2131): p0 reads P-1 peers and republishes ONE
+    digest; peers read only that. Decisions must be bit-identical to
+    the symmetric protocol's."""
+
+    def _world(self, per_process_entries, nproc, monkeypatch, fusion=1 << 26):
+        monkeypatch.setenv("HVD_NEGOTIATION_AGGREGATE", "1")
+        store = {}
+        results = [None] * nproc
+        errors = [None] * nproc
+        coords = [None] * nproc
+
+        def worker(pid):
+            c = Coordinator(LocalKV(store), nproc, pid, 0.005, fusion,
+                            timeout_s=10.0)
+            coords[pid] = c
+            assert c.aggregate
+            try:
+                results[pid] = c.negotiate(per_process_entries[pid])
+            except Exception as exc:
+                errors[pid] = exc
+
+        threads = [threading.Thread(target=worker, args=(p,))
+                   for p in range(nproc)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=30)
+        assert errors == [None] * nproc, errors
+        return results, coords, store
+
+    def test_same_decision_as_symmetric(self, monkeypatch):
+        entries = [[meta("a"), meta("b")], [meta("b"), meta("a")],
+                   [meta("a"), meta("b")], [meta("b")]]
+        agg, _, _ = self._world(entries, 4, monkeypatch)
+        monkeypatch.delenv("HVD_NEGOTIATION_AGGREGATE")
+        sym, errs = run_round(entries, nproc=4)
+        assert errs == [None] * 4
+        for a, s in zip(agg, sym):
+            assert [g.indices for g in a.groups] == \
+                   [g.indices for g in s.groups]
+            assert (a.cycle_time_s, a.fusion_threshold) == \
+                   (s.cycle_time_s, s.fusion_threshold)
+
+    def test_non_roots_read_one_key_per_round(self, monkeypatch):
+        entries = [[meta("x")] for _ in range(4)]
+        _, coords, _ = self._world(entries, 4, monkeypatch)
+        assert coords[0].stats["kv_gets"] == 3  # p0 gathers P-1 peers
+        for c in coords[1:]:
+            assert c.stats["kv_gets"] == 1, c.stats  # ONE digest read
+
+    def test_stall_attribution_survives_digest(self, monkeypatch):
+        # Everyone announced "x"; only p0 announced "lag" — every
+        # process must name the processes missing it, incl. digest
+        # readers (reference: CheckForStalledTensors names ranks).
+        entries = [[meta("x"), meta("lag")]] + [[meta("x")]] * 3
+        _, coords, _ = self._world(entries, 4, monkeypatch)
+        for c in coords:
+            assert c.missing_processes("lag") == [1, 2, 3]
+
+    def test_digest_keys_cleaned_up(self, monkeypatch):
+        monkeypatch.setenv("HVD_NEGOTIATION_AGGREGATE", "1")
+        store = {}
+        coords = [Coordinator(LocalKV(store), 2, p, 0.005, 0,
+                              timeout_s=10.0) for p in range(2)]
+
+        def rounds(c, n):
+            for _ in range(n):
+                c.negotiate([meta("t")])
+
+        threads = [threading.Thread(target=rounds, args=(c, 3))
+                   for c in coords]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=30)
+        digests = [k for k in store if k.endswith("/all")]
+        # Only the live round's digest (and possibly the just-written
+        # next one) may remain — consumed rounds are reclaimed.
+        assert len(digests) <= 2, sorted(store)
+
+    def test_straggler_attribution_reaches_digest_readers(self, monkeypatch):
+        """P=3 gather-tree, process 2 never publishes: p0 times out
+        naming process 2, and process 1 — which can only see p0's
+        digest — must receive THAT attribution (the error digest), not
+        a generic 'process 0 timed out' (code-review r4 finding)."""
+        monkeypatch.setenv("HVD_NEGOTIATION_AGGREGATE", "1")
+        store = {}
+        errors = {}
+
+        def worker(pid):
+            c = Coordinator(LocalKV(store), 3, pid, 0.005, 0,
+                            timeout_s=1.0)
+            try:
+                c.negotiate([meta("t")])
+            except Exception as exc:
+                errors[pid] = exc
+
+        threads = [threading.Thread(target=worker, args=(p,))
+                   for p in (0, 1)]  # process 2 is the silent straggler
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=30)
+        assert isinstance(errors.get(0), NegotiationTimeout)
+        assert errors[0].process == 2
+        assert "process 2" in str(errors.get(1)), errors

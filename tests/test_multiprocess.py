@@ -282,6 +282,32 @@ def test_negotiation_round_latency_vs_world_size(nproc):
             assert r["per_round_ms"] < 1500.0, r
 
 
+def test_negotiation_aggregate_gather_tree_np8():
+    """HVD_NEGOTIATION_AGGREGATE=1 (the reference's gather-tree shape):
+    correctness at P=8 plus the load signature — non-root processes
+    read ~one key per round instead of P-1 (total KV load O(P) instead
+    of O(P^2))."""
+    outs = _run_world("negotiation_latency", nproc=8, timeout=420,
+                      extra_env={"HVD_TEST_LOCAL_DEVICES": "1",
+                                 "HVD_NEGOTIATION_AGGREGATE": "1"})
+    import json as _json
+
+    recs = []
+    for out in outs:
+        line = [ln for ln in out.splitlines() if "NEG_LATENCY" in ln][-1]
+        recs.append(_json.loads(line.split("NEG_LATENCY ", 1)[1]))
+    assert len(recs) == 8
+    # One record is p0 (gets ~= (P-1)*rounds); the rest must sit near
+    # one get per round (poll-slice retries allowed; the symmetric
+    # protocol's ratio here is ~7x rounds).
+    ratios = sorted(r["kv_gets"] / max(r["rounds"], 1) for r in recs)
+    assert ratios[-1] > 3.0, ratios   # the root's gather
+    for ratio in ratios[:-1]:
+        assert ratio < 2.0, ratios    # digest readers
+    for r in recs:
+        assert r["burst_ms"] < r["seq_ms"], r
+
+
 def test_eight_process_collectives():
     """The widest world one host can stage: 8 controllers x 1 chip.
     Negotiation readiness/cleanup and the compiled collectives hold at
