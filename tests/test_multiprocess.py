@@ -229,6 +229,19 @@ def test_four_process_negotiation_fuzz(engine):
     assert sum("fuzz 40 ops OK" in out for out in outs) == 4
 
 
+def test_four_process_negotiation_fuzz_aggregate():
+    """The fuzz scenario under the gather-tree round shape
+    (HVD_NEGOTIATION_AGGREGATE=1): 40 shuffled mixed collectives per
+    process must still converge on identical batches when every peer
+    reads only p0's digest. (All failure-injection scenarios — fuzz,
+    mismatch, SIGKILL, re-init — were validated under aggregate mode in
+    r4; this pins the broadest one in CI.)"""
+    outs = _run_world("engine_fuzz", nproc=4, timeout=300,
+                      extra_env={**_NP4,
+                                 "HVD_NEGOTIATION_AGGREGATE": "1"})
+    assert sum("fuzz 40 ops OK" in out for out in outs) == 4
+
+
 @pytest.mark.parametrize("engine", ENGINES)
 def test_two_process_engine_reinit_generations(engine):
     """Three collective shutdown/re-init cycles: each generation
