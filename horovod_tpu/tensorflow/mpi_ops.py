@@ -45,7 +45,8 @@ def _np_collective(kind: str, t: np.ndarray, *, name: str,
         h = e.allreduce_async(name, np.atleast_1d(t), average)
         return e.synchronize(h).reshape(np.shape(t))
     if kind == "allgather":
-        return e.synchronize(e.allgather_async(name, t))
+        # Scalars ride the >=1-d wire as one gathered row apiece.
+        return e.synchronize(e.allgather_async(name, np.atleast_1d(t)))
     if kind == "broadcast":
         h = e.broadcast_async(name, np.atleast_1d(t), root)
         return e.synchronize(h).reshape(np.shape(t))
@@ -140,9 +141,13 @@ def _bridge(kind: str, tensor: tf.Tensor, **kw) -> tf.Tensor:
     if kind != "allgather":
         out.set_shape(tensor.shape)
     else:
-        shape = tensor.shape.as_list()
-        if shape and shape[0] is not None:
-            shape[0] = shape[0] * _topo.size()
+        # Per-rank first dims may differ (reference: mpi_ops.py:108-126),
+        # so the gathered first dim is dynamic; a scalar input contributes
+        # one row on the >=1-d wire.
+        shape = (tensor.shape.as_list()
+                 if tensor.shape.rank is not None else None)
+        if shape is not None:
+            shape = [None] + shape[1:] if shape else [None]
         out.set_shape(shape)
     return out
 
@@ -173,21 +178,39 @@ def _allreduce(tensor: tf.Tensor, average: bool = False,
 
 def allgather(tensor: tf.Tensor, name: Optional[str] = None) -> tf.Tensor:
     """Concat along dim 0 over ranks (reference: mpi_ops.py:108-126)."""
-    n = _topo.size()
 
     @tf.custom_gradient
     def op(x):
         y = _bridge("allgather", x)
+        in_rank = x.shape.rank
 
         def grad(dy):
-            # Reference: allreduce(SUM) then slice this rank's rows
-            # (mpi_ops.py:127-148). Equal first dims per rank here (the
-            # single-controller case); the eager varying-dim path exists
-            # on the jax frontend.
-            summed = _bridge("allreduce", dy, average=False)
-            per = tf.shape(summed)[0] // n
+            # Reference: allreduce(SUM) the cotangent, then slice this
+            # rank's rows by the TRUE per-rank first dims — ranks may
+            # contribute unequal counts, so the sizes are themselves
+            # allgathered (mpi_ops.py:127-148; torch does the same,
+            # torch/mpi_ops.py:169-176). Both collectives ride ONE
+            # grouped py_function: two blocking single-op bridges could
+            # wedge cross-rank under TF's sequential executor.
+            if in_rank == 0:
+                # Every rank contributes exactly one row by construction:
+                # no dims exchange needed.
+                summed = _bridge("allreduce", dy, average=False)
+                r = _topo.rank()
+                return tf.reshape(summed[r:r + 1], [])
+            # [first_dim]; yields [1] for a runtime scalar (unknown static
+            # rank) riding the >=1-d wire.
+            my_dim = tf.concat([tf.shape(x), [1]], 0)[:1]
+            names = _group_names("agrad", ["sum", "dims"])
+            summed, dims = _bridge_group(
+                ["allreduce", "allgather"], [dy, my_dim], names)
             r = _topo.rank()
-            return summed[per * r: per * (r + 1)]
+            offset = tf.reduce_sum(dims[:r])
+            begin = tf.concat(
+                [[offset], tf.zeros([tf.rank(summed) - 1], tf.int32)], 0)
+            size_vec = tf.concat([my_dim, tf.shape(summed)[1:]], 0)
+            sliced = tf.slice(summed, begin, size_vec)
+            return tf.reshape(sliced, tf.shape(x))
 
         return y, grad
 

@@ -123,6 +123,44 @@ def test_allgather_gradient_cotangent_slices():
     np.testing.assert_allclose(g.numpy(), w[r:r + 1].numpy() * 8)
 
 
+def test_allgather_gradient_unequal_first_dims():
+    """Ranks may contribute DIFFERENT first dims; the backward must split
+    the reduced cotangent by the true per-rank sizes, not an equal split
+    (reference: mpi_ops.py:127-148). Under the launcher's -np 2 world the
+    two controllers genuinely contribute 1 vs 2 rows; single-process all
+    chips agree (the path still runs end to end)."""
+    r = hvd_tf.rank()
+    rows = 1 if r == 0 else 2
+    x = tf.Variable(np.full((rows, 2), float(r + 1), np.float32))
+    with tf.GradientTape() as tape:
+        y = hvd_tf.allgather(x)
+        # Cotangent = the global row index, so a mis-sliced backward is
+        # numerically visible, not just shape-wrong.
+        w = tf.reshape(tf.range(tf.shape(y)[0], dtype=tf.float32), [-1, 1])
+        loss = tf.reduce_sum(y * w)
+    g = tape.gradient(loss, x)
+    assert g.shape == (rows, 2)
+    dims = hvd_tf.allgather(tf.constant([rows], tf.int32)).numpy()
+    offset = int(dims[:r].sum())
+    expect = 8.0 * np.arange(offset, offset + rows, dtype=np.float32)
+    np.testing.assert_allclose(g.numpy(), np.tile(expect[:, None], (1, 2)))
+
+
+def test_allgather_scalar_input():
+    """A rank-0 input rides the >=1-d wire as one gathered row apiece
+    (r4 advisor finding: the scalar path skipped atleast_1d and declared
+    a scalar static shape)."""
+    x = tf.Variable(3.0)
+    with tf.GradientTape() as tape:
+        y = hvd_tf.allgather(x)
+        loss = tf.reduce_sum(y)
+    assert y.shape.rank == 1
+    np.testing.assert_allclose(y.numpy(), np.full(int(y.shape[0]), 3.0))
+    g = tape.gradient(loss, x)
+    assert g.shape.rank == 0
+    np.testing.assert_allclose(g.numpy(), 8.0)
+
+
 def test_sparse_allreduce_indexed_slices():
     """Reference sparse path: IndexedSlices -> allgather
     (tensorflow/__init__.py:48-94)."""
