@@ -42,6 +42,7 @@ from __future__ import annotations
 import json
 import logging
 import os
+import re
 import threading
 import time
 from dataclasses import dataclass
@@ -567,6 +568,31 @@ class Coordinator:
         return [p for p in range(self.nproc)
                 if name not in self.last_tables.get(p, set())]
 
+    def counter_divergence_peer(
+            self, name: str) -> Optional[Tuple[int, str]]:
+        """A peer holding a HIGHER-numbered name of the same family while
+        this stalled (lower) name is missing from it proves the stall
+        cannot resolve: names are constructed in program order, so a peer
+        that reached the higher number either announced the lower one too
+        (then it would not be missing) or never will — its counters
+        diverged (asymmetric tf.function retrace / rank-conditional
+        program) or its sequential executor wedged on a different
+        blocking single-op collective. A peer holding only LOWER numbers
+        is an ordinary straggler and gets no hint."""
+        skeleton = re.sub(r"\d+", "#", name)
+        mine = tuple(int(d) for d in re.findall(r"\d+", name))
+        for p in range(self.nproc):
+            names = self.last_tables.get(p, set())
+            if name in names:
+                continue
+            for other in names:
+                if other == name or re.sub(r"\d+", "#", other) != skeleton:
+                    continue
+                theirs = tuple(int(d) for d in re.findall(r"\d+", other))
+                if theirs > mine:
+                    return p, other
+        return None
+
     def _maybe_warn_stalls(self, entries: Sequence[RequestMeta]):
         if self.stall_warning_s <= 0:
             return
@@ -579,8 +605,12 @@ class Coordinator:
                 continue
             missing = self.missing_processes(m.name)
             if missing:
-                lines.append(f"{m.name} [missing from process(es): "
-                             f"{', '.join(map(str, missing))}]")
+                line = (f"{m.name} [missing from process(es): "
+                        f"{', '.join(map(str, missing))}]")
+                hint = divergence_hint(self, m.name)
+                if hint:
+                    line += hint
+                lines.append(line)
         if lines:
             self._last_stall_warn = now
             LOG.warning(
@@ -588,6 +618,24 @@ class Coordinator:
                 "or broadcast by a subset of processes and are waiting for "
                 "the remainder for more than %ds: %s",
                 int(self.stall_warning_s), "; ".join(lines))
+
+
+def divergence_hint(coordinator, name: str) -> Optional[str]:
+    """Human-readable diagnosis when a stalled tensor's peers hold a
+    same-family, different-numbered name (see counter_divergence_peer) —
+    shared by the coordinator's warn path and the engines' watchdogs so
+    every stall report carries the same fail-fast hint."""
+    diverged = coordinator.counter_divergence_peer(name)
+    if not diverged:
+        return None
+    p, other = diverged
+    return (f" [process {p} holds '{other}' — same collective family, "
+            "different sequence number: either op-construction order "
+            "diverged across processes (asymmetric tf.function retrace / "
+            "rank-conditional program — every process must build "
+            "identical programs) or independent blocking single-op "
+            "collectives wedged under a sequential executor (submit them "
+            "as ONE group instead)]")
 
 
 # Engine generation counter: each engine shutdown/re-init cycle gets a

@@ -663,9 +663,14 @@ class Engine:
                 if c is not None and c.last_tables:
                     missing = c.missing_processes(n)
                     if missing:
-                        return (f"{n} ({int(age)}s; missing from "
+                        from horovod_tpu.core import coordinator as coord
+
+                        line = (f"{n} ({int(age)}s; missing from "
                                 f"process(es): "
                                 f"{', '.join(map(str, missing))})")
+                        # Unresolvable-divergence diagnosis (same family,
+                        # different sequence number on a peer).
+                        return line + (coord.divergence_hint(c, n) or "")
                 return f"{n} ({int(age)}s)"
 
             names = ", ".join(_fmt(n, age) for n, age in stalled)

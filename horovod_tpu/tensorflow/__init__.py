@@ -33,19 +33,23 @@ from horovod_tpu.tensorflow.mpi_ops import (  # noqa: F401
 
 
 def allreduce(tensor, average: bool = True, device_dense: str = "",
-              device_sparse: str = "", compression=Compression.none):
+              device_sparse: str = "", compression=Compression.none,
+              name: Optional[str] = None):
     """Allreduce with the reference's sparse path: IndexedSlices become an
     allgather of values+indices (reference:
-    horovod/tensorflow/__init__.py:48-94)."""
+    horovod/tensorflow/__init__.py:48-94). A user-supplied ``name`` is
+    the engine matching key — fully stable across re-traces."""
     if isinstance(tensor, tf.IndexedSlices):
-        values = allgather(tensor.values)
-        indices = allgather(tensor.indices)
+        values = allgather(tensor.values,
+                           name=f"{name}.values" if name else None)
+        indices = allgather(tensor.indices,
+                            name=f"{name}.indices" if name else None)
         if average:
             values = tf.math.divide(values, float(size()))
         return tf.IndexedSlices(values, indices,
                                 dense_shape=tensor.dense_shape)
     t, ctx = compression.compress(tensor)
-    summed = _allreduce(t, average=False)
+    summed = _allreduce(t, average=False, name=name)
     out = compression.decompress(summed, ctx)
     if average:
         out = tf.math.divide(out, float(size()))

@@ -53,7 +53,32 @@ def _np_collective(kind: str, t: np.ndarray, *, name: str,
     raise ValueError(kind)
 
 
-_BRIDGE_SEQ = {}
+def _seq_next(key: str) -> int:
+    """Per-kind sequence number scoped to the GRAPH under construction.
+
+    Engine names must match across processes; they are assigned at
+    op-construction time, so they must depend only on the op's position
+    within the program being built — never on how many programs were
+    built before. A process-global counter broke exactly there (r4
+    advisor): one process retracing a tf.function (new input shape,
+    rank-conditional branch) marched its counter past its peers' and
+    every later collective stalled on mismatched names until timeout.
+    Scoping the counter to the graph makes a re-trace rebuild the SAME
+    names. Eager ops scope to the persistent default graph — one
+    process-wide sequence, matched across processes by identical call
+    order (the contract the reference's stable tensor names rely on).
+
+    Same-name reuse across graphs/steps is safe: the engine pairs
+    same-name requests FIFO per process, the per-step reuse pattern the
+    reference is built on (tensor names recur every iteration)."""
+    g = tf.compat.v1.get_default_graph()
+    d = getattr(g, "_hvd_bridge_seq", None)
+    if d is None:
+        d = {}
+        g._hvd_bridge_seq = d
+    seq = d.get(key, 0)
+    d[key] = seq + 1
+    return seq
 
 
 def _bridge_group(kind: str, tensors, names, *, average=False, root=0):
@@ -112,27 +137,38 @@ def _bridge_group(kind: str, tensors, names, *, average=False, root=0):
 
 
 def _group_names(kind: str, labels) -> list:
-    """Stable engine names for a grouped collective: a per-kind sequence
-    number (identical across processes — every controller constructs the
-    same program in the same order) plus a per-member label (variable
-    name), so request matching survives arbitrary EXECUTION order."""
-    seq = _BRIDGE_SEQ.get("g" + kind, 0)
-    _BRIDGE_SEQ["g" + kind] = seq + 1
+    """Stable engine names for a grouped collective: a per-kind,
+    per-graph sequence number (identical across processes — every
+    controller constructs the same program in the same order) plus a
+    per-member label (variable name), so request matching survives
+    arbitrary EXECUTION order and asymmetric re-traces."""
+    seq = _seq_next("g" + kind)
     return [f"tf.{kind}g{seq}.{label}" for label in labels]
 
 
-def _bridge(kind: str, tensor: tf.Tensor, **kw) -> tf.Tensor:
+def _bridge(kind: str, tensor: tf.Tensor, name: Optional[str] = None,
+            **kw) -> tf.Tensor:
     """Run an engine collective on a TF tensor via py_function so the op
     works in both eager and tf.function graphs.
 
-    The engine name is assigned at op-CONSTRUCTION time from a per-kind
-    counter: every controller builds the same graph (or traces/executes
-    the same program) in the same order, so node N gets the same name
-    everywhere — the negotiation key the engine matches requests by —
-    while concurrent EXECUTION order stays free."""
-    seq = _BRIDGE_SEQ.get(kind, 0)
-    _BRIDGE_SEQ[kind] = seq + 1
-    opname = f"tf.{kind}.{seq}"
+    The engine name is assigned at op-CONSTRUCTION time — from the
+    user-supplied ``name`` when given (fully retrace-proof, the
+    reference's contract), else a per-kind per-graph counter: every
+    controller builds the same program in the same order, so node N gets
+    the same name everywhere — the negotiation key the engine matches
+    requests by — while concurrent EXECUTION order stays free.
+
+    NOTE (v1 Session graphs): py_function bodies execute strictly
+    sequentially per process; tf.function and eager run them in program
+    order (auto control deps serialize stateful ops), but a v1 Session
+    schedules them in arbitrary order, so MULTIPLE independent blocking
+    single-op collectives in one session.run can wedge cross-rank. The
+    v1 surfaces this package ships (hooks, DistributedOptimizer,
+    broadcast_global_variables) group their collectives through ONE
+    py_function (_bridge_group); hand-built v1 graphs with several
+    public per-tensor ops should do the same."""
+    opname = (f"tf.{kind}.{name}" if name
+              else f"tf.{kind}.{_seq_next(kind)}")
 
     def fn(t):
         return _np_collective(kind, t.numpy(), name=opname, **kw)
@@ -164,12 +200,13 @@ def _allreduce(tensor: tf.Tensor, average: bool = False,
                name: Optional[str] = None) -> tf.Tensor:
     @tf.custom_gradient
     def op(x):
-        y = _bridge("allreduce", x, average=average)
+        y = _bridge("allreduce", x, name=name, average=average)
 
         def grad(dy):
             # Reference: allreduce's gradient is an allreduce
             # (tensorflow/mpi_ops.py:94-105).
-            return _bridge("allreduce", dy, average=average)
+            gname = f"{name}.grad" if name else None
+            return _bridge("allreduce", dy, name=gname, average=average)
 
         return y, grad
 
@@ -181,7 +218,7 @@ def allgather(tensor: tf.Tensor, name: Optional[str] = None) -> tf.Tensor:
 
     @tf.custom_gradient
     def op(x):
-        y = _bridge("allgather", x)
+        y = _bridge("allgather", x, name=name)
         in_rank = x.shape.rank
 
         def grad(dy):
@@ -192,16 +229,18 @@ def allgather(tensor: tf.Tensor, name: Optional[str] = None) -> tf.Tensor:
             # torch/mpi_ops.py:169-176). Both collectives ride ONE
             # grouped py_function: two blocking single-op bridges could
             # wedge cross-rank under TF's sequential executor.
+            gname = f"{name}.grad" if name else None
             if in_rank == 0:
                 # Every rank contributes exactly one row by construction:
                 # no dims exchange needed.
-                summed = _bridge("allreduce", dy, average=False)
+                summed = _bridge("allreduce", dy, name=gname, average=False)
                 r = _topo.rank()
                 return tf.reshape(summed[r:r + 1], [])
             # [first_dim]; yields [1] for a runtime scalar (unknown static
             # rank) riding the >=1-d wire.
             my_dim = tf.concat([tf.shape(x), [1]], 0)[:1]
-            names = _group_names("agrad", ["sum", "dims"])
+            names = ([f"tf.agradg.{gname}.sum", f"tf.agradg.{gname}.dims"]
+                     if gname else _group_names("agrad", ["sum", "dims"]))
             summed, dims = _bridge_group(
                 ["allreduce", "allgather"], [dy, my_dim], names)
             r = _topo.rank()
@@ -224,12 +263,13 @@ def broadcast(tensor: tf.Tensor, root_rank: int,
 
     @tf.custom_gradient
     def op(x):
-        y = _bridge("broadcast", x, root=root_rank)
+        y = _bridge("broadcast", x, name=name, root=root_rank)
 
         def grad(dy):
             # Reference: reduce to root, zero elsewhere (mpi_ops.py:
             # 168-183).
-            g = _bridge("allreduce", dy, average=False)
+            gname = f"{name}.grad" if name else None
+            g = _bridge("allreduce", dy, name=gname, average=False)
             if _topo.rank() == root_rank:
                 return g
             return tf.zeros_like(g)

@@ -246,6 +246,38 @@ class TestRounds:
         msgs = [r.getMessage() for r in caplog.records]
         assert any("slowpoke" in m and "process(es): 1" in m for m in msgs)
 
+    def test_stall_warning_names_counter_divergence(self, caplog):
+        """When a peer holds the SAME collective under a different
+        sequence number (asymmetric retrace marched its construction
+        counter forward), the stall warning must name the divergence —
+        this stall can never resolve, unlike an ordinary straggler
+        (r4 advisor finding on the TF bridge's process-global counter)."""
+        mine = [meta("tf.allreduce.g3.w", age_s=99.0)]
+        theirs = [meta("tf.allreduce.g4.w", age_s=99.0)]
+        with caplog.at_level(logging.WARNING,
+                             logger="horovod_tpu.coordinator"):
+            results, errors = run_round({0: mine, 1: theirs}, nproc=2,
+                                        stall_warning_s=1.0)
+        assert errors == [None, None]
+        msgs = [r.getMessage() for r in caplog.records]
+        assert any("tf.allreduce.g4.w" in m and "sequence number" in m
+                   for m in msgs), msgs
+        # Only the LOWER-holding process diagnoses divergence; the peer
+        # holding the higher name sees a plain straggler (a peer behind
+        # on lower numbers may simply catch up — no false positives for
+        # ordinary async stragglers).
+        assert sum("sequence number" in m for m in msgs) == 1, msgs
+        # An ordinary straggler (no same-skeleton peer name) must NOT
+        # carry the divergence hint.
+        caplog.clear()
+        with caplog.at_level(logging.WARNING,
+                             logger="horovod_tpu.coordinator"):
+            run_round({0: [meta("plain", age_s=99.0)], 1: []}, nproc=2,
+                      stall_warning_s=1.0)
+        msgs = [r.getMessage() for r in caplog.records]
+        assert any("plain" in m for m in msgs)
+        assert not any("sequence number" in m for m in msgs)
+
 
 class TestAggregatedRounds:
     """HVD_NEGOTIATION_AGGREGATE=1 — the gather-tree round shape

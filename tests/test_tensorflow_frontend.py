@@ -250,6 +250,49 @@ def test_tf_function_graph_mode():
     np.testing.assert_allclose(out.numpy(), [8.0, 8.0])
 
 
+def test_bridge_names_scoped_per_graph():
+    """Sequence counters are scoped to the graph under construction, so
+    a RE-trace rebuilds the same engine names instead of marching a
+    process-global counter past the peers' (r4 advisor finding)."""
+    from horovod_tpu.tensorflow import mpi_ops as ops
+
+    g1, g2 = tf.Graph(), tf.Graph()
+    with g1.as_default():
+        first = ops._group_names("allreduce", ["w", "b"])
+        second = ops._group_names("allreduce", ["w"])
+    with g2.as_default():  # a retrace = a fresh graph
+        retraced = ops._group_names("allreduce", ["w", "b"])
+    assert first == ["tf.allreduceg0.w", "tf.allreduceg0.b"]
+    assert second == ["tf.allreduceg1.w"]  # later group, same graph
+    assert retraced == first  # fresh graph restarts the sequence
+
+
+def test_tf_function_asymmetric_retrace_keeps_collectives_paired():
+    """Only SOME processes retrace (a different batch shape, e.g. a
+    partial final batch with drop_remainder=False); the gradient
+    allreduce inside must still pair across processes. A process-global
+    name counter permanently desynced here (r4 advisor); graph-scoped
+    counters rebuild identical names. Under the launcher's -np 2 world
+    the second controller genuinely retraces while the first does not."""
+
+    @tf.function
+    def step(batch):
+        g = tf.reduce_sum(batch, axis=0)  # weight-shaped: [2]
+        return hvd_tf.allreduce(g, average=False)
+
+    r = hvd_tf.rank()
+    out1 = step(tf.ones([4, 2]))
+    # Expected = sum of every chip's contribution, computed via the
+    # (independently tested) eager allgather.
+    rows = 4 if r == 0 else 2
+    mine = np.full((1, 2), float(rows), np.float32)
+    expect = hvd_tf.allgather(tf.constant(mine)).numpy().sum(axis=0)
+    # Non-zero controllers see a second SHAPE -> only they retrace.
+    out2 = step(tf.ones([rows, 2]))
+    np.testing.assert_allclose(out2.numpy(), expect)
+    assert out1.shape == out2.shape
+
+
 def test_v1_session_skeleton_runs_unmodified(hvd):
     """The reference example's session-era training skeleton — v1 graph,
     placeholder feed, tf.compat.v1.train optimizer wrapped by
