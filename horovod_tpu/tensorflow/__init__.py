@@ -186,7 +186,24 @@ def DistributedOptimizer(optimizer, name: Optional[str] = None,
         return _distributed_v1_optimizer(optimizer, average, compression,
                                          sparse_as_dense)
 
-    class _Distributed(optimizer.__class__):
+    # Fresh instance of the dynamic subclass; slots build lazily on first
+    # apply_gradients (keras 3 semantics). Wrap BEFORE any training, as the
+    # reference requires (its optimizer is likewise wrapped pre-training).
+    cls = _distributed_cls(optimizer.__class__, average, compression,
+                           sparse_as_dense)
+    return cls.from_config(optimizer.get_config())
+
+
+def _distributed_cls(base_cls, average, compression, sparse_as_dense):
+    """Dynamic optimizer subclass whose apply_gradients allreduces first.
+
+    The class keeps the BASE class's name (the reference does the same,
+    horovod/_keras/__init__.py:93-109): keras serialization records the
+    class name, so a model compiled with the wrapped optimizer saves as
+    its underlying optimizer and :func:`load_model` can restore + re-wrap
+    it — symmetric save/load."""
+
+    class _Distributed(base_cls):
         _hvd_wrapped = True
 
         def apply_gradients(self, grads_and_vars, *args, **kwargs):
@@ -195,10 +212,61 @@ def DistributedOptimizer(optimizer, name: Optional[str] = None,
                                           sparse_as_dense)
             return super().apply_gradients(reduced, *args, **kwargs)
 
-    # Fresh instance of the dynamic subclass; slots build lazily on first
-    # apply_gradients (keras 3 semantics). Wrap BEFORE any training, as the
-    # reference requires (its optimizer is likewise wrapped pre-training).
-    return _Distributed.from_config(optimizer.get_config())
+    _Distributed.__name__ = base_cls.__name__
+    _Distributed.__qualname__ = base_cls.__qualname__
+    # Keep the base's module too: keras 3 records (module, class_name) and
+    # only imports keras-family modules on load, so without this a PLAIN
+    # tf.keras.models.load_model of a wrapped save would raise instead of
+    # restoring the (unwrapped) base optimizer.
+    _Distributed.__module__ = base_cls.__module__
+    return _Distributed
+
+
+def _standard_keras_optimizers() -> list:
+    """Every optimizer class reachable from tf.keras.optimizers (the
+    deserialization candidates the reference enumerates as Optimizer
+    subclasses, horovod/keras/__init__.py:118-148)."""
+    base = tf.keras.optimizers.Optimizer
+    out = []
+    for attr in dir(tf.keras.optimizers):
+        cls = getattr(tf.keras.optimizers, attr, None)
+        if (isinstance(cls, type) and issubclass(cls, base)
+                and cls is not base):
+            out.append(cls)
+    return out
+
+
+def load_model(filepath, custom_optimizers=None, custom_objects=None,
+               compression=Compression.none, average: bool = True,
+               sparse_as_dense: bool = False):
+    """Load a tf.keras model saved with ``Model.save`` and re-wrap its
+    optimizer in :func:`DistributedOptimizer` (reference:
+    horovod/keras/__init__.py:118-148 + _keras/__init__.py:93-109 — a
+    plain ``keras.models.load_model`` silently restores an UNWRAPPED
+    optimizer and every process trains on its own gradients).
+
+    Works for models saved with either a wrapped or a plain optimizer:
+    the file deserializes under a scope that resolves the recorded class
+    name (wrapped saves record the base optimizer's name — see
+    `_distributed_cls`), then the restored instance is re-classed onto
+    the distributed subclass, preserving all restored slot state
+    (momentum/moments), unlike a from_config reconstruction.
+
+    ``custom_optimizers``: extra optimizer classes needed to deserialize
+    (user-defined subclasses); ``custom_objects``: forwarded to keras
+    (layers, losses, ...)."""
+    objs = {c.__name__: c for c in _standard_keras_optimizers()}
+    for c in (custom_optimizers or []):
+        objs[c.__name__] = c
+    objs.update(custom_objects or {})
+    with tf.keras.utils.custom_object_scope(objs):
+        model = tf.keras.models.load_model(filepath)
+    opt = getattr(model, "optimizer", None)
+    if opt is not None and not getattr(type(opt), "_hvd_wrapped", False) \
+            and not isinstance(opt, tf.compat.v1.train.Optimizer):
+        opt.__class__ = _distributed_cls(type(opt), average, compression,
+                                         sparse_as_dense)
+    return model
 
 
 def _distributed_v1_optimizer(optimizer, average, compression,

@@ -167,7 +167,9 @@ def _bridge(kind: str, tensor: tf.Tensor, name: Optional[str] = None,
     broadcast_global_variables) group their collectives through ONE
     py_function (_bridge_group); hand-built v1 graphs with several
     public per-tensor ops should do the same."""
-    opname = (f"tf.{kind}.{name}" if name
+    # 'u.' keeps user names out of the auto-counter namespace (a user
+    # name of '0' must not pair with an unnamed op's 'tf.{kind}.0').
+    opname = (f"tf.{kind}.u.{name}" if name
               else f"tf.{kind}.{_seq_next(kind)}")
 
     def fn(t):
