@@ -49,10 +49,13 @@ def main():
                         "to amortize the fetch round-trip (~90 ms on the "
                         "tunneled platform) below the noise floor")
     p.add_argument("--num-iters", type=int, default=5)
-    p.add_argument("--steps-per-call", type=int, default=50,
+    p.add_argument("--steps-per-call", type=int, default=200,
                    help="training steps fused into one dispatch via "
                         "lax.scan; amortizes per-call host latency "
-                        "(each scanned step is a full real SGD update)")
+                        "(each scanned step is a full real SGD update). "
+                        "The default is one dispatch per timed window: "
+                        "measured +0.4%% over 4 dispatches/window and "
+                        "removes multi-call wobble from the headline")
     p.add_argument("--unroll", type=int, default=5,
                    help="lax.scan unroll factor: >1 lets XLA software-"
                         "pipeline across step boundaries (prefetch next "
@@ -61,6 +64,15 @@ def main():
                         "bs32: 2 is +4%%, 4-5 are +6%%)")
     p.add_argument("--fp16-allreduce", action="store_true",
                    help="bf16 gradient compression on the wire")
+    p.add_argument("--remat-blocks", nargs="?", const="act_drop",
+                   default=None, choices=["act_drop", "conv_saves"],
+                   help="ResNet traffic-removal remat: 'act_drop' "
+                        "(default) drops the tagged post-BN/ReLU/join "
+                        "activations from the saved set and recomputes "
+                        "them in backward from saved conv outputs + BN "
+                        "stats; 'conv_saves' saves ONLY conv outputs "
+                        "(measured negative — see docs/benchmarks.md). "
+                        "Numerics identical either way")
     p.add_argument("--profile", metavar="DIR", default=None,
                    help="capture an XLA profiler trace of one timed "
                         "window into DIR (view: tensorboard --logdir DIR)")
@@ -128,6 +140,14 @@ def main():
         loss = optax.softmax_cross_entropy_with_integer_labels(
             logits, labels).mean()
         return loss, mutated["batch_stats"]
+
+    if args.remat_blocks:
+        from horovod_tpu.models import resnet as _resnet
+
+        # Traffic-removal remat (see models/resnet.py policy docstrings).
+        policy = (_resnet.act_drop_policy() if args.remat_blocks == "act_drop"
+                  else _resnet.conv_saves_policy())
+        loss_fn = jax.checkpoint(loss_fn, policy=policy)
 
     def one_step(params, batch_stats, opt_state, key, images, labels):
         key, sub = jax.random.split(key)

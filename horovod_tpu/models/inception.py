@@ -38,6 +38,20 @@ def _pool_avg(x):
     return nn.avg_pool(x, (3, 3), strides=(1, 1), padding="SAME")
 
 
+def conv3_kernel_to_s2d(k3):
+    """Exact reparameterization of the stem's 3x3/s2 'VALID' kernel
+    [3,3,C,O] as the equivalent 2x2/s1 kernel [2,2,4C,O] over
+    space-to-depth input (zero-pad 3→4 taps, fold tap parity into the
+    subpixel channels — the same mapping as ResNet's
+    :func:`~horovod_tpu.models.resnet.conv7_kernel_to_s2d`). Used by the
+    equivalence test; training uses the 2x2 form directly."""
+    c, o = k3.shape[2], k3.shape[3]
+    k4 = jnp.pad(k3, ((0, 1), (0, 1), (0, 0), (0, 0)))
+    # [4,4,C,O] -> [2,2,2,2,C,O] -> [2,2,2,2,C,O] (subpixel-major) -> [2,2,4C,O]
+    k = k4.reshape(2, 2, 2, 2, c, o).transpose(0, 2, 1, 3, 4, 5)
+    return k.reshape(2, 2, 4 * c, o)
+
+
 class InceptionA(nn.Module):
     pool_features: int
     dtype: Any = jnp.bfloat16
@@ -129,14 +143,37 @@ class InceptionE(nn.Module):
 
 
 class InceptionV3(nn.Module):
+    """``stem``: ``"conv3"`` is the canonical 3x3/s2 VALID convolution;
+    ``"space_to_depth"`` reshapes the 299px image to [150,150,12] (one
+    zero pad row/col) and trains the mathematically equivalent 2x2/s1
+    VALID kernel (exactness: :func:`conv3_kernel_to_s2d`) — the ResNet
+    stem treatment applied to the 32-channel Inception stem the r4
+    profile names as >10% of the step (docs/benchmarks.md). Checkpoints
+    are not interchangeable between stems."""
+
     num_classes: int = 1000
     dtype: Any = jnp.bfloat16
+    stem: str = "conv3"
 
     @nn.compact
     def __call__(self, x, train: bool = True):
+        from horovod_tpu.models.resnet import space_to_depth_2x2
+
         c = partial(ConvBN, dtype=self.dtype)
         x = jnp.asarray(x, self.dtype)
-        x = c(32, (3, 3), (2, 2), "VALID")(x, train)
+        if self.stem not in ("conv3", "space_to_depth"):
+            raise ValueError(f"unknown stem {self.stem!r}; expected "
+                             "'conv3' or 'space_to_depth'")
+        if self.stem == "space_to_depth":
+            # 299 is odd: one zero row/col pad reaches the 2x2 macro
+            # grid; the padded taps are exactly the zero-padded 4th
+            # kernel row/col of conv3_kernel_to_s2d.
+            n, h, w, _ = x.shape
+            x = jnp.pad(x, ((0, 0), (0, h % 2), (0, w % 2), (0, 0)))
+            x = space_to_depth_2x2(x)
+            x = c(32, (2, 2), (1, 1), "VALID")(x, train)
+        else:
+            x = c(32, (3, 3), (2, 2), "VALID")(x, train)
         x = c(32, (3, 3), padding="VALID")(x, train)
         x = c(64, (3, 3))(x, train)
         x = nn.max_pool(x, (3, 3), strides=(2, 2))

@@ -191,6 +191,87 @@ def test_space_to_depth_stem_is_exact_reparameterization():
                                rtol=1e-5, atol=1e-5)
 
 
+def test_resnet_remat_policies_bit_exact():
+    """Both traffic-removal remat policies (measured NEGATIVE on chip,
+    docs/benchmarks.md r5 — kept as opt-ins) are BIT-exact against
+    stock autodiff: the recompute is the same deterministic function of
+    the same saved values."""
+    from functools import partial
+
+    from horovod_tpu.models.resnet import (BottleneckBlock, ResNet,
+                                           act_drop_policy,
+                                           conv_saves_policy)
+
+    m = ResNet(stage_sizes=[1, 1], block_cls=BottleneckBlock,
+               num_classes=10, num_filters=8, dtype=jnp.float32)
+    x = jnp.asarray(np.random.RandomState(0).randn(2, 32, 32, 3),
+                    jnp.float32)
+    v = m.init(jax.random.PRNGKey(0), x, False)
+
+    def loss(params, bs):
+        out, mut = m.apply({"params": params, "batch_stats": bs}, x,
+                           True, mutable=["batch_stats"])
+        return out.sum(), mut["batch_stats"]
+
+    import jax.tree_util as jtu
+
+    (l1, bs1), g1 = jax.value_and_grad(loss, has_aux=True)(
+        v["params"], v["batch_stats"])
+    for policy in (act_drop_policy(), conv_saves_policy()):
+        (l2, bs2), g2 = jax.value_and_grad(
+            jax.checkpoint(loss, policy=policy), has_aux=True)(
+            v["params"], v["batch_stats"])
+        assert float(l1) == float(l2)
+        gd = jtu.tree_map(lambda a, b: float(jnp.abs(a - b).max()), g1, g2)
+        assert max(jtu.tree_leaves(gd)) == 0.0
+        bd = jtu.tree_map(lambda a, b: float(jnp.abs(a - b).max()),
+                          bs1, bs2)
+        assert max(jtu.tree_leaves(bd)) == 0.0
+
+
+def test_inception_s2d_stem_is_exact_reparameterization():
+    """The Inception stem's 3x3/s2 'VALID' conv computes EXACTLY as the
+    2x2/s1 conv over space-to-depth input when the kernel is derived
+    via conv3_kernel_to_s2d — the ResNet stem transform applied to the
+    32-channel Inception stem (odd input sizes take one zero pad
+    row/col, matching the mapped kernel's zero 4th taps)."""
+    from jax import lax
+
+    from horovod_tpu.models.inception import conv3_kernel_to_s2d
+    from horovod_tpu.models.resnet import space_to_depth_2x2
+
+    rng = jax.random.PRNGKey(1)
+    k1, k2 = jax.random.split(rng)
+    # Odd spatial size, like the real 299px input.
+    x = jax.random.normal(k1, (2, 15, 15, 3), jnp.float32)
+    k3 = jax.random.normal(k2, (3, 3, 3, 8), jnp.float32)
+
+    dn = ("NHWC", "HWIO", "NHWC")
+    y_ref = lax.conv_general_dilated(
+        x, k3, window_strides=(2, 2), padding="VALID",
+        dimension_numbers=dn)
+    xp = jnp.pad(x, ((0, 0), (0, 1), (0, 1), (0, 0)))
+    y_s2d = lax.conv_general_dilated(
+        space_to_depth_2x2(xp), conv3_kernel_to_s2d(k3),
+        window_strides=(1, 1), padding="VALID",
+        dimension_numbers=dn)
+    assert y_s2d.shape == y_ref.shape == (2, 7, 7, 8)
+    np.testing.assert_allclose(np.asarray(y_s2d), np.asarray(y_ref),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_inception_s2d_stem_trains():
+    m = models.get_model("inceptionv3", num_classes=10,
+                         dtype=jnp.float32, stem="space_to_depth")
+    x = jnp.ones((1, 75, 75, 3), jnp.float32)
+    v = m.init(jax.random.PRNGKey(0), x, False)
+    out = m.apply(v, x, False)
+    assert out.shape == (1, 10)
+    with pytest.raises(ValueError):
+        models.get_model("inceptionv3", stem="bogus").init(
+            jax.random.PRNGKey(0), x, False)
+
+
 def test_resnet_space_to_depth_stem_trains():
     m = models.get_model("resnet18", num_classes=10, dtype=jnp.float32,
                          stem="space_to_depth")
