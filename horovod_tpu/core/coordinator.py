@@ -407,10 +407,15 @@ class Coordinator:
             deadline = time.monotonic() + self.timeout_s
             if digest:
                 # Digest readers outlast p0's own (whole-gather) deadline
-                # by a grace margin so p0's error digest — which carries
-                # the TRUE straggler attribution — arrives before this
-                # reader gives up and can only blame p0.
-                deadline += 2 * _POLL_SLICE_S
+                # so p0's error digest — which carries the TRUE straggler
+                # attribution — arrives before this reader gives up and
+                # can only blame p0. p0's deadline starts at p0's OWN
+                # round entry, which may lag this reader's by up to a full
+                # timeout while a third peer stalls p0's gather (r4
+                # advisor), hence a whole extra timeout_s of grace, not
+                # just poll slack; a DEAD p0 is still caught within one
+                # poll slice by the tombstone check below.
+                deadline += self.timeout_s + 2 * _POLL_SLICE_S
         self.waiting_on = peer
         try:
             while True:
@@ -437,6 +442,23 @@ class Coordinator:
                 except KVTimeout:
                     if self.kv.try_get(self._tomb_key(peer)) is not None:
                         raise PeerShutdown(peer) from None
+                    # Mixed-mode fail-fast (r4 advisor): a world where
+                    # HVD_NEGOTIATION_AGGREGATE differs across processes
+                    # deadlocks silently — each side waits on a key the
+                    # other mode never writes. The OTHER mode's key
+                    # appearing while ours does not is the signature;
+                    # surface the misconfiguration instead of hanging.
+                    other = (self._round_key(rnd, 0) if digest
+                             else (self._digest_key(rnd) if peer == 0
+                                   else None))
+                    if other and self.kv.try_get(other) is not None:
+                        raise KVError(
+                            "HVD_NEGOTIATION_AGGREGATE mismatch: process "
+                            f"0 is running {'symmetric' if digest else 'gather-tree'} "
+                            "rounds while this process expects "
+                            f"{'gather-tree' if digest else 'symmetric'} — "
+                            "set HVD_NEGOTIATION_AGGREGATE identically on "
+                            "every process") from None
         finally:
             self.waiting_on = None
 

@@ -67,6 +67,9 @@ class _Entry:
     root_rank: int = 0
     prescale: float = 1.0
     enqueued_at: float = field(default_factory=time.monotonic)
+    # Processes whose announcement of this tensor has been marked on the
+    # timeline (RANK_READY instants inside the NEGOTIATE_* span).
+    ready_marked: set = field(default_factory=set)
 
 
 class _Handle:
@@ -491,6 +494,16 @@ class Engine:
         if decision.fusion_threshold is not None:
             self.fusion_threshold = decision.fusion_threshold
         self._extra_wait = decision.idle_backoff_s
+        if self.timeline.enabled and c.last_tables:
+            # Per-process readiness instants inside the NEGOTIATE_* span
+            # (reference: timeline.cc:106-130) — the trace names who was
+            # late, not just that negotiation was long.
+            for e in self._negotiating:
+                for p, names in c.last_tables.items():
+                    if p not in e.ready_marked and e.name in names:
+                        e.ready_marked.add(p)
+                        self.timeline.instant(e.name, tl.RANK_READY,
+                                              {"process": p})
         done = set()
         executed_bytes = 0
         for g in decision.groups:

@@ -130,6 +130,8 @@ def load_library():
     lib.hvd_engine_drop.argtypes = [ctypes.c_void_p, ctypes.c_longlong]
     lib.hvd_engine_pending.restype = ctypes.c_longlong
     lib.hvd_engine_pending.argtypes = [ctypes.c_void_p]
+    lib.hvd_engine_timeline_instant.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.c_char_p, ctypes.c_char_p]
     lib.hvd_engine_shutdown.argtypes = [ctypes.c_void_p]
     lib.hvd_engine_join.argtypes = [ctypes.c_void_p]
     lib.hvd_engine_destroy.argtypes = [ctypes.c_void_p]

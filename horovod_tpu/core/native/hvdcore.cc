@@ -188,6 +188,14 @@ class Timeline {
     return active_ ? (long long)(SecondsSince(start_) * 1e6) : 0;
   }
 
+  // Zero-duration mark on the tensor's lane (chrome 'i' event) — e.g.
+  // RANK_READY instants inside a NEGOTIATE_* span (reference: the
+  // per-rank readiness events of timeline.cc:106-130).
+  void Instant(const std::string& name, const char* phase,
+               const std::string& args = "") {
+    Emit(name, phase, 'i', args, -1);
+  }
+
   void Close() {
     std::lock_guard<std::mutex> g(mu_);
     if (!active_) return;
@@ -226,6 +234,7 @@ class Timeline {
     Sep();
     file_ << "{\"name\":\"" << phase << "\",\"ph\":\"" << ph
           << "\",\"pid\":" << pid << ",\"ts\":" << ts;
+    if (ph == 'i') file_ << ",\"s\":\"p\"";  // instant scope: process
     if (!args.empty()) file_ << ",\"args\":{" << args << "}";
     file_ << "}";
     // 1 s flush horizon like the reference (timeline.h:32).
@@ -476,6 +485,14 @@ class Engine {
     if (loop_.joinable()) loop_.join();
     if (watchdog_.joinable()) watchdog_.join();
     timeline_.Close();  // workers joined: no further Emit is possible
+  }
+
+  // External instant mark (the python negotiator trampoline emits
+  // RANK_READY marks here — the negotiation tables live python-side).
+  void TimelineInstant(const char* name, const char* phase,
+                       const char* args) {
+    if (timeline_.Active())
+      timeline_.Instant(name, phase, args ? args : "");
   }
 
  private:
@@ -1014,6 +1031,11 @@ void hvd_engine_drop(void* e, long long handle) {
 
 long long hvd_engine_pending(void* e) {
   return static_cast<Engine*>(e)->PendingCount();
+}
+
+void hvd_engine_timeline_instant(void* e, const char* name,
+                                 const char* phase, const char* args) {
+  static_cast<Engine*>(e)->TimelineInstant(name, phase, args);
 }
 
 void hvd_engine_shutdown(void* e) { static_cast<Engine*>(e)->Shutdown(); }

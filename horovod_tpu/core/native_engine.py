@@ -84,6 +84,32 @@ def _make_negotiator(engine):
                 for r in rows
             ]
             decision = c.negotiate(metas)
+            if engine._timeline_on and c.last_tables:
+                # Per-process readiness instants inside the NEGOTIATE_*
+                # span (reference: timeline.cc:106-130): the C++ writer
+                # owns the file, the tables live here — mark through the
+                # engine's instant hook.
+                seen = engine._ready_marked
+                live = {m.name for m in metas}
+                for stale in [n for n in seen if n not in live]:
+                    del seen[stale]
+                for m in metas:
+                    marked = seen.setdefault(m.name, set())
+                    for p, names in c.last_tables.items():
+                        if p not in marked and m.name in names:
+                            marked.add(p)
+                            lib.hvd_engine_timeline_instant(
+                                engine._ptr, m.name.encode(),
+                                tl.RANK_READY.encode(),
+                                f'"process":{p}'.encode())
+                # A name's seen-set lives exactly as long as its pending
+                # instance: recurring tensors (per-step gradients) are
+                # re-submitted before an empty round could prune them,
+                # so clear at execution — the python twin's per-_Entry
+                # lifetime, same observable semantics.
+                for g in decision.groups:
+                    for i in g.indices:
+                        seen.pop(metas[i].name, None)
             lines = [f"p {decision.cycle_time_s} "
                      f"{decision.fusion_threshold}"]
             if decision.idle_backoff_s:
@@ -181,6 +207,8 @@ class NativeEngine:
 
         self._lib = native.load_library()
         self._executor = executor or JaxExecutor()
+        self._timeline_on = bool(timeline_path)
+        self._ready_marked: dict = {}  # name -> processes marked RANK_READY
         if timeline_path:
             # Staging time feeds the WAIT_FOR_DATA spans; only measured
             # (it costs a device sync) while a timeline is recording.

@@ -22,6 +22,10 @@ ALLREDUCE = "ALLREDUCE"
 ALLGATHER = "ALLGATHER"
 BROADCAST = "BROADCAST"
 MEMCPY_OUT_FUSION_BUFFER = "MEMCPY_OUT_FUSION_BUFFER"
+# Instant mark inside a NEGOTIATE_* span: process N announced the tensor
+# (reference: the per-rank readiness events timeline.cc:106-130 records
+# while a tensor is NEGOTIATING — the trace then shows who was late).
+RANK_READY = "RANK_READY"
 
 _FLUSH_INTERVAL_S = 1.0  # reference: timeline.h:32
 
@@ -80,6 +84,8 @@ class Timeline:
                 return
             ev = {"name": activity, "ph": phase, "pid": self._pid(tensor),
                   "ts": self._ts_us() if ts_us is None else ts_us}
+            if phase == "i":
+                ev["s"] = "p"  # instant scope: process
             if args:
                 ev["args"] = args
             self._emit(ev)
@@ -91,6 +97,12 @@ class Timeline:
     def end(self, tensor: str, activity: str, args: Optional[dict] = None,
             ts_us: Optional[int] = None):
         self._event("E", tensor, activity, args, ts_us)
+
+    def instant(self, tensor: str, activity: str,
+                args: Optional[dict] = None):
+        """Zero-duration mark on the tensor's lane (chrome 'i' event) —
+        e.g. RANK_READY instants inside a NEGOTIATE_* span."""
+        self._event("i", tensor, activity, args)
 
     def close(self):
         if not self.enabled:

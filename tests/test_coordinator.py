@@ -10,6 +10,7 @@ processes that have not submitted."""
 
 import logging
 import threading
+import time
 
 import pytest
 
@@ -17,6 +18,7 @@ from horovod_tpu.core.coordinator import (
     Coordinator,
     Decision,
     Group,
+    KVError,
     LocalKV,
     NegotiationTimeout,
     PeerShutdown,
@@ -388,3 +390,38 @@ class TestAggregatedRounds:
         assert isinstance(errors.get(0), NegotiationTimeout)
         assert errors[0].process == 2
         assert "process 2" in str(errors.get(1)), errors
+
+    def test_mixed_mode_fails_fast(self):
+        """HVD_NEGOTIATION_AGGREGATE set on only SOME processes used to
+        deadlock until the full negotiation timeout — each side waits on
+        a key the other mode never writes. The mismatch must be named
+        within a poll slice instead (r4 advisor)."""
+        for agg0 in (False, True):
+            store = {}
+            errors = {}
+
+            def worker(pid, agg):
+                c = Coordinator(LocalKV(store), 2, pid, 0.005, 0,
+                                timeout_s=8.0)
+                c.aggregate = agg  # env is process-global; set directly
+                try:
+                    c.negotiate([meta("x")])
+                except Exception as exc:
+                    errors[pid] = exc
+
+            t0 = time.monotonic()
+            threads = [
+                threading.Thread(target=worker, args=(0, agg0)),
+                threading.Thread(target=worker, args=(1, not agg0)),
+            ]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=30)
+            elapsed = time.monotonic() - t0
+            mismatches = [e for e in errors.values()
+                          if isinstance(e, KVError)
+                          and "AGGREGATE mismatch" in str(e)]
+            assert mismatches, (agg0, errors)
+            # Fail-FAST: well under the 8 s negotiation timeout.
+            assert elapsed < 6.0, elapsed
