@@ -25,6 +25,7 @@ from horovod_tpu.common.topology import (  # noqa: F401
     rank,
     local_size,
     local_rank,
+    local_num_processes,
     cross_size,
     cross_rank,
     num_processes,

@@ -17,8 +17,11 @@ MPI_COMM_WORLD      1-D ``Mesh(jax.devices(), ('hvd',))``
 rank                global id of this process's first device (device-level
                     rank inside SPMD code comes from ``lax.axis_index``)
 size                total number of chips in the mesh
-local_comm          this process's ``jax.local_devices()``
-cross_comm          one representative chip per process (DCN tier)
+local_comm          this process's ``jax.local_devices()``; co-hosted
+                    controllers are split by ``local_rank()`` (hostname
+                    exchange at init — the shared-memory split)
+cross_comm          one representative per host (DCN tier):
+                    ``cross_rank()``/``cross_size()`` enumerate hosts
 ==================  ==========================================================
 
 Single-controller SPMD means one Python process may *speak for* several ranks
@@ -67,8 +70,10 @@ class _Topology:
         self.size = 0
         self.rank0 = 0  # global rank of this process's first local device
         self.local_size = 0
+        self.local_rank = 0  # this controller's index among co-hosted ones
         self.cross_size = 0
         self.cross_rank = 0
+        self.host_num_processes = 1  # controllers sharing this host
         self.num_processes = 1
         self.process_index = 0
         self.homogeneous = True
@@ -134,6 +139,65 @@ def _build_two_tier(devices: Sequence):
         for c, d in enumerate(row):
             arr[r, c] = d
     return Mesh(arr, ("dcn", "ici"))
+
+
+def _host_split(num_processes: int, process_index: int,
+                timeout_s: float = 60.0):
+    """Shared-host split (reference: the MPI_Comm_split_type(SHARED) local
+    communicator + the cross split, operations.cc:1668-1705): every
+    process publishes its hostname to the coordination service and reads
+    its peers', yielding which controllers share a physical host.
+
+    Returns ``(local_rank, host_num_processes, cross_rank, cross_size)``
+    — controller index among co-hosted controllers, how many controllers
+    share this host, this host's index, and the number of distinct hosts
+    — or ``None`` when no coordination service is reachable (callers
+    degrade to the one-controller-per-host view).
+
+    ``HVD_HOSTNAME`` overrides the reported hostname — the simulation
+    knob for exercising multi-host layouts on one machine (the same role
+    mpirun's hostfile plays for the reference)."""
+    import json as _json
+    import socket
+
+    host = os.environ.get("HVD_HOSTNAME") or socket.gethostname()
+    if num_processes == 1:
+        return 0, 1, 0, 1
+    from horovod_tpu.core import coordinator as coord
+
+    try:
+        kv = coord.JaxKV()
+    except Exception:
+        # No coordination service is a PROPERTY OF THE WORLD (the jax
+        # distributed client is either up everywhere or nowhere), so the
+        # one-controller-per-host fallback stays consistent across it.
+        return None
+    try:
+        key = f"hvd/host/p{process_index}"
+        # The KV store forbids overwrites; a re-init (shutdown → init)
+        # finds this process's own key already present with the same
+        # value — only write when absent.
+        if kv.try_get(key) is None:
+            kv.set(key, _json.dumps(host))
+        deadline = coord.negotiation_timeout_s()
+        peers = [_json.loads(kv.get(f"hvd/host/p{p}", deadline))
+                 for p in range(num_processes)]
+    except Exception as exc:
+        # The service exists but a peer's hostname never arrived: a
+        # silent per-process fallback here would leave the world
+        # DISAGREEING on cross_size/local_rank ownership — fail loudly
+        # instead (the same contract negotiation rounds have).
+        raise HorovodInternalError(
+            f"shared-host split failed: could not exchange hostnames "
+            f"with all {num_processes} processes ({exc}); a peer may "
+            "not have reached hvd.init()") from None
+    by_host: dict = {}
+    for p, h in enumerate(peers):
+        by_host.setdefault(h, []).append(p)
+    hosts = sorted(by_host, key=lambda h: by_host[h][0])  # first-pid order
+    mine = by_host[host]
+    return (mine.index(process_index), len(mine),
+            hosts.index(host), len(hosts))
 
 
 def init(ranks: Optional[Sequence[int]] = None, devices: Optional[Sequence] = None):
@@ -203,8 +267,18 @@ def init(ranks: Optional[Sequence[int]] = None, devices: Optional[Sequence] = No
         # Global rank of the first local device: devices are mesh-ordered, so
         # this is its index in the world list.
         _state.rank0 = _state.devices.index(local[0])
-        _state.cross_size = _state.num_processes
-        _state.cross_rank = _state.process_index
+        # Shared-host split (reference: operations.cc:1668-1705). Without
+        # a coordination service (or single-process) every controller is
+        # assumed to own its host — the previous fixed behavior.
+        split = _host_split(jax.process_count(), jax.process_index())
+        if split is None:
+            _state.local_rank = 0
+            _state.host_num_processes = 1
+            _state.cross_rank = jax.process_index()
+            _state.cross_size = jax.process_count()
+        else:
+            (_state.local_rank, _state.host_num_processes,
+             _state.cross_rank, _state.cross_size) = split
         counts = {}
         for d in devices:
             counts[d.process_index] = counts.get(d.process_index, 0) + 1
@@ -294,22 +368,37 @@ def rank() -> int:
 
 
 def local_size() -> int:
+    """Number of chips THIS CONTROLLER drives (the mapping table above:
+    local_comm = this process's ``jax.local_devices()``) — the
+    per-process sizing knob examples use for their local batch."""
     return _require_init().local_size
 
 
 def local_rank() -> int:
-    """Rank within this host's chips for host-side code. A single controller
-    process speaks for all its local chips, so this is always 0 (the
-    per-chip value exists only inside SPMD programs)."""
-    _require_init()
-    return 0
+    """This controller's index among the controllers sharing its host
+    (reference: the shared-memory-split local rank,
+    operations.cc:1668-1705) — the owner key for per-host resources
+    (cache dirs, log files, host-level data shards; see
+    docs/running.md). 0 for the usual one controller per host; with two
+    controllers on one machine they see 0 and 1."""
+    return _require_init().local_rank
+
+
+def local_num_processes() -> int:
+    """Number of controller processes sharing this host."""
+    return _require_init().host_num_processes
 
 
 def cross_size() -> int:
+    """Number of distinct hosts in the world (one controller per host —
+    the common TPU layout — makes this equal to ``num_processes()``)."""
     return _require_init().cross_size
 
 
 def cross_rank() -> int:
+    """This host's index among the world's hosts. For a per-process id
+    that is unique even with several controllers on one host, use
+    :func:`process_index`."""
     return _require_init().cross_rank
 
 

@@ -13,5 +13,5 @@ assert hvd.size() == 8, hvd.size()
 
 out = np.asarray(hvd.allreduce(jnp.ones((2,)), average=False))
 np.testing.assert_allclose(out, np.full((2,), 8.0))
-print(f"rank {hvd.rank()} (proc {hvd.cross_rank()}): LAUNCHER TEST PASSED",
+print(f"rank {hvd.rank()} (proc {hvd.process_index()}): LAUNCHER TEST PASSED",
       flush=True)

@@ -115,7 +115,7 @@ def test_latest_checkpoint(hvd, tmp_path):
     # not probe the empty-dir case on it — process 0 may already have
     # saved by the time they look.
     shared = hvd_jax.broadcast_object(str(tmp_path))
-    if hvd.cross_rank() == 0:
+    if hvd.process_index() == 0:
         assert latest_checkpoint(shared) is None
     save_checkpoint(shared, {"a": np.zeros(2)}, step=1)
     save_checkpoint(shared, {"a": np.ones(2)}, step=10)
