@@ -141,8 +141,7 @@ def _build_two_tier(devices: Sequence):
     return Mesh(arr, ("dcn", "ici"))
 
 
-def _host_split(num_processes: int, process_index: int,
-                timeout_s: float = 60.0):
+def _host_split(num_processes: int, process_index: int):
     """Shared-host split (reference: the MPI_Comm_split_type(SHARED) local
     communicator + the cross split, operations.cc:1668-1705): every
     process publishes its hostname to the coordination service and reads
@@ -176,12 +175,19 @@ def _host_split(num_processes: int, process_index: int,
         key = f"hvd/host/p{process_index}"
         # The KV store forbids overwrites; a re-init (shutdown → init)
         # finds this process's own key already present with the same
-        # value — only write when absent.
-        if kv.try_get(key) is None:
+        # value. A DIFFERENT stale value (a changed HVD_HOSTNAME across
+        # incarnations) must be replaced, not trusted.
+        existing = kv.try_get(key)
+        if existing is not None and _json.loads(existing) != host:
+            kv.delete(key)
+            existing = None
+        if existing is None:
             kv.set(key, _json.dumps(host))
         deadline = coord.negotiation_timeout_s()
         peers = [_json.loads(kv.get(f"hvd/host/p{p}", deadline))
                  for p in range(num_processes)]
+        if peers[process_index] != host:  # delete/set above failed
+            raise KeyError("own hostname key is stale")
     except Exception as exc:
         # The service exists but a peer's hostname never arrived: a
         # silent per-process fallback here would leave the world

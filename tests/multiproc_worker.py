@@ -529,7 +529,11 @@ def main():
         # compounding signature so a slow baseline can never mask the
         # regression this test exists to catch. The floor keeps the
         # bound positive for small worlds/caps (nproc=2, cap=1 would
-        # otherwise make it 0 and auto-fail — r4 advisor).
+        # otherwise make it 0 and auto-fail — r4 advisor); NOTE at such
+        # tiny worlds the floor sits ABOVE the compounding cost, so the
+        # scenario only detects compounding for nproc*cap large enough
+        # that (nproc-1)*cap - 1 > cap + 1 (the np=4/cap=4 config run
+        # by test_multiprocess.py qualifies).
         bound = max(cap + 1.0,
                     min(cap + 3.0 + 2 * baseline, (nproc - 1) * cap - 1.0))
         # Two unconditional attempts (collectives must stay collective —

@@ -69,10 +69,24 @@ _CASES = {
 _TIMEOUTS = {"keras_imagenet_resnet50.py": 900,
              "pytorch_imagenet_resnet50.py": 600}
 
+# Opt-in tier (HVD_SLOW_TESTS=1): the two imagenet scripts cost ~7 min
+# of XLA:CPU ResNet-50 compile/engine time — measured as the default
+# suite's single biggest slice — while their training cores (Trainer
+# pipeline, torch engine loop) are exercised every run by the frontend
+# suites and the mnist variants. The scripts still smoke end-to-end
+# whenever the slow tier is enabled (CI nightly / pre-release).
+_SLOW = {"keras_imagenet_resnet50.py", "pytorch_imagenet_resnet50.py"}
+
 
 @pytest.mark.parametrize("case", sorted(_CASES), ids=lambda s: s)
 def test_example_runs(case):
     script = case.split()[0]  # keys may carry a variant suffix for ids
+    slow_on = (os.environ.get("HVD_SLOW_TESTS", "").lower()
+               not in ("", "0", "false", "off"))
+    if script in _SLOW and not slow_on:
+        pytest.skip("multi-minute XLA:CPU ResNet-50 case; set "
+                    "HVD_SLOW_TESTS=1 to run (core paths covered by the "
+                    "frontend suites)")
     env = dict(os.environ)
     # Force the virtual CPU mesh. JAX_PLATFORMS alone is NOT enough: the
     # TPU-plugin site dir on PYTHONPATH pre-imports jax and preempts the

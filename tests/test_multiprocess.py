@@ -196,7 +196,7 @@ def test_four_process_host_split():
     processes/cross_rank/cross_size derive from the shared-host split
     (reference: the MPI shared-memory + cross communicator split,
     operations.cc:1668-1705)."""
-    _run_world("host_split", nproc=4)
+    _run_world("host_split", nproc=4, extra_env=_NP4)
 
 
 def test_four_process_collectives():
