@@ -141,6 +141,11 @@ def _build_two_tier(devices: Sequence):
     return Mesh(arr, ("dcn", "ici"))
 
 
+# Incremented once per init() that performs the exchange; identical on
+# every process because engine/topology lifecycle is collective.
+_host_split_generation = 0
+
+
 def _host_split(num_processes: int, process_index: int):
     """Shared-host split (reference: the MPI_Comm_split_type(SHARED) local
     communicator + the cross split, operations.cc:1668-1705): every
@@ -171,23 +176,21 @@ def _host_split(num_processes: int, process_index: int):
         # distributed client is either up everywhere or nowhere), so the
         # one-controller-per-host fallback stays consistent across it.
         return None
+    global _host_split_generation
+    gen = _host_split_generation
+    _host_split_generation += 1
     try:
-        key = f"hvd/host/p{process_index}"
-        # The KV store forbids overwrites; a re-init (shutdown → init)
-        # finds this process's own key already present with the same
-        # value. A DIFFERENT stale value (a changed HVD_HOSTNAME across
-        # incarnations) must be replaced, not trusted.
-        existing = kv.try_get(key)
-        if existing is not None and _json.loads(existing) != host:
-            kv.delete(key)
-            existing = None
-        if existing is None:
-            kv.set(key, _json.dumps(host))
+        # Generation-suffixed keys: every incarnation (init/shutdown
+        # cycles are COLLECTIVE across processes, the MPI_Init contract)
+        # writes and reads a FRESH namespace, so a re-init can never
+        # read a peer's stale hostname from a previous incarnation —
+        # and the store's no-overwrite rule is never hit. The handful
+        # of small leaked keys per generation matches the coordinator's
+        # own per-generation round namespacing.
+        kv.set(f"hvd/host/g{gen}/p{process_index}", _json.dumps(host))
         deadline = coord.negotiation_timeout_s()
-        peers = [_json.loads(kv.get(f"hvd/host/p{p}", deadline))
+        peers = [_json.loads(kv.get(f"hvd/host/g{gen}/p{p}", deadline))
                  for p in range(num_processes)]
-        if peers[process_index] != host:  # delete/set above failed
-            raise KeyError("own hostname key is stale")
     except Exception as exc:
         # The service exists but a peer's hostname never arrived: a
         # silent per-process fallback here would leave the world
