@@ -141,11 +141,6 @@ def _build_two_tier(devices: Sequence):
     return Mesh(arr, ("dcn", "ici"))
 
 
-# Incremented once per init() that performs the exchange; identical on
-# every process because engine/topology lifecycle is collective.
-_host_split_generation = 0
-
-
 def _host_split(num_processes: int, process_index: int):
     """Shared-host split (reference: the MPI_Comm_split_type(SHARED) local
     communicator + the cross split, operations.cc:1668-1705): every
@@ -176,21 +171,28 @@ def _host_split(num_processes: int, process_index: int):
         # distributed client is either up everywhere or nowhere), so the
         # one-controller-per-host fallback stays consistent across it.
         return None
-    global _host_split_generation
-    gen = _host_split_generation
-    _host_split_generation += 1
     try:
-        # Generation-suffixed keys: every incarnation (init/shutdown
-        # cycles are COLLECTIVE across processes, the MPI_Init contract)
-        # writes and reads a FRESH namespace, so a re-init can never
-        # read a peer's stale hostname from a previous incarnation —
-        # and the store's no-overwrite rule is never hit. The handful
-        # of small leaked keys per generation matches the coordinator's
-        # own per-generation round namespacing.
-        kv.set(f"hvd/host/g{gen}/p{process_index}", _json.dumps(host))
+        # Stable (generation-free) keys so a FAILED init converges on
+        # retry: a straggler that missed the first attempt still finds
+        # and completes the same exchange (a local generation counter
+        # would desync retriers from the straggler forever). The write
+        # is idempotent — skipped when the key already holds this
+        # hostname (the store forbids overwrites); a DIFFERENT stale
+        # value (changed HVD_HOSTNAME across incarnations) is replaced,
+        # which is safe because init is collective: peers re-enter the
+        # exchange together rather than racing a half-replaced key.
+        key = f"hvd/host/p{process_index}"
+        existing = kv.try_get(key)
+        if existing is not None and _json.loads(existing) != host:
+            kv.delete(key)
+            existing = None
+        if existing is None:
+            kv.set(key, _json.dumps(host))
         deadline = coord.negotiation_timeout_s()
-        peers = [_json.loads(kv.get(f"hvd/host/g{gen}/p{p}", deadline))
+        peers = [_json.loads(kv.get(f"hvd/host/p{p}", deadline))
                  for p in range(num_processes)]
+        if peers[process_index] != host:  # own delete/set failed
+            raise KeyError("own hostname key is stale")
     except Exception as exc:
         # The service exists but a peer's hostname never arrived: a
         # silent per-process fallback here would leave the world

@@ -49,3 +49,31 @@ def broadcast_parameters(params, root_rank: int = 0):
 def broadcast_state(state, root_rank: int = 0):
     """Broadcast ``hk.State`` (batch norm statistics etc.)."""
     return broadcast_pytree(state, root_rank=root_rank)
+
+
+def average_state(state):
+    """Average ``hk.State`` across ranks — batch-norm statistics are
+    per-replica during training (never allreduced, matching the
+    reference's BN semantics); average them once before evaluation or
+    checkpointing so every rank scores the same model.
+
+    The mean is computed INSIDE the mesh (psum over the hvd axis):
+    per-chip statistics live in arrays whose sharding claims
+    replication while chips disagree, so any host-side fetch would read
+    ONE chip's values and silently discard the rest. Counters and other
+    integer state are averaged in float and cast back."""
+    import jax.numpy as jnp
+    import jax.tree_util as jtu
+    from jax.sharding import PartitionSpec as P
+
+    from horovod_tpu import jax as hvd_jax
+
+    @hvd_jax.jit(in_specs=(P(),), out_specs=P())
+    def avg(tree):
+        return jtu.tree_map(
+            lambda l: allreduce(jnp.asarray(l, jnp.float32),
+                                average=True).astype(
+                                    jnp.asarray(l).dtype),
+            tree)
+
+    return avg(state)

@@ -20,6 +20,7 @@ _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 _CASES = {
     "jax_mnist.py": ["--epochs", "1", "--batch-size", "16", "--synthetic"],
+    "haiku_mnist.py": ["--epochs", "1", "--batch-size", "16"],
     "pytorch_mnist.py": ["--epochs", "1", "--batch-size", "64"],
     "keras_mnist.py": ["--epochs", "1", "--batch-size", "16"],
     "jax_word2vec.py": ["--steps", "30", "--batch-size", "64"],

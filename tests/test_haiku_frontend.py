@@ -50,3 +50,34 @@ def test_haiku_state_broadcast(hvd):
     state = {"bn": {"mean": jnp.ones((4,)), "var": jnp.zeros((4,))}}
     out = hvd_hk.broadcast_state(state)
     np.testing.assert_allclose(out["bn"]["mean"], np.ones(4))
+
+
+def test_haiku_average_state(hvd):
+    """average_state must compute the TRUE cross-chip mean of
+    per-replica BN statistics: the training arrays claim replication
+    while chips disagree (check_vma=False), so a host-side fetch would
+    silently read one chip's values — construct exactly that divergent
+    state and require the real average (plus integer dtype round-trip)."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    mesh = hvd.mesh()
+
+    def divergent(per_chip_value):
+        shards = [jax.device_put(np.asarray(per_chip_value(i)), d)
+                  for i, d in enumerate(mesh.devices.flat)]
+        return jax.make_array_from_single_device_arrays(
+            shards[0].shape, NamedSharding(mesh, P()), shards)
+
+    n = hvd.size()
+    state = {"bn": {
+        "mean": divergent(lambda i: np.full((3,), float(i), np.float32)),
+        "counter": divergent(lambda i: np.asarray([10 * i], np.int32)),
+    }}
+    out = hvd_hk.average_state(state)
+    expect = (n - 1) / 2.0  # mean of 0..n-1
+    np.testing.assert_allclose(np.asarray(out["bn"]["mean"]),
+                               np.full(3, expect), rtol=1e-6)
+    assert np.asarray(out["bn"]["counter"]).dtype == np.int32
+    np.testing.assert_array_equal(np.asarray(out["bn"]["counter"]),
+                                  [int(10 * expect)])

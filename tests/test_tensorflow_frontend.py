@@ -307,6 +307,20 @@ def test_load_model_wraps_plain_saved_optimizer(tmp_path):
     assert type(loaded.optimizer).__name__ == "Adam"
 
 
+def test_user_supplied_names_are_stable_keys():
+    """A user-supplied name is the engine matching key, carried in its
+    own namespace so a numeric name can never collide with an unnamed
+    op's auto counter; results stay correct across repeated use."""
+    x = tf.constant([1.0, 2.0])
+    for _ in range(2):  # same name reused sequentially = the per-step
+        out = hvd_tf.allreduce(x, average=False, name="0")
+        np.testing.assert_allclose(out.numpy(), [8.0, 16.0])
+    g = hvd_tf.allgather(tf.constant([[3.0]]), name="rows")
+    assert g.shape[0] == 8
+    b = hvd_tf.broadcast(tf.constant([7.0]), root_rank=0, name="b0")
+    np.testing.assert_allclose(b.numpy(), [7.0])
+
+
 def test_bridge_names_scoped_per_graph():
     """Sequence counters are scoped to the graph under construction, so
     a RE-trace rebuilds the same engine names instead of marching a
