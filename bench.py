@@ -43,19 +43,23 @@ def main():
                    help="per-chip batch size (reference default 32)")
     p.add_argument("--image-size", type=int, default=224)
     p.add_argument("--num-warmup-batches", type=int, default=150)
-    p.add_argument("--num-batches-per-iter", type=int, default=200,
+    p.add_argument("--num-batches-per-iter", type=int, default=800,
                    help="batches per timed window; each window ends in one "
-                        "device->host fetch, so enough batches are needed "
-                        "to amortize the fetch round-trip (~90 ms on the "
-                        "tunneled platform) below the noise floor")
+                        "device->host fetch (the honesty barrier), so the "
+                        "window must be long enough to amortize the "
+                        "fetch+dispatch round-trip (~100 ms through the "
+                        "tunnel — 4%% of a 200-step window, <1.5%% at "
+                        "800; a real TPU host pays ~1 ms and would not "
+                        "care)")
     p.add_argument("--num-iters", type=int, default=5)
-    p.add_argument("--steps-per-call", type=int, default=200,
+    p.add_argument("--steps-per-call", type=int, default=800,
                    help="training steps fused into one dispatch via "
                         "lax.scan; amortizes per-call host latency "
                         "(each scanned step is a full real SGD update). "
                         "The default is one dispatch per timed window: "
-                        "measured +0.4%% over 4 dispatches/window and "
-                        "removes multi-call wobble from the headline")
+                        "fewer dispatches measured faster at every size "
+                        "and one call removes multi-call wobble from "
+                        "the headline")
     p.add_argument("--unroll", type=int, default=5,
                    help="lax.scan unroll factor: >1 lets XLA software-"
                         "pipeline across step boundaries (prefetch next "
@@ -259,6 +263,9 @@ def main():
         return float(np.asarray(loss))
 
     ncalls_warm = max(1, args.num_warmup_batches // spc)
+    if ncalls_warm * spc != args.num_warmup_batches:
+        print(f"# note: warmup rounded to {ncalls_warm * spc} batches "
+              f"(multiple of --steps-per-call {spc})", file=sys.stderr)
     ncalls_iter = max(1, args.num_batches_per_iter // spc)
     batches_per_iter = ncalls_iter * spc
     if batches_per_iter != args.num_batches_per_iter:

@@ -29,3 +29,20 @@ def synthetic_text(n_tokens=65536, vocab=1000, seed=0):
     p = 1.0 / np.arange(1, vocab + 1)
     p /= p.sum()
     return rng.choice(vocab, size=n_tokens, p=p).astype(np.int32)
+
+
+def shard_batch(a, mesh, axis_name):
+    """Split a host batch across this process's devices and assemble the
+    global [per * world_size, ...] array every example feeds its step
+    (the shared form of the per-example `shard` helpers)."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    import horovod_tpu as hvd
+
+    per = a.shape[0] // hvd.local_size()
+    shards = [jax.device_put(a[i * per:(i + 1) * per], d)
+              for i, d in enumerate(mesh.local_mesh.devices.flat)]
+    return jax.make_array_from_single_device_arrays(
+        (per * hvd.size(),) + a.shape[1:],
+        NamedSharding(mesh, P(axis_name)), shards)

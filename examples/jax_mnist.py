@@ -17,14 +17,14 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 import optax
-from jax.sharding import NamedSharding, PartitionSpec as P
+from jax.sharding import PartitionSpec as P
 
 import horovod_tpu as hvd
 import horovod_tpu.jax as hvd_jax
 from horovod_tpu.models import MnistConvNet
 from horovod_tpu.utils import save_checkpoint
 
-from common import synthetic_mnist
+from common import shard_batch, synthetic_mnist
 
 
 def main():
@@ -67,12 +67,7 @@ def main():
     mesh = hvd.mesh()
 
     def shard(a):
-        per = a.shape[0] // hvd.local_size()
-        shards = [jax.device_put(a[i * per:(i + 1) * per], d)
-                  for i, d in enumerate(mesh.local_mesh.devices.flat)]
-        return jax.make_array_from_single_device_arrays(
-            (per * hvd.size(),) + a.shape[1:],
-            NamedSharding(mesh, P(hvd_jax.HVD_AXIS)), shards)
+        return shard_batch(a, mesh, hvd_jax.HVD_AXIS)
 
     n_local = args.batch_size * hvd.local_size()
     steps = len(xtr) // n_local

@@ -141,6 +141,11 @@ def _build_two_tier(devices: Sequence):
     return Mesh(arr, ("dcn", "ici"))
 
 
+# Count of successfully COMPLETED hostname exchanges; advances only on
+# success, so it stays agreed across processes (see _host_split).
+_host_split_completed = 0
+
+
 def _host_split(num_processes: int, process_index: int):
     """Shared-host split (reference: the MPI_Comm_split_type(SHARED) local
     communicator + the cross split, operations.cc:1668-1705): every
@@ -171,17 +176,21 @@ def _host_split(num_processes: int, process_index: int):
         # distributed client is either up everywhere or nowhere), so the
         # one-controller-per-host fallback stays consistent across it.
         return None
+    global _host_split_completed
+    # Keys are namespaced by the count of COMPLETED exchanges, which
+    # agrees across processes (lifecycle is collective, and an exchange
+    # completes either everywhere or nowhere — completion requires
+    # every process's key, which requires every process to have
+    # published). This closes both failure modes at once: a new
+    # incarnation reads FRESH keys (never a peer's stale hostname from
+    # the previous one), while a FAILED attempt does not advance the
+    # count, so retriers and a late straggler converge on the same
+    # namespace. Keys are immutable in the common case; the remaining
+    # delete+set only fires for a hostname changed across a *failed*
+    # attempt within one incarnation.
+    inc = _host_split_completed
     try:
-        # Stable (generation-free) keys so a FAILED init converges on
-        # retry: a straggler that missed the first attempt still finds
-        # and completes the same exchange (a local generation counter
-        # would desync retriers from the straggler forever). The write
-        # is idempotent — skipped when the key already holds this
-        # hostname (the store forbids overwrites); a DIFFERENT stale
-        # value (changed HVD_HOSTNAME across incarnations) is replaced,
-        # which is safe because init is collective: peers re-enter the
-        # exchange together rather than racing a half-replaced key.
-        key = f"hvd/host/p{process_index}"
+        key = f"hvd/host/i{inc}/p{process_index}"
         existing = kv.try_get(key)
         if existing is not None and _json.loads(existing) != host:
             kv.delete(key)
@@ -189,10 +198,11 @@ def _host_split(num_processes: int, process_index: int):
         if existing is None:
             kv.set(key, _json.dumps(host))
         deadline = coord.negotiation_timeout_s()
-        peers = [_json.loads(kv.get(f"hvd/host/p{p}", deadline))
+        peers = [_json.loads(kv.get(f"hvd/host/i{inc}/p{p}", deadline))
                  for p in range(num_processes)]
         if peers[process_index] != host:  # own delete/set failed
             raise KeyError("own hostname key is stale")
+        _host_split_completed += 1
     except Exception as exc:
         # The service exists but a peer's hostname never arrived: a
         # silent per-process fallback here would leave the world

@@ -61,19 +61,31 @@ def average_state(state):
     per-chip statistics live in arrays whose sharding claims
     replication while chips disagree, so any host-side fetch would read
     ONE chip's values and silently discard the rest. Counters and other
-    integer state are averaged in float and cast back."""
-    import jax.numpy as jnp
-    import jax.tree_util as jtu
-    from jax.sharding import PartitionSpec as P
+    integer state are averaged in float and cast back.
 
-    from horovod_tpu import jax as hvd_jax
+    The compiled averager is cached per world mesh (hvd.jit binds the
+    mesh at decoration time), so a per-epoch eval pays one trace/compile
+    per world, not per call."""
+    m = mesh()
+    avg = _AVG_CACHE.get(id(m))
+    if avg is None:
+        import jax.numpy as jnp
+        import jax.tree_util as jtu
+        from jax.sharding import PartitionSpec as P
 
-    @hvd_jax.jit(in_specs=(P(),), out_specs=P())
-    def avg(tree):
-        return jtu.tree_map(
-            lambda l: allreduce(jnp.asarray(l, jnp.float32),
-                                average=True).astype(
-                                    jnp.asarray(l).dtype),
-            tree)
+        from horovod_tpu import jax as hvd_jax
 
+        @hvd_jax.jit(in_specs=(P(),), out_specs=P())
+        def avg(tree):
+            return jtu.tree_map(
+                lambda l: allreduce(jnp.asarray(l, jnp.float32),
+                                    average=True).astype(
+                                        jnp.asarray(l).dtype),
+                tree)
+
+        _AVG_CACHE.clear()  # old worlds' programs are unusable anyway
+        _AVG_CACHE[id(m)] = avg
     return avg(state)
+
+
+_AVG_CACHE: dict = {}
