@@ -68,6 +68,15 @@ def main():
                         "bs32: 2 is +4%%, 4-5 are +6%%)")
     p.add_argument("--fp16-allreduce", action="store_true",
                    help="bf16 gradient compression on the wire")
+    p.add_argument("--sharded-update", action="store_true",
+                   help="cross-replica sharded weight update (arxiv "
+                        "2004.13336): reduce-scatter the gradient "
+                        "buckets, update a 1/N shard of params + "
+                        "optimizer state, all-gather the result. Cuts "
+                        "per-chip optimizer HBM traffic ~(N-1)/N on a "
+                        "multi-chip world; at N=1 it degrades to whole-"
+                        "tree packing (a measured NEGATIVE — see "
+                        "docs/benchmarks.md 'HBM diet')")
     p.add_argument("--remat-blocks", nargs="?", const="act_drop",
                    default=None, choices=["act_drop", "conv_saves"],
                    help="ResNet traffic-removal remat: 'act_drop' "
@@ -113,7 +122,7 @@ def main():
     # 11.4 ms step at bs32.
     opt = hvd_jax.DistributedOptimizer(
         optax.sgd(0.01, momentum=0.9), compression=compression,
-        fused_update=True)
+        fused_update=True, sharded_update=args.sharded_update)
 
     rng = jax.random.PRNGKey(0)
     # bf16 host feed: the model computes in bf16; feeding bf16 halves the
@@ -164,10 +173,16 @@ def main():
 
     spc = max(1, args.steps_per_call)
 
+    # Sharded update: each chip carries only its 1/N block of the
+    # momentum/param flat buffers, so the optimizer state rides the mesh
+    # as P('hvd') instead of replicated.
+    ospec = (hvd_jax.sharded_state_specs(opt_state)
+             if args.sharded_update else P())
+
     @hvd_jax.jit(
-        in_specs=(P(), P(), P(), P(),
+        in_specs=(P(), P(), ospec, P(),
                   P(hvd_jax.HVD_AXIS), P(hvd_jax.HVD_AXIS)),
-        out_specs=(P(), P(), P(), P(), P()),
+        out_specs=(P(), P(), ospec, P(), P()),
         donate_argnums=(0, 1, 2),
     )
     def train_step(params, batch_stats, opt_state, key, images, labels):

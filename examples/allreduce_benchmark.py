@@ -37,7 +37,12 @@ import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 import horovod_tpu as hvd
-from horovod_tpu.ops.collectives import HVD_AXIS, ranked_allreduce
+from horovod_tpu.ops.collectives import (
+    HVD_AXIS,
+    ranked_allgather,
+    ranked_allreduce,
+    ranked_reducescatter,
+)
 
 
 def _decompose_timeline(path, n_ops):
@@ -134,7 +139,12 @@ def main():
     ap.add_argument("--decompose", action="store_true",
                     help="with --engine: print the per-phase share table "
                          "of the round trip (queue / stage / collective "
-                         "/ fusion memcpys) from the engine timeline")
+                         "/ fusion memcpys) from the engine timeline. "
+                         "Without --engine: additionally time the "
+                         "reduce_scatter and all_gather phases an "
+                         "allreduce decomposes into — the collective "
+                         "shape of the sharded weight update "
+                         "(DistributedOptimizer(sharded_update=True))")
     ap.add_argument("--hierarchical", action="store_true",
                     help="route through reduce-scatter(ICI) -> psum(DCN) "
                          "-> all-gather(ICI) (reference: "
@@ -195,6 +205,39 @@ def main():
         print(f"size={mb:8.1f} MB/chip  time={dt*1e3:8.3f} ms  "
               f"busbw={bus_bytes/dt/1e9:8.2f} GB/s  "
               f"alg_bw={payload/dt/1e9:8.2f} GB/s")
+
+        if not args.decompose:
+            continue
+        # Phase decomposition of the same payload into the two halves an
+        # allreduce is built from — reduce_scatter (each rank keeps the
+        # sum of one 1/n chunk) then all_gather of the chunks. This is
+        # the collective shape of the sharded weight update
+        # (horovod_tpu/jax/sharded.py), so the engine-vs-in-step
+        # comparison covers it directly. rs+ag ≈ allreduce is the
+        # expected signature on a ring; a large gap means one phase's
+        # schedule is mis-tuned.
+        def timed(fn, arg, sync):
+            for _ in range(args.warmup):
+                sync(fn(arg))
+            t0 = time.perf_counter()
+            out = None
+            for _ in range(args.iters):
+                out = fn(arg)
+            sync(out)
+            return (time.perf_counter() - t0) / args.iters
+
+        # Sliced-scalar fetch: the only reliable barrier on the tunneled
+        # platform, without billing a multi-MB host transfer (see above).
+        def sync(out):
+            return float(np.asarray(out.ravel()[0]))
+
+        t_rs = timed(ranked_reducescatter, x, sync)
+        scattered = ranked_reducescatter(x)  # (n, elems/n) per-rank chunks
+        t_ag = timed(ranked_allgather, scattered, sync)
+        print(f"  phases: reduce_scatter={t_rs*1e3:8.3f} ms  "
+              f"all_gather={t_ag*1e3:8.3f} ms  "
+              f"rs+ag={(t_rs+t_ag)*1e3:8.3f} ms  "
+              f"(allreduce {dt*1e3:8.3f} ms)")
 
 
 if __name__ == "__main__":

@@ -1,0 +1,32 @@
+"""jax version compatibility shims.
+
+One import site for the API drift the framework spans:
+
+- ``shard_map`` moved from ``jax.experimental.shard_map`` to ``jax``
+  (>= 0.4.35-ish), and its replication-check keyword was renamed
+  ``check_rep`` -> ``check_vma``. Every module here spells the NEW name
+  (``check_vma``); on an older jax the shim forwards it as ``check_rep``.
+
+Import ``shard_map`` from here instead of repeating the try/except +
+keyword dance at each call site.
+"""
+
+from __future__ import annotations
+
+import inspect
+
+try:  # jax >= 0.4.35
+    from jax import shard_map as _shard_map
+except ImportError:  # pragma: no cover - older jax
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+if "check_vma" in inspect.signature(_shard_map).parameters:
+    shard_map = _shard_map
+else:  # pragma: no cover - exercised only on older jax
+    import functools
+
+    @functools.wraps(_shard_map)
+    def shard_map(*args, check_vma=None, **kwargs):
+        if check_vma is not None:
+            kwargs["check_rep"] = check_vma
+        return _shard_map(*args, **kwargs)

@@ -49,11 +49,12 @@ from horovod_tpu.ops.collectives import (  # noqa: F401
 )
 from horovod_tpu.jax.compression import Compression, Compressor  # noqa: F401
 from horovod_tpu.jax.fused import fuse  # noqa: F401
+from horovod_tpu.jax.sharded import (  # noqa: F401
+    shard_update,
+    sharded_state_specs,
+)
 
-try:
-    from jax import shard_map as _shard_map
-except ImportError:  # pragma: no cover
-    from jax.experimental.shard_map import shard_map as _shard_map
+from horovod_tpu.common.compat import shard_map as _shard_map
 
 try:
     from jax.experimental import sparse as _jsparse
@@ -95,6 +96,11 @@ def allreduce(
         if average:
             data = data / _world_size_like(data)
         return _BCOO((data, indices), shape=tensor.shape)
+    if _C._topo._require_init().size == 1:
+        # Single-rank world: the reduction is identity; skip the wire
+        # compression round trip too (it would be a lossy cast for
+        # nothing — the reference likewise short-circuits size 1).
+        return jnp.asarray(tensor)
     tensor, ctx = compression.compress(tensor)
     out = _C.allreduce(tensor, average=average, name=name)
     return compression.decompress(out, ctx)
@@ -110,6 +116,18 @@ def allreduce_pytree(tree, average: bool = True, compression=Compression.none,
     """Fused allreduce over a pytree with per-leaf compression. The fusion
     (per-dtype flat buffers) is the compile-time analogue of the reference's
     64 MB fusion buffer (reference: operations.cc:2035-2074)."""
+    if _C._topo._require_init().size == 1:
+        # Identity at world size 1 — per-leaf allreduce (which itself
+        # short-circuits before the compression round trip) elides the
+        # per-dtype concatenate -> all-reduce -> slice chain that XLA
+        # does NOT simplify away (a full extra HBM round trip of the
+        # gradient tree per step on a one-chip bench; docs/benchmarks.md
+        # "HBM diet") while keeping the N>1 leaf semantics: dense leaves
+        # become jax arrays, sparse leaves densify under sparse_as_dense.
+        leaves, treedef = _jax.tree_util.tree_flatten(tree)
+        return _jax.tree_util.tree_unflatten(
+            treedef, [allreduce(l, average, None, compression,
+                                sparse_as_dense) for l in leaves])
     leaves, treedef = _jax.tree_util.tree_flatten(tree)
     dense_idx, sparse_idx = [], []
     for i, l in enumerate(leaves):
@@ -173,6 +191,34 @@ def broadcast_object(obj: Any, root_rank: int = 0) -> Any:
 # DistributedOptimizer / gradient transforms
 # ---------------------------------------------------------------------------
 
+# One zero tree per (structure, shapes, dtypes, shardings): the
+# accumulation skip path must not allocate-and-write a fresh param-sized
+# zero tree every non-boundary microstep (it returns the SAME buffers
+# each time — the updates contract only promises values, not fresh
+# arrays). Bounded: param-sized device buffers must not outlive a shape
+# sweep, so old structures are evicted FIFO.
+_ZERO_TREES: dict = {}
+_ZERO_TREES_MAX = 8
+
+
+def _cached_zero_tree(tree):
+    leaves, treedef = _jax.tree_util.tree_flatten(tree)
+    if any(isinstance(l, _jax.core.Tracer) for l in leaves):
+        # Traced (the lax.cond branch): zeros_like stays a broadcast-of-0
+        # — XLA's cheapest form, fusable into the consuming add. A cached
+        # concrete tree here would bake a param-sized CONSTANT into the
+        # executable instead.
+        return _jax.tree.map(jnp.zeros_like, tree)
+    key = (treedef, tuple((jnp.shape(l), str(jnp.result_type(l)),
+                           str(getattr(l, "sharding", None)))
+                          for l in leaves))
+    z = _ZERO_TREES.get(key)
+    if z is None:
+        while len(_ZERO_TREES) >= _ZERO_TREES_MAX:
+            _ZERO_TREES.pop(next(iter(_ZERO_TREES)))
+        z = _ZERO_TREES[key] = _jax.tree.map(jnp.zeros_like, tree)
+    return z
+
 def DistributedOptimizer(
     optimizer: optax.GradientTransformation,
     name: Optional[str] = None,
@@ -181,6 +227,7 @@ def DistributedOptimizer(
     sparse_as_dense: bool = False,
     backward_passes_per_step: int = 1,
     fused_update: bool = False,
+    sharded_update: bool = False,
 ):
     """Wrap an optax transform so gradients are allreduced (fused, with
     compression) before the update (reference: horovod/tensorflow/
@@ -193,16 +240,44 @@ def DistributedOptimizer(
     per-parameter XLA fusions collapse into a couple of large ones —
     worth ~20% of a ResNet-50 step on TPU. Valid for elementwise
     transforms (sgd/momentum/adam/...); keep it off for shape-dependent
-    ones (adafactor, LARS)."""
-    if fused_update:
-        optimizer = fuse(optimizer)
+    ones (adafactor, LARS).
 
-    def update(grads, state, params=None, **kwargs):
-        grads = allreduce_pytree(
-            grads, average=average, compression=compression,
-            sparse_as_dense=sparse_as_dense,
-        )
-        return optimizer.update(grads, state, params, **kwargs)
+    ``sharded_update=True`` replaces allreduce + replicated update with
+    reduce-scatter -> update a 1/N shard of params/state -> all-gather
+    (:func:`horovod_tpu.jax.shard_update`; arxiv 2004.13336): per-chip
+    optimizer-state HBM read/write drops by ~(N-1)/N. The optimizer
+    state becomes per-dtype flat buffers padded to a world-size multiple
+    — lay them out ``P('hvd')`` in the compiled step via
+    :func:`sharded_state_specs`. Subsumes ``fused_update`` (the whole
+    tree is packed); valid for per-coordinate transforms ONLY (a
+    shard-local ``clip_by_global_norm`` would be wrong — see
+    sharded.py)."""
+    if sharded_update:
+        if backward_passes_per_step > 1:
+            # The accumulation wrapper's state ({'inner', 'acc', 'count'})
+            # interleaves param-structured accumulators with the sharded
+            # flat buffers — sharded_state_specs cannot tell them apart,
+            # so a divisible-sized accumulator would silently ride
+            # P('hvd') and shard a buffer every rank needs whole.
+            raise ValueError(
+                "sharded_update does not compose with "
+                "backward_passes_per_step > 1: accumulate before the "
+                "optimizer, or use fused_update")
+        # Reduction happens inside the wrapper (reduce-scatter on the
+        # packed buffers), so there is no separate allreduce here.
+        optimizer = shard_update(optimizer, average=average,
+                                 compression=compression)
+        update = optimizer.update
+    else:
+        if fused_update:
+            optimizer = fuse(optimizer)
+
+        def update(grads, state, params=None, **kwargs):
+            grads = allreduce_pytree(
+                grads, average=average, compression=compression,
+                sparse_as_dense=sparse_as_dense,
+            )
+            return optimizer.update(grads, state, params, **kwargs)
 
     if backward_passes_per_step <= 1:
         return optax.GradientTransformationExtraArgs(optimizer.init, update)
@@ -238,7 +313,7 @@ def DistributedOptimizer(
 
         def skip_fn(operand):
             acc_, inner_ = operand
-            return _jax.tree.map(jnp.zeros_like, grads), {
+            return _cached_zero_tree(grads), {
                 "inner": inner_,
                 "acc": acc_,
                 "count": count,

@@ -1,0 +1,220 @@
+"""Cross-replica sharded weight update — reduce-scatter, update 1/N,
+all-gather (arxiv 2004.13336 "Automatic Cross-Replica Sharding of Weight
+Update in Data-Parallel Training"; the ZeRO-2 shape).
+
+The Horovod pattern this repo reproduces allreduces the full gradient and
+then runs the IDENTICAL optimizer update on every chip: each chip reads
+and writes a full copy of the momentum/Adam state and the full parameter
+tree every step, even though chip r only "owns" new information about
+1/N of the reduced gradient. On a memory-bound step (ResNet-50 bs32 sits
+at 87.6% of the practical HBM peak at 35.7% MFU — docs/benchmarks.md)
+that redundancy is the dominant removable traffic: per-chip optimizer
+read/write drops by ~(N-1)/N when the update is sharded.
+
+:func:`shard_update` wraps an *elementwise* optax transform so that,
+inside the compiled SPMD step:
+
+1. gradients are packed into per-dtype flat buffers (the same packing
+   :mod:`horovod_tpu.jax.fused` uses, applied to the WHOLE tree — the
+   scatter needs one contiguous buffer per dtype) and zero-padded to a
+   multiple of the world size,
+2. the buffers go through ``lax.psum_scatter`` (reduce-scatter — half an
+   allreduce of wire traffic; optional on-the-wire compression applies
+   to the flat buffer exactly as it would to an allreduce),
+3. the inner optax update runs on the 1/N shard of gradient, parameters
+   and optimizer state (state buffers are (padded,) global arrays laid
+   out ``P('hvd')`` over the mesh, so each chip holds — and reads and
+   writes — only its own 1/N block),
+4. the updated-parameter DELTA returns via tiled ``lax.all_gather`` (the
+   other half of the allreduce's wire traffic), is un-padded, and
+   unpacks to the caller's update pytree.
+
+Called eagerly (no mesh axis bound), the wrapper reduces with a plain
+allreduce and updates the full buffers — elementwise transforms make the
+full update the concatenation of the per-shard updates, so eager and
+SPMD trajectories agree and share one state structure.
+
+Correctness domain: per-coordinate transforms (sgd, momentum, adam(w),
+rmsprop, lion, ...). Transforms that aggregate ACROSS coordinates see
+only the local shard under sharding — ``clip_by_global_norm`` would
+compute a shard-local norm — and must stay on the replicated path (this
+is stricter than :func:`horovod_tpu.jax.fuse`, where the norm stayed
+global because every chip held every coordinate).
+
+At world size 1 the scatter and gather are identity and the wrapper
+degrades to whole-tree-packed :func:`fuse` — a measured NEGATIVE on one
+chip (packing severs XLA's wgrad->update producer fusion; see
+docs/benchmarks.md "HBM diet"). Shard when N > 1.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from horovod_tpu.common import topology as _topo
+from horovod_tpu.common.topology import HVD_AXIS
+from horovod_tpu.jax.compression import Compression
+from horovod_tpu.jax.fused import _layout_of, _pack, _unpack
+from horovod_tpu.ops import collectives as _C
+
+# Pack EVERY leaf: the reduce-scatter needs one contiguous buffer per
+# dtype, so there is no passthrough tier (unlike fuse()'s small-only
+# packing).
+_PACK_ALL = 1 << 62
+
+
+def _world() -> int:
+    return _topo._require_init().size
+
+
+def shard_update(
+    optimizer: optax.GradientTransformation,
+    average: bool = True,
+    compression=Compression.none,
+) -> optax.GradientTransformationExtraArgs:
+    """Wrap ``optimizer`` so the gradient reduction AND the update are
+    sharded across the world (module docstring). The returned transform
+    replaces the allreduce: do NOT reduce gradients before calling it.
+
+    ``init`` returns per-dtype flat state buffers zero-padded to a
+    multiple of the world size; lay them out ``P('hvd')`` in the compiled
+    step (:func:`sharded_state_specs` builds the spec tree) so each chip
+    holds one 1/N block. ``average=False`` keeps the reduced sum, exactly
+    like :func:`horovod_tpu.jax.allreduce`.
+    """
+    optimizer = optax.with_extra_args_support(optimizer)
+    # Layout cache keyed like fuse(): init()'s param-dtype layout must
+    # serve update() calls that omit params (grads share treedef/shapes).
+    layouts: dict = {}
+
+    def _layout_key(tree):
+        leaves, treedef = jax.tree_util.tree_flatten(tree)
+        return treedef, tuple(tuple(jnp.shape(l)) for l in leaves)
+
+    def _remember(tree):
+        key = _layout_key(tree)
+        layout = layouts.get(key)
+        if layout is None:
+            layout = layouts[key] = _layout_of(tree, _PACK_ALL)
+        return layout
+
+    def _pack_padded(tree, layout, world, cast_small=False):
+        packed = _pack(tree, layout, cast_small=cast_small)
+        # Same zero-pad-to-multiple contract as reducescatter's.
+        return {k: _C._pad_dim0(v, world) for k, v in packed["buf"].items()}
+
+    def _unpack_padded(bufs, layout):
+        # _unpack indexes [off:off+n] per leaf, so trailing padding is
+        # simply never read.
+        return _unpack({"buf": bufs, "big": []}, layout)
+
+    def init(params):
+        world = _world()
+        layout = _remember(params)
+        return optimizer.init(
+            {"buf": _pack_padded(params, layout, world), "big": []})
+
+    def update(grads, state, params=None, **extra_args):
+        world = _world()
+        if params is not None:
+            layout = _remember(params)
+        else:
+            layout = (layouts.get(_layout_key(grads))
+                      or _layout_of(grads, _PACK_ALL))
+        gbufs = _pack_padded(grads, layout, world, cast_small=True)
+        pbufs = (None if params is None
+                 else _pack_padded(params, layout, world))
+
+        leaf0 = next(iter(gbufs.values()))
+        ax = _C.rank_axes() if _C.in_spmd(leaf0) else None
+        if (ax is None and world == 1) or (
+                ax is not None and lax.psum(1, ax) == 1):
+            # Degenerate 1-rank world: scatter and gather are identity
+            # and the wire carries nothing (skip the lossy compression
+            # round trip). What remains is whole-tree packing — fuse()
+            # semantics, a measured NEGATIVE on one chip (module
+            # docstring); kept so the flag is runnable anywhere.
+            ufull, new_state = optimizer.update(
+                {"buf": gbufs, "big": []}, state,
+                None if pbufs is None else {"buf": pbufs, "big": []},
+                **extra_args)
+            return _unpack_padded(ufull["buf"], layout), new_state
+        if ax is not None:
+            # --- compiled SPMD path: scatter, update 1/N, gather -------
+            n_axis = lax.psum(1, ax)  # static axis size
+            idx = lax.axis_index(ax)
+
+            def scatter(flat):
+                wire, ctx = compression.compress(flat)
+                shard = lax.psum_scatter(wire, ax, scatter_dimension=0,
+                                         tiled=True)
+                shard = compression.decompress(shard, ctx)
+                if average:
+                    shard = (shard / n_axis).astype(flat.dtype)
+                return shard
+
+            gshard = {k: scatter(v) for k, v in gbufs.items()}
+            pshard = None if pbufs is None else {
+                k: lax.dynamic_slice(
+                    v, (idx * (v.shape[0] // n_axis),),
+                    (v.shape[0] // n_axis,))
+                for k, v in pbufs.items()}
+            ushard, new_state = optimizer.update(
+                {"buf": gshard, "big": []}, state,
+                None if pshard is None else {"buf": pshard, "big": []},
+                **extra_args)
+            ubufs = {k: lax.all_gather(v, ax, axis=0, tiled=True)
+                     for k, v in ushard["buf"].items()}
+            return _unpack_padded(ubufs, layout), new_state
+
+        # --- eager path: allreduce + full-buffer update ---------------
+        # (single-controller host calls, and tests). Elementwise inner
+        # transforms make this the concatenation of the per-shard
+        # updates, so the state structure is shared with the SPMD path.
+        def reduce_full(flat):
+            wire, ctx = compression.compress(flat)
+            out = _C.allreduce(wire, average=False)
+            out = compression.decompress(out, ctx)
+            if average:
+                out = (out / world).astype(flat.dtype)
+            return out
+
+        gfull = {k: reduce_full(v) for k, v in gbufs.items()}
+        ufull, new_state = optimizer.update(
+            {"buf": gfull, "big": []}, state,
+            None if pbufs is None else {"buf": pbufs, "big": []},
+            **extra_args)
+        return _unpack_padded(ufull["buf"], layout), new_state
+
+    return optax.GradientTransformationExtraArgs(init, update)
+
+
+def sharded_state_specs(opt_state, axis: str = HVD_AXIS):
+    """PartitionSpec tree for a :func:`shard_update` optimizer state:
+    ``P('hvd')`` for the padded per-dtype flat buffers (every array leaf
+    — their leading dim is padded to a world-size multiple by
+    construction), ``P()`` for scalar leaves (step counters and other
+    replicated bookkeeping).
+
+    Use as the ``in_specs``/``out_specs`` entry for the optimizer-state
+    argument of :func:`horovod_tpu.jax.jit` so each chip holds exactly
+    its 1/N block of m/v/trace buffers::
+
+        spec = hvd.jax.sharded_state_specs(opt_state)
+        step = hvd.jax.jit(fn, in_specs=(P(), spec, ...),
+                           out_specs=(P(), spec, ...),
+                           donate_argnums=(0, 1))
+    """
+    world = _world()
+
+    def one(leaf):
+        shape = jnp.shape(leaf)
+        if len(shape) >= 1 and shape[0] > 0 and shape[0] % world == 0:
+            return P(axis)
+        return P()
+
+    return jax.tree_util.tree_map(one, opt_state)

@@ -32,6 +32,7 @@ from horovod_tpu.jax import (
     allreduce_pytree,
     broadcast_pytree,
     jit as _hvd_jit,
+    sharded_state_specs as _sharded_state_specs,
 )
 from horovod_tpu.jax import allreduce as _allreduce
 from horovod_tpu.keras import callbacks  # noqa: F401
@@ -132,11 +133,21 @@ class Trainer:
         distributed: bool = True,
         compression=Compression.none,
         rng: int = 0,
+        fused_update: bool = False,
+        sharded_update: bool = False,
     ):
+        """``fused_update``/``sharded_update`` forward to
+        :func:`horovod_tpu.jax.DistributedOptimizer` — ``sharded_update``
+        runs the optimizer on a 1/N shard of params/state per chip
+        (reduce-scatter + all-gather; per-coordinate transforms only) and
+        lays the optimizer state out ``P('hvd')`` in the compiled step."""
         self.model = model
+        self._sharded_update = bool(sharded_update and distributed)
         if distributed:
             optimizer = DistributedOptimizer(optimizer,
-                                             compression=compression)
+                                             compression=compression,
+                                             fused_update=fused_update,
+                                             sharded_update=sharded_update)
         self.optimizer = optimizer
         self.loss_fn = loss_fn
         self.metrics = tuple(metrics)
@@ -231,9 +242,20 @@ class Trainer:
                 logs["accuracy"] = _allreduce(acc)
             return logs
 
-        @_hvd_jit(in_specs=(P(), P(), P(), P(HVD_AXIS), P(HVD_AXIS), P(),
+        # Sharded update: each chip carries its 1/N block of the flat
+        # optimizer-state buffers instead of a replicated copy.
+        ospec = (_sharded_state_specs(self.opt_state)
+                 if self._sharded_update else P())
+
+        # donate_argnums: params/batch_stats/opt_state are rebound to the
+        # step's outputs every batch, so XLA may update them in place —
+        # without donation every param-sized buffer pays a copy-on-update
+        # each step. Callbacks run AFTER the rebind and therefore always
+        # see live buffers.
+        @_hvd_jit(in_specs=(P(), P(), ospec, P(HVD_AXIS), P(HVD_AXIS), P(),
                             P()),
-                  out_specs=(P(), P(), P(), P()))
+                  out_specs=(P(), P(), ospec, P()),
+                  donate_argnums=(0, 1, 2))
         def train_step(params, batch_stats, opt_state, x, y, lr_scale,
                        dropout_key):
             (loss, (logits, new_bs)), grads = jax.value_and_grad(

@@ -39,10 +39,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 from horovod_tpu.common import topology as _topo
 from horovod_tpu.common.topology import HVD_AXIS
 
-try:  # jax >= 0.4.35
-    from jax import shard_map as _shard_map
-except ImportError:  # pragma: no cover
-    from jax.experimental.shard_map import shard_map as _shard_map
+from horovod_tpu.common.compat import shard_map as _shard_map
 
 
 # Two-tier axis names, matching horovod_tpu.parallel.mesh (not imported:
@@ -159,6 +156,8 @@ def _hier_allreduce(x, average: bool):
 def _spmd_allreduce(x, average: bool, ax):
     """In-SPMD allreduce over whatever rank axes are bound, hierarchical
     when the two-tier axes are available and the env knob is on."""
+    if lax.psum(1, ax) == 1:
+        return x  # single-rank axis: sum and mean are both identity
     if isinstance(ax, tuple) and hierarchical_allreduce_enabled():
         return _hier_allreduce(x, average)
     return _psum_avg(x, lax.psum(1, ax), average, axis=ax)
@@ -219,7 +218,8 @@ def _ranked_program(op: str, mesh_key, root: int, average: bool,
         if op == "broadcast":
             return _root_select_psum(x, root, axis=rank_spec)
         if op == "reducescatter":
-            return lax.psum_scatter(x, rank_spec, scatter_dimension=0, tiled=True)[None]
+            return lax.psum_scatter(_pad_dim0(x, world), rank_spec,
+                                    scatter_dimension=0, tiled=True)[None]
         if op == "alltoall":
             return lax.all_to_all(x, rank_spec, split_axis=0, concat_axis=0, tiled=True)[None]
         raise ValueError(op)
@@ -429,6 +429,8 @@ def allreduce(tensor, average: bool = True, name: Optional[str] = None):
             _require_axis("allreduce")
         return _spmd_allreduce(tensor, average, ax)
     tensor = jnp.asarray(tensor)
+    if _topo._require_init().size == 1:
+        return tensor  # identity — no program launch for a 1-rank world
     _maybe_consistency_check(0, tensor, flags=int(average))
     return _localize(ranked_allreduce(_replicated_stack(tensor),
                                       average=average))
@@ -443,10 +445,14 @@ def allgather(tensor, name: Optional[str] = None):
         ax = rank_axes()
         if ax is None:
             _require_axis("allgather")
+        if lax.psum(1, ax) == 1:
+            return tensor
         return lax.all_gather(tensor, ax, axis=0, tiled=True)
     tensor = jnp.asarray(tensor)
     if tensor.ndim == 0:
         raise ValueError("allgather requires a tensor with at least one dimension")
+    if _topo._require_init().size == 1:
+        return tensor
     # Allgather legitimately permits differing first dims; check the rest.
     _maybe_consistency_check(1, tensor[:0])
     st = _topo._require_init()
@@ -482,22 +488,57 @@ def broadcast(tensor, root_rank: int, name: Optional[str] = None):
         ax = rank_axes()
         if ax is None:
             _require_axis("broadcast")
+        if lax.psum(1, ax) == 1:
+            return tensor
         return _root_select_psum(tensor, root_rank, axis=ax)
     tensor = jnp.asarray(tensor)
+    if _topo._require_init().size == 1:
+        return tensor
     _maybe_consistency_check(2, tensor, root_rank)
     return _localize(ranked_broadcast(_replicated_stack(tensor), root_rank))
+
+
+def _pad_dim0(tensor, multiple: int):
+    """Zero-pad dim 0 up to the next multiple (the reducescatter padding
+    contract); identity when already divisible."""
+    rem = tensor.shape[0] % multiple
+    if rem == 0:
+        return tensor
+    pad = [(0, multiple - rem)] + [(0, 0)] * (tensor.ndim - 1)
+    return jnp.pad(tensor, pad)
 
 
 def reducescatter(tensor, name: Optional[str] = None):
     """Sum over ranks, scattered: rank r keeps the r-th chunk of dim 0.
     (Beyond the reference's three verbs; native on TPU, and the building
-    block of hierarchical allreduce — operations.cc:1194-1346.)"""
+    block of hierarchical allreduce — operations.cc:1194-1346.)
+
+    Padding contract: a dim 0 not divisible by the world size is
+    zero-padded to the next multiple, so rank r receives rows
+    ``[r*c, (r+1)*c)`` of the padded sum where ``c = ceil(n/size)`` —
+    the trailing ``size*c - n`` rows of rank ``size-1``'s chunk are
+    zeros. A following tiled ``allgather`` returns the ``size*c``-row
+    concatenation; slice ``[:n]`` to recover the original extent (this
+    round trip is how the sharded weight update composes —
+    horovod_tpu/jax/sharded.py)."""
     if in_spmd(tensor):
         ax = rank_axes()
         if ax is None:
             _require_axis("reducescatter")
-        return lax.psum_scatter(tensor, ax, scatter_dimension=0, tiled=True)
+        if tensor.ndim == 0:
+            raise ValueError(
+                "reducescatter requires a tensor with at least one dimension")
+        world = lax.psum(1, ax)
+        if world == 1:
+            return tensor
+        return lax.psum_scatter(_pad_dim0(tensor, world), ax,
+                                scatter_dimension=0, tiled=True)
     tensor = jnp.asarray(tensor)
+    if tensor.ndim == 0:
+        raise ValueError(
+            "reducescatter requires a tensor with at least one dimension")
+    if _topo._require_init().size == 1:
+        return tensor
     _maybe_consistency_check(3, tensor)
     # _local_row is already process-local — no _localize round trip.
     return _local_row(ranked_reducescatter(_replicated_stack(tensor)))
@@ -510,8 +551,12 @@ def alltoall(tensor, name: Optional[str] = None):
         ax = rank_axes()
         if ax is None:
             _require_axis("alltoall")
+        if lax.psum(1, ax) == 1:
+            return tensor
         return lax.all_to_all(tensor, ax, split_axis=0, concat_axis=0, tiled=True)
     tensor = jnp.asarray(tensor)
+    if _topo._require_init().size == 1:
+        return tensor
     _maybe_consistency_check(4, tensor)
     return _local_row(ranked_alltoall(_replicated_stack(tensor)))
 
@@ -560,7 +605,14 @@ def grouped_allreduce(tensors: Sequence, average: bool = True):
     """Allreduce many tensors as one fused buffer — the compile-time
     equivalent of the reference's 64 MB fusion buffer (reference:
     operations.cc:2035-2074, fusion_buffer_manager.cc). One collective per
-    dtype group instead of one per tensor."""
+    dtype group instead of one per tensor.
+
+    World size 1 short-circuits BEFORE the packing: the concatenate ->
+    all-reduce -> slice chain survives XLA simplification even with one
+    participant, costing a full extra HBM round trip of the tensor set
+    per step (measured on the one-chip bench — docs/benchmarks.md)."""
+    if _topo._require_init().size == 1:
+        return [jnp.asarray(t) for t in tensors]
     return _grouped_apply(lambda flat: allreduce(flat, average=average), tensors)
 
 
@@ -574,6 +626,9 @@ def broadcast_pytree(tree, root_rank: int = 0):
     """Broadcast every leaf from ``root_rank`` (reference:
     broadcast_global_variables / broadcast_parameters — §3.4). Fused into
     one collective per dtype."""
+    if _topo._require_init().size == 1:
+        _check_root(root_rank)
+        return tree
     leaves, treedef = jax.tree_util.tree_flatten(tree)
     out = _grouped_apply(lambda flat: broadcast(flat, root_rank), leaves)
     return jax.tree_util.tree_unflatten(treedef, out)

@@ -40,10 +40,7 @@ from horovod_tpu.parallel.tensor_parallel import (
     RowParallelDense,
 )
 
-try:
-    from jax import shard_map as _shard_map
-except ImportError:  # pragma: no cover
-    from jax.experimental.shard_map import shard_map as _shard_map
+from horovod_tpu.common.compat import shard_map as _shard_map
 
 
 @dataclasses.dataclass(frozen=True)

@@ -276,6 +276,71 @@ def _category_totals(spaces):
     return cat_ms, cat_b
 
 
+# Per-op-CLASS attribution (coarser than _category_totals' fusion-name
+# buckets): where do the HBM bytes go — the wire, the optimizer, or the
+# math? First match wins; roots in _NO_TRAFFIC_OPS are classed
+# "control" with zero bytes (their names re-list buffers real ops own).
+_OP_CLASSES: List[Tuple[str, str]] = [
+    (r"all-reduce|all-gather|reduce-scatter|all-to-all|"
+     r"collective-permute|collective", "collective"),
+    # wgrad+momentum+param-apply fusions (TPU names them multiply_add /
+    # scatter fusions; see _category_totals) — the traffic the sharded
+    # weight update divides by N.
+    (r"multiply[._-]?add.*fusion|scatter.*fusion", "optimizer"),
+    (r"convolution|conv\d|dot|einsum|matmul|gemm|convert_reduce_fusion",
+     "conv/matmul"),
+    (r"copy|slice|bitcast|transpose|reshape|dynamic-update", "copy/layout"),
+    (r"rng|random", "rng"),
+    (r"infeed|outfeed|send|recv", "host transfer"),
+    (r"fusion", "elementwise fusion"),
+]
+
+
+def _op_class(name: str) -> str:
+    if _op_root(name) in _NO_TRAFFIC_OPS:
+        return "control"
+    low = name.lower()
+    for pat, label in _OP_CLASSES:
+        if re.search(pat, low):
+            return label
+    return "other"
+
+
+def class_breakdown(logdir: str, steps: int = 1,
+                    spaces=None) -> Dict[str, Dict[str, float]]:
+    """Per-op-class sequencer time and schedule-derived HBM bytes over
+    the "XLA Ops" line: ``{class: {"ms": .., "bytes": ..}}`` (per step).
+
+    This is the attribution table for traffic regressions: a jump in
+    "collective" bytes means the wire (or a size-1 world failing to
+    elide its collectives), "optimizer" the update fusions the sharded
+    weight update divides by N, "conv/matmul" the math itself. Bytes are
+    name-level (each op's non-VMEM operand/result shapes — same
+    accounting as :func:`hbm_bytes`), so copy/layout ops over-count
+    their source buffers; "control" ops contribute time but no bytes.
+    """
+    out: Dict[str, Dict[str, float]] = collections.defaultdict(
+        lambda: {"ms": 0.0, "bytes": 0.0})
+    if spaces is None:
+        spaces = _load_spaces(logdir)
+    for plane, line in _device_lines(spaces, "XLA Ops"):
+        meta = {i: m.name for i, m in plane.event_metadata.items()}
+        info: Dict[int, Tuple[str, int]] = {}
+        for ev in line.events:
+            mid = ev.metadata_id
+            if mid not in info:
+                name = meta.get(mid, "")
+                cls = _op_class(name)
+                info[mid] = (cls,
+                             0 if cls == "control" else _hbm_shape_bytes(name))
+            cls, b = info[mid]
+            out[cls]["ms"] += ev.duration_ps / 1e9
+            out[cls]["bytes"] += b
+    steps = max(steps, 1)
+    return {c: {"ms": v["ms"] / steps, "bytes": v["bytes"] / steps}
+            for c, v in out.items()}
+
+
 def fusion_direct_bytes(logdir: str, spaces=None) -> float:
     """Total bytes the compute fusions stream to/from HBM directly
     (their non-VMEM operand/output shapes) — the component of true HBM
@@ -328,6 +393,16 @@ def hbm_report(logdir: str, steps: int = 1, spaces=None) -> str:
     out.append(f"true HBM traffic (DMA + direct streams): {total:.2f} "
                f"GB/step -> {total / (inner / steps / 1e3):.0f} GB/s "
                f"achieved over the device step")
+    # Attribution: which op CLASS owns the bytes (collective wire vs
+    # optimizer update vs the math) — the table that makes a traffic
+    # regression attributable. Name-level accounting; "control" ops
+    # (incl. the while wrapper, whose span covers the whole loop)
+    # carry time but no bytes.
+    classes = class_breakdown(logdir, steps=steps, spaces=spaces)
+    out.append("per-op-class (schedule-derived bytes, name-level):")
+    out.append(f"  {'class':20s} {'ms/step':>8s} {'GB/step':>8s}")
+    for c, v in sorted(classes.items(), key=lambda kv: -kv[1]["bytes"]):
+        out.append(f"  {c:20s} {v['ms']:8.3f} {v['bytes'] / 1e9:8.2f}")
     return "\n".join(out)
 
 
@@ -395,7 +470,9 @@ def main(argv=None):
     ap.add_argument("--hbm", action="store_true",
                     help="measured-roofline table: per-category time + "
                          "HBM bytes + achieved GB/s, async-DMA payload, "
-                         "true-traffic sum (docs/benchmarks.md)")
+                         "true-traffic sum, and the per-op-class "
+                         "attribution (collective vs optimizer vs "
+                         "conv/matmul bytes) (docs/benchmarks.md)")
     args = ap.parse_args(argv)
     if args.hbm:
         print(hbm_report(args.logdir, steps=args.steps or 1))

@@ -79,6 +79,65 @@ def test_ranked_reducescatter(hvd):
     np.testing.assert_allclose(np.asarray(out)[:, 0], total)
 
 
+def test_reducescatter_non_divisible_padding_contract(hvd):
+    """Dim 0 not divisible by size: zero-pad to the next multiple, rank r
+    keeps rows [r*c, (r+1)*c) of the padded sum, c = ceil(n/size) — the
+    contract the sharded weight update composes on (allgather then slice
+    [:n] recovers the original extent)."""
+    n = hvd.size()
+    rows = n + 2  # 10 rows over 8 ranks -> c = 2, padded to 16
+    x = jnp.arange(rows * 3, dtype=jnp.float32).reshape(rows, 3)
+    out = hvd.reducescatter(x)
+    c = -(-rows // n)
+    assert out.shape == (c, 3)
+    # Eager semantics: every local chip contributes this controller's x,
+    # so the sum is x * size; this process sees its FIRST rank's chunk.
+    np.testing.assert_allclose(np.asarray(out), np.asarray(x[:c]) * n)
+
+
+def test_ranked_reducescatter_non_divisible(hvd):
+    n = hvd.size()
+    rows = n + 2
+    vals = [jnp.arange(rows, dtype=jnp.float32) + r for r in range(n)]
+    out = C.ranked_reducescatter(C.make_ranked(vals))
+    c = -(-rows // n)
+    assert out.shape == (n, c)
+    total = n * np.arange(rows) + sum(range(n))
+    padded = np.zeros(n * c, np.float32)
+    padded[:rows] = total
+    np.testing.assert_allclose(np.asarray(out).ravel(), padded)
+
+
+def test_spmd_reducescatter_allgather_roundtrip_non_divisible(hvd):
+    """In-SPMD: reducescatter -> allgather -> [:n] == allreduce sum, for
+    a leading dim the world size does not divide (the sharded-update
+    round trip)."""
+    from jax.sharding import PartitionSpec as P
+
+    from horovod_tpu.common.compat import shard_map
+
+    n = hvd.size()
+    rows = 2 * n + 3
+
+    def step(x):
+        x = x[0]  # this rank's (rows,) contribution
+        rs = hvd.reducescatter(x)
+        back = hvd.allgather(rs)[:rows]
+        return (back - hvd.allreduce(x, average=False))[None]
+
+    xs = jnp.arange(n * rows, dtype=jnp.float32).reshape(n, rows)
+    f = jax.jit(shard_map(
+        step, mesh=hvd.mesh(), in_specs=P(C.HVD_AXIS, None),
+        out_specs=P(C.HVD_AXIS, None), check_vma=False))
+    np.testing.assert_allclose(np.asarray(f(xs)), np.zeros((n, rows)),
+                               atol=1e-5)
+
+
+def test_reducescatter_scalar_raises(hvd):
+    with pytest.raises(ValueError, match="at least one dimension"):
+        hvd.reducescatter(jnp.float32(1.0))
+
+
 def test_ranked_alltoall(hvd):
     n = hvd.size()
     # rank r's tensor: [r*n, r*n+1, ..., r*n+n-1]; after alltoall rank r
@@ -120,10 +179,7 @@ def test_in_spmd_collectives(hvd):
     """Collectives inside shard_map over the world mesh — the hot path."""
     from jax.sharding import PartitionSpec as P
 
-    try:
-        from jax import shard_map
-    except ImportError:
-        from jax.experimental.shard_map import shard_map
+    from horovod_tpu.common.compat import shard_map
 
     mesh = hvd.mesh()
     n = hvd.size()
@@ -186,10 +242,7 @@ def test_spmd_int_average_preserves_dtype(hvd):
     """Traced and eager integer averaging must agree (floor-div, same dtype)."""
     from jax.sharding import PartitionSpec as P
 
-    try:
-        from jax import shard_map
-    except ImportError:
-        from jax.experimental.shard_map import shard_map
+    from horovod_tpu.common.compat import shard_map
 
     n = hvd.size()
     xs = jnp.full((n, 4), 3, dtype=jnp.int32)

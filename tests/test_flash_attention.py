@@ -166,10 +166,7 @@ def test_flash_in_ulysses():
     import jax
     from jax.sharding import PartitionSpec as P
 
-    try:
-        from jax import shard_map
-    except ImportError:
-        from jax.experimental.shard_map import shard_map
+    from horovod_tpu.common.compat import shard_map
 
     from horovod_tpu import parallel
 

@@ -1,0 +1,266 @@
+"""Sharded weight update (reduce-scatter -> 1/N update -> all-gather;
+arxiv 2004.13336) against the replicated-update oracle, on the 8-device
+virtual mesh — including the padding contract for param trees whose flat
+size is not divisible by the world size, composition with fused_update +
+bf16 wire compression, buffer donation of the sharded state, and the
+world-size-1 collective elision (subprocess with one device)."""
+
+import os
+import subprocess
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+from jax.sharding import PartitionSpec as P
+
+import horovod_tpu.jax as hj
+from horovod_tpu.jax import Compression
+
+
+@pytest.fixture(autouse=True)
+def _init(hvd):
+    pass
+
+
+def _params():
+    """Flat f32 size 10+3+20 = 33 — NOT divisible by 8, so the scatter
+    pads to 40 and the last rank's chunk carries zeros."""
+    return {
+        "w": jnp.arange(10.0),
+        "b": jnp.full((3,), 0.5),
+        "k": jnp.linspace(-1.0, 1.0, 20).reshape(4, 5),
+    }
+
+
+def _dyadic_grads(rank_rows, shape_tree, step):
+    """Per-rank gradients whose values are small dyadic rationals
+    (k/16): every cross-rank sum is exact in f32 REGARDLESS of the
+    reduction order, so psum (replicated) and psum_scatter (sharded)
+    must agree BITWISE."""
+
+    def one(path_i, leaf):
+        n = leaf.size
+        base = (np.arange(rank_rows * n).reshape(rank_rows, n)
+                % 31 - 15) / 16.0
+        return (base + step / 16.0 + path_i / 8.0).astype(np.float32)
+
+    leaves, treedef = jax.tree_util.tree_flatten(shape_tree)
+    return treedef, [one(i, l) for i, l in enumerate(leaves)]
+
+
+def _run_trajectory(make_opt, sharded, hvd, steps=4, compression=None,
+                    fused=False, donate=True):
+    """Drive opt.update inside the compiled SPMD step with DISTINCT
+    per-rank gradients (fed as rank-stacked arrays) and return the
+    resulting params after ``steps`` updates."""
+    n = hvd.size()
+    params = _params()
+    kwargs = {"compression": compression} if compression else {}
+    opt = hj.DistributedOptimizer(make_opt(), sharded_update=sharded,
+                                  fused_update=fused, **kwargs)
+    state = opt.init(params)
+    ospec = hj.sharded_state_specs(state) if sharded else P()
+
+    @hj.jit(in_specs=(P(), ospec, P("hvd", None)),
+            out_specs=(P(), ospec),
+            donate_argnums=(0, 1) if donate else ())
+    def step(p, s, gstack):
+        # gstack block: (1, total_elems) — this rank's gradient row.
+        leaves = jax.tree_util.tree_leaves(p)
+        offs, out = 0, []
+        for l in leaves:
+            out.append(gstack[0, offs: offs + l.size].reshape(l.shape))
+            offs += l.size
+        g = jax.tree_util.tree_unflatten(
+            jax.tree_util.tree_structure(p), out)
+        u, s2 = opt.update(g, s, p)
+        return optax.apply_updates(p, u), s2
+
+    p, s = params, state
+    for t in range(steps):
+        _, rows = _dyadic_grads(n, params, t)
+        # (n, total): row r = rank r's flat gradient, leaves in flatten
+        # order — the step reslices them into the param tree.
+        gstack = jnp.asarray(np.concatenate(rows, axis=1))
+        p, s = step(p, s, gstack)
+    return p
+
+
+def test_sharded_matches_replicated_sgd_f32_exact(hvd):
+    """SGD+momentum in f32 with dyadic gradients: the sharded path must
+    match the replicated path BITWISE (dyadic sums are order-exact, and
+    the per-shard update is the same arithmetic on a slice)."""
+    mk = lambda: optax.sgd(0.5, momentum=0.5)
+    ps = _run_trajectory(mk, True, hvd)
+    pr = _run_trajectory(mk, False, hvd)
+    for k in ps:
+        np.testing.assert_array_equal(np.asarray(ps[k]), np.asarray(pr[k]),
+                                      err_msg=k)
+
+
+def test_sharded_fused_bf16_compression_matches_replicated(hvd):
+    """sharded_update + fused_update + bf16 wire compression vs the
+    replicated path with the same compression: identical precision
+    profile (compress before reduce, sum on the bf16 wire), different
+    reduction shapes — agreement within bf16 tolerance."""
+    mk = lambda: optax.sgd(0.1, momentum=0.9)
+    ps = _run_trajectory(mk, True, hvd, compression=Compression.bf16,
+                         fused=True)
+    pr = _run_trajectory(mk, False, hvd, compression=Compression.bf16,
+                         fused=True)
+    for k in ps:
+        np.testing.assert_allclose(np.asarray(ps[k]), np.asarray(pr[k]),
+                                   rtol=2e-2, atol=2e-2, err_msg=k)
+
+
+def test_sharded_adam_matches_replicated(hvd):
+    """Adam's rsqrt makes bitwise equality unattainable, but the sharded
+    trajectory must track the replicated one tightly (the scalar count
+    state stays replicated, the m/v buffers shard)."""
+    mk = lambda: optax.adam(1e-2)
+    ps = _run_trajectory(mk, True, hvd)
+    pr = _run_trajectory(mk, False, hvd)
+    for k in ps:
+        np.testing.assert_allclose(np.asarray(ps[k]), np.asarray(pr[k]),
+                                   rtol=1e-5, atol=1e-6, err_msg=k)
+
+
+def test_eager_sharded_matches_eager_replicated(hvd):
+    """The eager fallback (allreduce + full-buffer update) must produce
+    the replicated trajectory — elementwise updates make the full update
+    the concatenation of the per-shard updates."""
+
+    def run(sharded):
+        params = _params()
+        opt = hj.DistributedOptimizer(optax.sgd(0.1, momentum=0.9),
+                                      sharded_update=sharded)
+        s = opt.init(params)
+        p = params
+        for t in range(3):
+            g = jax.tree_util.tree_map(
+                lambda l: jnp.full(l.shape, 0.25 * (t + 1)), params)
+            u, s = opt.update(g, s, p)
+            p = optax.apply_updates(p, u)
+        return p
+
+    ps, pr = run(True), run(False)
+    for k in ps:
+        np.testing.assert_allclose(np.asarray(ps[k]), np.asarray(pr[k]),
+                                   rtol=1e-6, err_msg=k)
+
+
+def test_sharded_state_specs(hvd):
+    """Flat padded buffers ride P('hvd'); scalar bookkeeping (adam's
+    count) stays replicated P()."""
+    params = _params()
+    opt = hj.DistributedOptimizer(optax.adam(1e-3), sharded_update=True)
+    state = opt.init(params)
+    specs = hj.sharded_state_specs(state)
+    leaves = jax.tree_util.tree_leaves(
+        state, is_leaf=lambda x: isinstance(x, jnp.ndarray))
+    spec_leaves = jax.tree_util.tree_leaves(
+        specs, is_leaf=lambda x: isinstance(x, P))
+    assert len(leaves) == len(spec_leaves)
+    n = hvd.size()
+    for leaf, spec in zip(leaves, spec_leaves):
+        if jnp.ndim(leaf) >= 1:
+            assert leaf.shape[0] % n == 0, "buffers must pad to N"
+            assert spec == P("hvd"), (leaf.shape, spec)
+        else:
+            assert spec == P(), (leaf.shape, spec)
+
+
+def test_sharded_update_init_pads_to_world_multiple(hvd):
+    """init()'s per-dtype buffers are zero-padded to a world-size
+    multiple — 33 f32 elements become 40 on the 8-device mesh."""
+    params = _params()
+    sharded = hj.shard_update(optax.sgd(0.1, momentum=0.9))
+    state = sharded.init(params)
+    bufs = [l for l in jax.tree_util.tree_leaves(state) if jnp.ndim(l) == 1]
+    assert bufs and all(b.shape[0] == 40 for b in bufs), [
+        b.shape for b in bufs]
+
+
+def test_sharded_update_rejects_accumulation(hvd):
+    """sharded_update's flat-buffer state cannot be told apart from the
+    accumulation wrapper's param-structured accumulators by
+    sharded_state_specs — the combination must refuse loudly instead of
+    silently sharding an accumulator."""
+    with pytest.raises(ValueError, match="backward_passes_per_step"):
+        hj.DistributedOptimizer(optax.sgd(0.1), sharded_update=True,
+                                backward_passes_per_step=2)
+
+
+def test_accumulation_skip_returns_cached_zero_tree(hvd):
+    """The non-boundary microstep must not materialize a fresh
+    param-sized zero tree: the skip path returns the SAME cached
+    buffers on every call (the updates contract promises values, not
+    fresh arrays), and the boundary update is unchanged."""
+    params = {"w": jnp.ones((5,)), "b": jnp.zeros(())}
+    opt = hj.DistributedOptimizer(optax.sgd(0.1),
+                                  backward_passes_per_step=3)
+    state = opt.init(params)
+    g = {"w": jnp.ones((5,)), "b": jnp.ones(())}
+    u1, state = opt.update(g, state, params)
+    u2, state = opt.update(g, state, params)
+    for a, b in zip(jax.tree_util.tree_leaves(u1),
+                    jax.tree_util.tree_leaves(u2)):
+        assert a is b, "skip path must reuse one zero tree"
+        np.testing.assert_array_equal(np.asarray(a), np.zeros(a.shape))
+    u3, state = opt.update(g, state, params)
+    np.testing.assert_allclose(np.asarray(u3["w"]), -0.1 * np.ones(5),
+                               rtol=1e-6)
+
+
+def test_world_size_one_elides_collectives(hvd):
+    """A 1-rank world compiles the DistributedOptimizer step with NO
+    all-reduce and NO pack/unpack concatenate — the r5 one-chip bench
+    paid a full extra HBM round trip of the gradient tree for an
+    identity reduction (docs/benchmarks.md 'HBM diet'). Subprocess: the
+    suite's own world is 8 virtual devices."""
+    code = """
+import jax
+jax.config.update("jax_platforms", "cpu")
+import jax.numpy as jnp, numpy as np, optax
+import horovod_tpu as hvd, horovod_tpu.jax as hj
+from jax.sharding import PartitionSpec as P
+hvd.init()
+assert hvd.size() == 1, hvd.size()
+x = jnp.arange(8.0)
+np.testing.assert_array_equal(np.asarray(hvd.allreduce(x)), np.asarray(x))
+np.testing.assert_array_equal(np.asarray(hvd.broadcast(x, 0)), np.asarray(x))
+np.testing.assert_array_equal(np.asarray(hvd.reducescatter(x)), np.asarray(x))
+# No lossy wire cast either: bf16 compression short-circuits at size 1.
+y = jnp.float32(1.0) + jnp.float32(1e-4)
+np.testing.assert_array_equal(
+    np.asarray(hj.allreduce(y[None], compression=hj.Compression.bf16)),
+    np.asarray(y[None]))
+opt = hj.DistributedOptimizer(optax.sgd(0.1, momentum=0.9),
+                              fused_update=True)
+params = {"a": jnp.ones((64, 64)), "b": jnp.ones((7,))}
+s = opt.init(params)
+def step(p, s, g):
+    u, s2 = opt.update(g, s, p)
+    return optax.apply_updates(p, u), s2
+f = hj.jit(step, in_specs=(P(), P(), P()), out_specs=(P(), P()))
+txt = f.lower(params, s, params).compile().as_text()
+assert "all-reduce" not in txt, "size-1 allreduce must be elided"
+assert "concatenate" not in txt, "size-1 grouped pack must be elided"
+print("ELIDED-OK")
+"""
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    # Strip the 8-device flag the suite's conftest forces: this world
+    # must see exactly one device.
+    env["XLA_FLAGS"] = " ".join(
+        f for f in env.get("XLA_FLAGS", "").split()
+        if "xla_force_host_platform_device_count" not in f)
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+    proc = subprocess.run([sys.executable, "-c", code], env=env, cwd=repo,
+                          capture_output=True, text=True, timeout=300)
+    assert proc.returncode == 0, proc.stderr[-4000:]
+    assert "ELIDED-OK" in proc.stdout

@@ -162,7 +162,8 @@ def test_profiler_capture_produces_trace(hvd, tmp_path):
 def _synthetic_xspace(tmp_path):
     """A hand-built device plane exercising every xplane metric: two
     compute fusions (one HBM-direct, one VMEM-only), an async copy pair,
-    a while wrapper, and an XLA Modules span."""
+    a while wrapper, an XLA Modules span, plus one collective and one
+    optimizer-update fusion for the per-op-class attribution."""
     from tensorflow.tsl.profiler.protobuf import xplane_pb2
 
     space = xplane_pb2.XSpace()
@@ -176,12 +177,17 @@ def _synthetic_xspace(tmp_path):
         4: "%copy-done = f32[256]{0:T(128)S(1)} copy-done(%copy-start)",
         5: "%while.2 = (s32[]{:T(128)}, f32[999999]{0:T(128)}) while(...)",
         6: "jit_step(123)",
+        7: "%all-reduce.3 = f32[128]{0:T(128)} all-reduce("
+           "f32[128]{0:T(128)} %x)",
+        8: "%multiply_add_fusion.11 = f32[256]{0:T(128)} fusion("
+           "f32[256]{0:T(128)} %g, f32[256]{0:T(128)S(1)} %m)",
     }
     for i, n in names.items():
         plane.event_metadata[i].id = i
         plane.event_metadata[i].name = n
     ops = plane.lines.add(name="XLA Ops")
-    for mid, dur_ps in [(1, 4e9), (2, 1e9), (4, 2e9), (5, 8e9)]:
+    for mid, dur_ps in [(1, 4e9), (2, 1e9), (4, 2e9), (5, 8e9),
+                        (7, 2e9), (8, 1.5e9)]:
         ev = ops.events.add(metadata_id=int(mid))
         ev.duration_ps = int(dur_ps)
     async_line = plane.lines.add(name="Async XLA Ops")
@@ -209,13 +215,32 @@ def test_xplane_hbm_accounting_on_synthetic_capture(tmp_path):
     assert xp.module_ms(logdir) == pytest.approx(9.0)
 
     # fusion.7: bf16 out 8*128*2 + bf16 operand 8*128*2 (the S(1) f32
-    # operand excluded); fusion.9 all-VMEM -> 0; copy-done + while skipped.
+    # operand excluded); fusion.9 all-VMEM -> 0; copy-done + while
+    # skipped; all-reduce.3 in+out 2*128*4; multiply_add_fusion.11 out +
+    # one HBM operand 2*256*4 (the S(1) momentum operand excluded).
     hb = xp.hbm_bytes(logdir)
-    assert hb["bytes"] == 2 * (8 * 128 * 2)
+    assert hb["bytes"] == 2 * (8 * 128 * 2) + 2 * 128 * 4 + 2 * 256 * 4
 
     report = xp.hbm_report(logdir, steps=1)
     assert "conv+BN fusion" in report and "while" not in report
     assert "true HBM traffic" in report
+    assert "per-op-class" in report
+
+    # Per-op-class attribution (collective vs optimizer vs conv/matmul
+    # bytes): the table that makes a traffic regression attributable.
+    classes = xp.class_breakdown(logdir, steps=1)
+    assert classes["collective"]["bytes"] == 2 * 128 * 4
+    assert classes["collective"]["ms"] == pytest.approx(2.0)
+    assert classes["optimizer"]["bytes"] == 2 * 256 * 4
+    assert classes["optimizer"]["ms"] == pytest.approx(1.5)
+    assert classes["conv/matmul"]["bytes"] == 2 * (8 * 128 * 2)
+    # control (while + copy-done) carries time but never bytes.
+    assert classes["control"]["bytes"] == 0
+    assert classes["control"]["ms"] == pytest.approx(10.0)
+    assert classes["elementwise fusion"]["bytes"] == 0
+    # steps divides evenly into per-step figures.
+    half = xp.class_breakdown(logdir, steps=2)
+    assert half["collective"]["bytes"] == 128 * 4
 
     # Shape parsing corner cases.
     assert xp._first_shape_bytes("%x = pred[3]{0} y(pred[3] %a)") == 3
