@@ -405,6 +405,16 @@ def main():
         "hbm_source": hbm_source,
         "membw_util": round(membw, 3) if membw is not None else None,
     }
+    # Unified telemetry (core/telemetry.py): eager-collective counts, the
+    # startup broadcast, engine activity if any — read AFTER the timed
+    # windows so collecting it can never perturb the headline. The hot
+    # path itself is the AOT executable, which carries no instrumentation.
+    try:
+        from horovod_tpu.core import telemetry as _telemetry
+
+        result["telemetry"] = _telemetry.compact()
+    except Exception as e:  # pragma: no cover - never fail the bench line
+        print(f"# telemetry unavailable: {e}", file=sys.stderr)
     print(json.dumps(result))
     print(f"# {nchips} chip(s), spread {min(rates):.0f}-{max(rates):.0f} "
           f"img/sec over {args.num_iters} iters, "

@@ -46,3 +46,7 @@ from horovod_tpu.ops.collectives import (  # noqa: F401
     allreduce_pytree,
     broadcast_pytree,
 )
+from horovod_tpu.core.telemetry import (  # noqa: F401
+    telemetry,
+    report as telemetry_report,
+)

@@ -267,6 +267,28 @@ def init(ranks: Optional[Sequence[int]] = None, devices: Optional[Sequence] = No
                     process_id=int(os.environ.get("HVD_PROCESS_ID", "0")),
                 )
 
+        # Multi-controller on the CPU platform: current jaxlib executes
+        # cross-process CPU collectives only through a CPU collectives
+        # backend — without one, the first collective dies with
+        # "Multiprocess computations aren't implemented on the CPU
+        # backend". Select gloo while the backend is still uninitialized
+        # (works before or after jax.distributed.initialize; an env var
+        # alone is preempted the same way JAX_PLATFORMS is). No-op for
+        # single-process and for real TPU platforms.
+        try:
+            from jax._src import distributed as _jax_dist
+
+            multiproc = _jax_dist.global_state.client is not None
+        except Exception:
+            multiproc = False
+        if multiproc and (plat == "cpu"
+                          or jax.config.jax_platforms == "cpu"):
+            try:
+                jax.config.update(
+                    "jax_cpu_collectives_implementation", "gloo")
+            except Exception:
+                pass  # older jaxlib without the knob / backend already up
+
         if devices is None:
             devices = list(jax.devices())
         if ranks is not None:

@@ -48,6 +48,8 @@ import time
 from dataclasses import dataclass
 from typing import Dict, List, Optional, Sequence, Tuple
 
+from horovod_tpu.core import telemetry as _tele
+
 LOG = logging.getLogger("horovod_tpu.coordinator")
 
 _POLL_SLICE_S = 0.5  # granularity of tombstone checks while blocked
@@ -150,7 +152,15 @@ class JaxKV:
 
     def try_get(self, key: str) -> Optional[str]:
         try:
-            return self._client.key_value_try_get(key)
+            fn = getattr(self._client, "key_value_try_get", None)
+            if fn is not None:
+                return fn(key)
+            # Newer jaxlib clients dropped key_value_try_get: emulate the
+            # non-blocking probe with a near-zero-timeout blocking get
+            # (an absent key surfaces as DEADLINE_EXCEEDED -> None).
+            # Only probe paths use this (tombstone checks between poll
+            # slices), so the extra 50 ms rides an already-blocked wait.
+            return self._client.blocking_key_value_get(key, 50)
         except Exception:
             return None
 
@@ -354,6 +364,13 @@ class Coordinator:
         # O(P) reads/round that make total KV load O(P^2)/round).
         self.stats = {"rounds": 0, "round_s": 0.0, "kv_gets": 0}
         self.aggregate = aggregation_enabled()
+        # Straggler attribution state: first-observed announce time per
+        # (name, process) from the round tables, and the names already
+        # charged to the telemetry tracker (a recurring name — per-step
+        # gradients — is forgotten once it leaves every table, so the
+        # next instance is charged afresh).
+        self._announce: Dict[str, Dict[int, float]] = {}
+        self._blamed: set = set()
 
     # -- keys ---------------------------------------------------------------
 
@@ -568,6 +585,7 @@ class Coordinator:
         groups = decide(tables, entries, int(fusion))
         self.last_tables = {pid: {m.name for m in metas}
                             for pid, metas in tables.items()}
+        self._track_stragglers()
         total = sum(len(t) for t in tables.values())
         self.idle_rounds = self.idle_rounds + 1 if total == 0 else 0
         backoff = 0.0
@@ -583,6 +601,28 @@ class Coordinator:
 
     # -- stall attribution (reference: CheckForStalledTensors,
     # operations.cc:1535-1581 — names the ranks holding up each tensor) ----
+
+    def _track_stragglers(self):
+        """Distill per-process lateness from the round tables into the
+        telemetry straggler tracker. Rounds tick even when this process
+        is idle, so announce times are observed at round granularity
+        (~cycle time) on EVERY process — a delayed peer is charged its
+        lateness on the waiting processes and on itself alike."""
+        now = time.monotonic()
+        live = set()
+        for pid, names in self.last_tables.items():
+            for n in names:
+                live.add(n)
+                self._announce.setdefault(n, {}).setdefault(pid, now)
+        for n in [n for n in self._announce if n not in live]:
+            # Instance completed everywhere: forget, so a re-submission
+            # of the same name (per-step gradients) is charged afresh.
+            del self._announce[n]
+            self._blamed.discard(n)
+        for n, times in self._announce.items():
+            if n not in self._blamed and len(times) >= self.nproc:
+                self._blamed.add(n)
+                _tele.STRAGGLERS.observe(n, times)
 
     def missing_processes(self, name: str) -> List[int]:
         if not self.last_tables:
@@ -635,6 +675,9 @@ class Coordinator:
                 lines.append(line)
         if lines:
             self._last_stall_warn = now
+            worst = _tele.STRAGGLERS.worst_line()
+            if worst:
+                lines.append(worst)
             LOG.warning(
                 "One or more tensors were submitted to be reduced, gathered "
                 "or broadcast by a subset of processes and are waiting for "

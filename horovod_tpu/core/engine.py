@@ -33,6 +33,7 @@ from typing import Callable, Dict, Optional
 
 import numpy as np
 
+from horovod_tpu.core import telemetry as tele
 from horovod_tpu.core import timeline as tl
 
 LOG = logging.getLogger("horovod_tpu.engine")
@@ -104,7 +105,13 @@ class JaxExecutor:
         if arr.dtype.itemsize == 8 and arr.dtype.kind in "fiuc":
             import jax
 
-            return jax.enable_x64()
+            if hasattr(jax, "enable_x64"):
+                return jax.enable_x64()
+            # jax versions without the top-level alias keep the
+            # experimental spelling.
+            from jax.experimental import enable_x64
+
+            return enable_x64()
         return contextlib.nullcontext()
 
     def _stage(self, arr: np.ndarray):
@@ -235,6 +242,25 @@ def config_from_env(cycle_time_s: Optional[float],
     return cycle_time_s, fusion_threshold, stall_warning_s
 
 
+def record_submit(op: str, nbytes: int, queue_depth: int):
+    """Submit-side telemetry shared by both engine implementations (the
+    native engine enqueues through Python too; only execution-side
+    counters need its stats C API). Counter names are the parity contract
+    tests/test_telemetry.py pins across the two engines."""
+    tele.REGISTRY.counter(f"engine.submitted.{op}").inc()
+    tele.REGISTRY.counter("engine.submitted.bytes").inc(int(nbytes))
+    tele.REGISTRY.histogram(
+        "engine.tensor_bytes", tele.BYTES_BUCKETS).observe(int(nbytes))
+    tele.REGISTRY.gauge("engine.queue_depth").set(queue_depth)
+
+
+def record_cycle(elapsed_s: float):
+    """One engine cycle that executed work (idle ticks are not counted —
+    both engines apply the same rule, so the counts are comparable)."""
+    tele.REGISTRY.counter("engine.cycles").inc()
+    tele.REGISTRY.counter("engine.cycle_seconds_total").inc(elapsed_s)
+
+
 def make_autotuner(engine):
     """Shared autotuner construction (reference: HOROVOD_AUTOTUNE,
     operations.cc:1797-1804). Returns a ParameterManager or None. In
@@ -324,6 +350,8 @@ class Engine:
             self._next_handle += 1
             self._handles[entry.handle] = h
             self._pending_names[entry.name] = entry
+            depth = len(self._pending_names)
+        record_submit(entry.op, entry.tensor.nbytes, depth)
         self.timeline.start(entry.name, tl.QUEUE)
         self._queue.put(entry)
         self._wake.set()
@@ -458,6 +486,7 @@ class Engine:
         operations.cc:1921-2172)."""
         from horovod_tpu.core import coordinator as coord
 
+        t_cycle = time.monotonic()
         for e in entries:
             self.timeline.start(e.name, f"NEGOTIATE_{e.op.upper()}")
         self._negotiating.extend(entries)
@@ -472,8 +501,11 @@ class Engine:
                 age_s=now - e.enqueued_at, nbytes=e.tensor.nbytes)
             for e in self._negotiating
         ]
+        t_neg = time.monotonic()
         try:
             decision = c.negotiate(metas)
+            tele.REGISTRY.histogram("engine.negotiation_s").observe(
+                time.monotonic() - t_neg)
         except Exception as exc:
             # Post-poison rounds re-raise KVError(self.dead) whose message
             # still names the peer shutdown — map by substring exactly like
@@ -524,10 +556,12 @@ class Engine:
         if done:
             self._negotiating = [e for i, e in enumerate(self._negotiating)
                                  if i not in done]
+            record_cycle(time.monotonic() - t_cycle)
         if executed_bytes and self._param_manager is not None:
             self._param_manager.update(executed_bytes)
 
     def _run_cycle(self):
+        t_cycle = time.monotonic()
         entries = self._drain()
         self._maybe_build_coordinator()
         if self._coordinator is not None:
@@ -571,6 +605,7 @@ class Engine:
                     self._exec_single(e)
             if batch:
                 self._exec_allreduce_batch(batch)
+            record_cycle(time.monotonic() - t_cycle)
 
     def _emit_exec_spans(self, entries, activity, t0_us):
         """Retro-emit WAIT_FOR_DATA (host→device staging, reference:
@@ -591,6 +626,13 @@ class Engine:
     def _exec_allreduce_batch(self, batch):
         names = [e.name for e in batch]
         fused = len(batch) > 1
+        if fused:
+            # Fusion-buffer occupancy accounting (reference analogue: the
+            # 64 MB fusion buffer, operations.cc:2035-2074).
+            tele.REGISTRY.counter("engine.fused.batches").inc()
+            tele.REGISTRY.counter("engine.fused.tensors").inc(len(batch))
+            tele.REGISTRY.counter("engine.fused.bytes").inc(
+                sum(e.tensor.nbytes for e in batch))
         try:
             if fused:
                 for n in names:
@@ -641,7 +683,11 @@ class Engine:
         self.timeline.end(e.name, tl.QUEUE)
         with self._lock:
             self._pending_names.pop(e.name, None)
+            depth = len(self._pending_names)
             h = self._handles.get(e.handle)
+        tele.REGISTRY.counter(
+            "engine.errors" if err is not None else "engine.completed").inc()
+        tele.REGISTRY.gauge("engine.queue_depth").set(depth)
         if h is not None:
             h.result = result
             h.error = err
@@ -690,6 +736,11 @@ class Engine:
             if c is not None and c.waiting_on is not None:
                 names += (f" [negotiation is blocked waiting for process "
                           f"{c.waiting_on}]")
+            # Same registry the straggler report reads: name the rank
+            # with the largest cumulative imposed wait so far.
+            worst = tele.STRAGGLERS.worst_line()
+            if worst:
+                names += " " + worst
             LOG.warning(
                 "One or more tensors were submitted to be reduced/gathered/"
                 "broadcast but have not completed for over %ds: %s",

@@ -75,6 +75,24 @@ class HvdResult(ctypes.Structure):
     ]
 
 
+class HvdStats(ctypes.Structure):
+    """Execution-side telemetry snapshot — field layout MUST stay in sync
+    with hvd_engine_stats in hvdcore.cc."""
+
+    _fields_ = [
+        ("submitted", ctypes.c_longlong * 3),
+        ("submitted_bytes", ctypes.c_longlong),
+        ("completed", ctypes.c_longlong),
+        ("errors", ctypes.c_longlong),
+        ("fused_batches", ctypes.c_longlong),
+        ("fused_tensors", ctypes.c_longlong),
+        ("fused_bytes", ctypes.c_longlong),
+        ("cycles", ctypes.c_longlong),
+        ("cycle_seconds", ctypes.c_double),
+        ("queue_depth", ctypes.c_longlong),
+    ]
+
+
 EXEC_FN = ctypes.CFUNCTYPE(ctypes.c_int, ctypes.c_void_p,
                            ctypes.POINTER(HvdRequest),
                            ctypes.POINTER(HvdResult))
@@ -130,6 +148,8 @@ def load_library():
     lib.hvd_engine_drop.argtypes = [ctypes.c_void_p, ctypes.c_longlong]
     lib.hvd_engine_pending.restype = ctypes.c_longlong
     lib.hvd_engine_pending.argtypes = [ctypes.c_void_p]
+    lib.hvd_engine_get_stats.argtypes = [ctypes.c_void_p,
+                                         ctypes.POINTER(HvdStats)]
     lib.hvd_engine_timeline_instant.argtypes = [
         ctypes.c_void_p, ctypes.c_char_p, ctypes.c_char_p, ctypes.c_char_p]
     lib.hvd_engine_shutdown.argtypes = [ctypes.c_void_p]

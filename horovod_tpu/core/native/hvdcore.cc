@@ -112,6 +112,24 @@ typedef int (*hvd_exec_fn)(void* ctx, hvd_request* req, hvd_result* res);
 typedef int (*hvd_negotiate_fn)(void* ctx, const char* table_json,
                                 char** decision_out);
 
+// Execution-side telemetry snapshot (submit-side counters live in the
+// Python binding, which every enqueue passes through anyway). Field
+// layout MUST stay in sync with HvdStats in native/__init__.py; the
+// Python side computes deltas between reads and folds them into the
+// process-wide telemetry registry (core/telemetry.py).
+struct hvd_engine_stats {
+  long long submitted[3];   // per HvdOp (allreduce/allgather/broadcast)
+  long long submitted_bytes;
+  long long completed;      // entries completed successfully
+  long long errors;         // entries completed with an error
+  long long fused_batches;  // fused allreduce executions (batch size > 1)
+  long long fused_tensors;  // tensors that rode a fused batch
+  long long fused_bytes;    // payload bytes through fusion buffers
+  long long cycles;         // loop cycles that executed work
+  double cycle_seconds;     // wall time inside those cycles
+  long long queue_depth;    // in-flight tensors right now
+};
+
 void* hvd_alloc(long long nbytes) { return malloc((size_t)nbytes); }
 
 }  // extern "C"
@@ -401,6 +419,8 @@ class Engine {
     e.shape.assign(shape, shape + ndim);
     e.enqueued = Clock::now();
     pending_names_[e.name] = e.enqueued;
+    if (op >= 0 && op < 3) stats_.submitted[op]++;
+    stats_.submitted_bytes += (long long)e.data.size();
     handles_[e.handle] = std::make_shared<HandleState>();
     long long h = e.handle;
     if (timeline_.Active()) timeline_.Begin(e.name, "QUEUE");
@@ -464,6 +484,12 @@ class Engine {
   long long PendingCount() {
     std::lock_guard<std::mutex> g(mu_);
     return (long long)pending_names_.size();
+  }
+
+  void GetStats(hvd_engine_stats* out) {
+    std::lock_guard<std::mutex> g(mu_);
+    *out = stats_;
+    out->queue_depth = (long long)pending_names_.size();
   }
 
   void Shutdown() {
@@ -559,6 +585,7 @@ class Engine {
   // control plane, execute exactly the groups it returns (the reference's
   // coordinated half of RunLoopOnce, operations.cc:1921-2172).
   void NegotiateCycle(std::deque<Entry>& fresh) {
+    Clock::time_point t0 = Clock::now();
     for (auto& e : fresh) {
       if (timeline_.Active()) timeline_.Begin(e.name, NegPhase(e.op));
       negotiating_.push_back(std::move(e));
@@ -611,8 +638,16 @@ class Engine {
       FailAllNegotiating(neg_poison_);
       return;
     }
+    size_t before = negotiating_.size();
     long long executed_bytes = ParseAndExecute(decision ? decision : "");
     free(decision);
+    if (negotiating_.size() < before) {
+      // Entries completed this round ('g' or 'e' groups) — the same
+      // executed-work rule the Python twin counts cycles by.
+      std::lock_guard<std::mutex> g(mu_);
+      stats_.cycles++;
+      stats_.cycle_seconds += SecondsSince(t0);
+    }
     if (executed_bytes > 0) {
       hvd_request req{};
       req.op = HVD_TICK;
@@ -698,6 +733,7 @@ class Engine {
   // the threshold (reference: operations.cc:2035-2074); other ops run
   // singly, in order.
   void RunCycle(std::deque<Entry>& entries) {
+    Clock::time_point t0 = Clock::now();
     long long fusion_limit;
     bool sort_by_name;
     {
@@ -737,6 +773,11 @@ class Engine {
     }
     flush();
     if (!entries.empty()) {
+      {
+        std::lock_guard<std::mutex> g(mu_);
+        stats_.cycles++;
+        stats_.cycle_seconds += SecondsSince(t0);
+      }
       hvd_request req{};
       req.op = HVD_TICK;
       req.names = "";
@@ -770,6 +811,12 @@ class Engine {
       if (!names.empty()) names += ';';
       names += e->name;
       total += (long long)e->data.size() / itemsize;
+    }
+    if (batch.size() > 1) {
+      std::lock_guard<std::mutex> g(mu_);
+      stats_.fused_batches++;
+      stats_.fused_tensors += (long long)batch.size();
+      stats_.fused_bytes += total * itemsize;
     }
     std::vector<char> fused((size_t)(total * itemsize));
     long long off = 0;
@@ -876,6 +923,9 @@ class Engine {
     {
       std::lock_guard<std::mutex> g(mu_);
       pending_names_.erase(e.name);
+      // Counted whether or not the handle is still live (the Python twin
+      // counts every completion the same way).
+      if (error) stats_.errors++; else stats_.completed++;
       auto it = handles_.find(e.handle);
       if (it == handles_.end()) return;
       hs = it->second;
@@ -945,6 +995,7 @@ class Engine {
 
   std::mutex mu_;
   std::condition_variable cv_, cv_done_;
+  hvd_engine_stats stats_{};  // guarded by mu_
   std::deque<Entry> queue_;
   std::unordered_map<std::string, Clock::time_point> pending_names_;
   std::unordered_map<long long, std::shared_ptr<HandleState>> handles_;
@@ -1031,6 +1082,10 @@ void hvd_engine_drop(void* e, long long handle) {
 
 long long hvd_engine_pending(void* e) {
   return static_cast<Engine*>(e)->PendingCount();
+}
+
+void hvd_engine_get_stats(void* e, hvd_engine_stats* out) {
+  static_cast<Engine*>(e)->GetStats(out);
 }
 
 void hvd_engine_timeline_instant(void* e, const char* name,

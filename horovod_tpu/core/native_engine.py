@@ -13,11 +13,12 @@ from __future__ import annotations
 
 import ctypes
 import os
+import threading
 from typing import Optional
 
 import numpy as np
 
-from horovod_tpu.core import native, timeline as tl
+from horovod_tpu.core import native, telemetry as tele, timeline as tl
 from horovod_tpu.core.engine import (
     STALL_WARNING_TIME_S,
     DuplicateNameError,
@@ -28,6 +29,7 @@ from horovod_tpu.core.engine import (
     _negotiated,
     config_from_env,
     make_autotuner,
+    record_submit,
 )
 
 # Engine wire dtypes (the role MPIDataType plays in the reference,
@@ -72,6 +74,8 @@ def _make_negotiator(engine):
     @native.NEG_FN
     def neg(ctx, table_json, out_pp):
         try:
+            import time
+
             c = engine._coordinator
             rows = json.loads(table_json.decode())
             metas = [
@@ -83,7 +87,10 @@ def _make_negotiator(engine):
                     nbytes=r["b"])
                 for r in rows
             ]
+            t_neg = time.monotonic()
             decision = c.negotiate(metas)
+            tele.REGISTRY.histogram("engine.negotiation_s").observe(
+                time.monotonic() - t_neg)
             if engine._timeline_on and c.last_tables:
                 # Per-process readiness instants inside the NEGOTIATE_*
                 # span (reference: timeline.cc:106-130): the C++ writer
@@ -238,6 +245,45 @@ class NativeEngine:
         self._param_manager = make_autotuner(self)
         self._executor.param_manager = self._param_manager
 
+        # Execution-side telemetry rides the stats C API: a registry sync
+        # hook folds counter deltas in right before every snapshot, so
+        # both engines surface the SAME counter names (submit-side
+        # counters are recorded in _enqueue below, which is Python).
+        self._last_stats: dict = {}
+        self._stats_lock = threading.Lock()
+        tele.REGISTRY.register_sync(self._collect_stats)
+
+    # Registry counter name <- HvdStats field (the parity contract with
+    # the python engine's record_* helpers in core/engine.py).
+    _STAT_COUNTERS = (
+        ("engine.completed", "completed"),
+        ("engine.errors", "errors"),
+        ("engine.fused.batches", "fused_batches"),
+        ("engine.fused.tensors", "fused_tensors"),
+        ("engine.fused.bytes", "fused_bytes"),
+        ("engine.cycles", "cycles"),
+        ("engine.cycle_seconds_total", "cycle_seconds"),
+    )
+
+    def _collect_stats(self):
+        """Fold the C++ loop's counters into the process-wide registry
+        (delta since the previous collect — counters stay monotonic
+        across engine generations). Locked: two concurrent snapshots
+        computing the same delta would double-count it."""
+        with self._stats_lock:
+            if self._ptr is None:
+                return
+            st = native.HvdStats()
+            self._lib.hvd_engine_get_stats(self._ptr, ctypes.byref(st))
+            for reg_name, field in self._STAT_COUNTERS:
+                value = getattr(st, field)
+                delta = value - self._last_stats.get(field, 0)
+                if delta:
+                    tele.REGISTRY.counter(reg_name).inc(delta)
+                    self._last_stats[field] = value
+            tele.REGISTRY.gauge("engine.queue_depth").set(
+                int(st.queue_depth))
+
     def _maybe_activate_negotiation(self):
         """Build the coordinator + flip the C++ loop into negotiated mode
         once a multi-controller world with a KV service is known."""
@@ -273,6 +319,8 @@ class NativeEngine:
             if "already pending" in msg:
                 raise DuplicateNameError(msg)
             raise ShutdownError(msg)
+        record_submit(op, tensor.nbytes,
+                      int(self._lib.hvd_engine_pending(self._ptr)))
         self._meta[h] = tensor.dtype
         return int(h)
 
@@ -360,6 +408,9 @@ class NativeEngine:
     def shutdown(self):
         if self._ptr is None:
             return
+        # Stop the registry syncing first: it must never read through a
+        # dead engine pointer.
+        tele.REGISTRY.unregister_sync(self._collect_stats)
         if self._param_manager is not None:
             self._param_manager.close()
         if self._coordinator is not None:
@@ -371,5 +422,9 @@ class NativeEngine:
         # still be inside hvd_engine_wait_meta, and destroying a condition
         # variable with blocked waiters is undefined behavior.
         self._lib.hvd_engine_join(self._ptr)
+        # Final telemetry fold: the loop is joined, so this captures the
+        # shutdown-drain completions/errors too (parity with the python
+        # twin, which counts them in _complete).
+        self._collect_stats()
         self._ptr = None
         self._meta.clear()

@@ -5,6 +5,7 @@ events)."""
 
 from __future__ import annotations
 
+import atexit
 import json
 import os
 import threading
@@ -40,10 +41,17 @@ class Timeline:
         self._fh = None
         self._pids = {}
         self._last_flush = 0.0
+        self._first = True
         if path:
             self._fh = open(path, "w")
             self._fh.write("[\n")
             self._start = time.monotonic()
+            # Crash-safety: a killed run leaves a truncated file. Events
+            # are separator-FIRST (no trailing comma after the last one),
+            # which the chrome/Perfetto JSON-array reader accepts without
+            # the closing ']'; a clean interpreter exit that never reached
+            # close() (engine leaked, Ctrl-C mid-run) is closed here.
+            atexit.register(self.close)
 
     @property
     def enabled(self) -> bool:
@@ -63,7 +71,12 @@ class Timeline:
         return self._pids[name]
 
     def _emit(self, ev: dict):
-        self._fh.write(json.dumps(ev) + ",\n")
+        # Separator BEFORE each event (after the first): however the
+        # process dies, the file never ends in a trailing comma, so it
+        # stays loadable in Perfetto after truncation.
+        sep = "" if self._first else ",\n"
+        self._first = False
+        self._fh.write(sep + json.dumps(ev))
         now = time.monotonic()
         if now - self._last_flush > _FLUSH_INTERVAL_S:
             self._fh.flush()
@@ -108,9 +121,15 @@ class Timeline:
         if not self.enabled:
             return
         with self._lock:
-            self._fh.write("{}]\n")
+            if self._fh is None:  # raced with another closer
+                return
+            self._fh.write("\n]\n")
             self._fh.close()
             self._fh = None
+        # Drop the crash-safety hook: without this, every engine
+        # generation's closed Timeline (and its per-tensor lane map)
+        # stays pinned by the atexit registry for process lifetime.
+        atexit.unregister(self.close)
 
 
 def timeline_path_from_env() -> Optional[str]:

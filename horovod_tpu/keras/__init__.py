@@ -14,6 +14,7 @@ from __future__ import annotations
 
 import collections.abc
 import math
+import time
 from typing import Any, Callable, Optional, Sequence
 
 import jax
@@ -35,6 +36,7 @@ from horovod_tpu.jax import (
     sharded_state_specs as _sharded_state_specs,
 )
 from horovod_tpu.jax import allreduce as _allreduce
+from horovod_tpu.core import telemetry as _tele
 from horovod_tpu.keras import callbacks  # noqa: F401
 from horovod_tpu.ops.collectives import HVD_AXIS
 from horovod_tpu.utils import checkpoint as _ckpt
@@ -338,10 +340,18 @@ class Trainer:
                 for cb in callbacks:
                     cb.on_batch_begin(b)
                 self.rng, dk = jax.random.split(self.rng)
+                t_step = time.perf_counter()
                 self.params, self.batch_stats, self.opt_state, logs = \
                     self._train_step(self.params, self.batch_stats,
                                      self.opt_state, xb, yb,
                                      jnp.float32(self.lr_scale), dk)
+                # Compiled-path telemetry: dispatch time of the whole step
+                # program (execution is async — the ring records the host
+                # cost of handing work to the runtime; wall step time
+                # shows up in the inter-dispatch cadence).
+                _tele.REGISTRY.counter("trainer.steps").inc()
+                _tele.REGISTRY.ring("trainer.step_s").push(
+                    time.perf_counter() - t_step)
                 # Prefetch: the step above dispatched asynchronously;
                 # pulling the next batch NOW overlaps its host->device
                 # transfers with the running step (the role tf.data

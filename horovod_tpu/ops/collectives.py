@@ -40,6 +40,7 @@ from horovod_tpu.common import topology as _topo
 from horovod_tpu.common.topology import HVD_AXIS
 
 from horovod_tpu.common.compat import shard_map as _shard_map
+from horovod_tpu.core import telemetry as _tele
 
 
 # Two-tier axis names, matching horovod_tpu.parallel.mesh (not imported:
@@ -386,6 +387,22 @@ def _maybe_consistency_check(op_code: int, tensor, root: int = -1,
 # Public verbs — context-polymorphic (SPMD tracer or eager host value)
 # ---------------------------------------------------------------------------
 
+def _nbytes(tensor) -> int:
+    """Host-visible byte size of an eager tensor (telemetry accounting)."""
+    try:
+        return int(np.prod(tensor.shape) if tensor.shape else 1) \
+            * np.dtype(tensor.dtype).itemsize
+    except Exception:
+        return 0
+
+
+def _record_eager(op: str, tensor, elided: bool = False):
+    """Feed the telemetry registry for one eager collective. The compiled
+    (SPMD) path deliberately records nothing here — tracing happens once,
+    and its cost story lives in the xplane capture instead."""
+    _tele.record_eager(op, _nbytes(tensor), elided=elided)
+
+
 def _localize(x):
     """Re-home an eager collective's replicated GLOBAL output as an
     ordinary process-local array. In a multi-controller world the raw
@@ -430,7 +447,10 @@ def allreduce(tensor, average: bool = True, name: Optional[str] = None):
         return _spmd_allreduce(tensor, average, ax)
     tensor = jnp.asarray(tensor)
     if _topo._require_init().size == 1:
-        return tensor  # identity — no program launch for a 1-rank world
+        # identity — no program launch for a 1-rank world
+        _record_eager("allreduce", tensor, elided=True)
+        return tensor
+    _record_eager("allreduce", tensor)
     _maybe_consistency_check(0, tensor, flags=int(average))
     return _localize(ranked_allreduce(_replicated_stack(tensor),
                                       average=average))
@@ -452,7 +472,9 @@ def allgather(tensor, name: Optional[str] = None):
     if tensor.ndim == 0:
         raise ValueError("allgather requires a tensor with at least one dimension")
     if _topo._require_init().size == 1:
+        _record_eager("allgather", tensor, elided=True)
         return tensor
+    _record_eager("allgather", tensor)
     # Allgather legitimately permits differing first dims; check the rest.
     _maybe_consistency_check(1, tensor[:0])
     st = _topo._require_init()
@@ -493,7 +515,9 @@ def broadcast(tensor, root_rank: int, name: Optional[str] = None):
         return _root_select_psum(tensor, root_rank, axis=ax)
     tensor = jnp.asarray(tensor)
     if _topo._require_init().size == 1:
+        _record_eager("broadcast", tensor, elided=True)
         return tensor
+    _record_eager("broadcast", tensor)
     _maybe_consistency_check(2, tensor, root_rank)
     return _localize(ranked_broadcast(_replicated_stack(tensor), root_rank))
 
@@ -538,7 +562,9 @@ def reducescatter(tensor, name: Optional[str] = None):
         raise ValueError(
             "reducescatter requires a tensor with at least one dimension")
     if _topo._require_init().size == 1:
+        _record_eager("reducescatter", tensor, elided=True)
         return tensor
+    _record_eager("reducescatter", tensor)
     _maybe_consistency_check(3, tensor)
     # _local_row is already process-local — no _localize round trip.
     return _local_row(ranked_reducescatter(_replicated_stack(tensor)))
@@ -556,7 +582,9 @@ def alltoall(tensor, name: Optional[str] = None):
         return lax.all_to_all(tensor, ax, split_axis=0, concat_axis=0, tiled=True)
     tensor = jnp.asarray(tensor)
     if _topo._require_init().size == 1:
+        _record_eager("alltoall", tensor, elided=True)
         return tensor
+    _record_eager("alltoall", tensor)
     _maybe_consistency_check(4, tensor)
     return _local_row(ranked_alltoall(_replicated_stack(tensor)))
 
@@ -612,7 +640,11 @@ def grouped_allreduce(tensors: Sequence, average: bool = True):
     participant, costing a full extra HBM round trip of the tensor set
     per step (measured on the one-chip bench — docs/benchmarks.md)."""
     if _topo._require_init().size == 1:
-        return [jnp.asarray(t) for t in tensors]
+        out = [jnp.asarray(t) for t in tensors]
+        for t in out:
+            if not in_spmd(t):  # tracers: trace-time, not a per-step event
+                _record_eager("allreduce", t, elided=True)
+        return out
     return _grouped_apply(lambda flat: allreduce(flat, average=average), tensors)
 
 
@@ -628,6 +660,13 @@ def broadcast_pytree(tree, root_rank: int = 0):
     one collective per dtype."""
     if _topo._require_init().size == 1:
         _check_root(root_rank)
+        for leaf in jax.tree_util.tree_leaves(tree):
+            # No jnp.asarray here: counting bytes must not device-put the
+            # whole host-side tree on the very path that elides the
+            # transfer. _nbytes reads shape/dtype only (0 for plain
+            # python scalars — an acceptable undercount).
+            if not in_spmd(leaf):  # tracers: trace-time, not per-step
+                _record_eager("broadcast", leaf, elided=True)
         return tree
     leaves, treedef = jax.tree_util.tree_flatten(tree)
     out = _grouped_apply(lambda flat: broadcast(flat, root_rank), leaves)

@@ -4,23 +4,40 @@ The reference provides this twice: a Keras callback allreducing epoch-end
 metrics (reference: horovod/_keras/callbacks.py:33-67) and a hand-rolled
 ``Metric`` class in the examples (reference:
 examples/pytorch_imagenet_resnet50.py:255-268). Both shapes are here.
+
+Metric-averaging collectives flow through :mod:`horovod_tpu.core.telemetry`
+like every other eager collective, plus a dedicated ``metrics.*`` counter
+family so "how much of my eager traffic is metrics" is answerable.
 """
 
 from __future__ import annotations
 
 import jax.numpy as jnp
 
+from horovod_tpu.core import telemetry as _tele
 from horovod_tpu.ops import collectives as _C
 
 
 class Metric:
     """Running average whose value is allreduce-averaged across ranks
-    (reference: examples/pytorch_imagenet_resnet50.py:255-268)."""
+    (reference: examples/pytorch_imagenet_resnet50.py:255-268).
+
+    ``avg`` is memoized per ``(sum, n)``: reading the property twice
+    without an intervening ``update`` fires ONE eager allreduce, not one
+    per read (a logging loop printing ``m.avg`` in two places used to pay
+    a full collective for each). Memoization is single-controller only:
+    in a multi-controller world whether the collective fires must not
+    depend on LOCAL state — with an uneven last batch, rank 0's extra
+    ``update`` would change its cache key while rank 1 serves its cache,
+    leaving a mismatched collective and a deadlocked world — so there
+    every read keeps firing (the pre-memoization contract: equal read
+    counts suffice)."""
 
     def __init__(self, name: str):
         self.name = name
         self.sum = 0.0
         self.n = 0
+        self._cache = None  # ((sum, n), value) of the last collective
 
     def update(self, value):
         self.sum += float(value)
@@ -30,8 +47,15 @@ class Metric:
     def avg(self) -> float:
         if self.n == 0:
             return 0.0
+        memoizable = _C._topo._require_init().num_processes == 1
+        if (memoizable and self._cache is not None
+                and self._cache[0] == (self.sum, self.n)):
+            return self._cache[1]
         local = self.sum / self.n
-        return float(_C.allreduce(jnp.asarray(local), average=True))
+        _tele.REGISTRY.counter("metrics.allreduces").inc()
+        val = float(_C.allreduce(jnp.asarray(local), average=True))
+        self._cache = ((self.sum, self.n), val) if memoizable else None
+        return val
 
 
 def MetricAverage(values: dict) -> dict:
@@ -41,6 +65,8 @@ def MetricAverage(values: dict) -> dict:
     if not values:
         return {}
     keys = sorted(values)
+    _tele.REGISTRY.counter("metrics.averages").inc()
+    _tele.REGISTRY.counter("metrics.averaged_values").inc(len(keys))
     stacked = jnp.asarray([float(values[k]) for k in keys], jnp.float32)
     avg = _C.allreduce(stacked, average=True)
     return {k: float(avg[i]) for i, k in enumerate(keys)}

@@ -1,0 +1,160 @@
+"""Telemetry stats CLI — the query tool over the unified registry.
+
+    python -m horovod_tpu.utils.stats <target> [--json] [--watch N]
+
+``target`` is one of:
+
+- a Prometheus-style text file written by ``HVD_TELEMETRY_FILE`` (see
+  :mod:`horovod_tpu.core.telemetry`) — parsed and pretty-printed
+  (``--watch N`` re-reads every N seconds, the poor-man's dashboard);
+- an XLA profiler capture directory (``bench.py --profile DIR``) — the
+  machine-readable HBM attribution (:func:`horovod_tpu.utils.xplane.
+  hbm_json`, the same data ``xplane --hbm --json`` emits), so bench
+  tooling never re-parses the human table;
+- ``live`` — snapshot of the *current process's* registry (only useful
+  from code/REPL in the process doing the work; cross-process use goes
+  through the exposition file).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import time
+from typing import Dict, List, Tuple
+
+_SAMPLE_RE = re.compile(
+    r"^([A-Za-z_:][A-Za-z0-9_:]*)(?:\{([^}]*)\})?\s+(-?[0-9.eE+\-infa]+)$")
+
+
+def parse_prometheus(text: str) -> List[Tuple[str, Dict[str, str], float]]:
+    """Parse exposition text into (name, labels, value) samples. Ignores
+    comments/TYPE lines and anything unparseable (forward compatible)."""
+    out = []
+    for line in text.splitlines():
+        line = line.strip()
+        if not line or line.startswith("#"):
+            continue
+        m = _SAMPLE_RE.match(line)
+        if not m:
+            continue
+        name, labels_raw, value = m.groups()
+        labels: Dict[str, str] = {}
+        if labels_raw:
+            for part in labels_raw.split(","):
+                if "=" in part:
+                    k, v = part.split("=", 1)
+                    labels[k.strip()] = v.strip().strip('"')
+        try:
+            out.append((name, labels, float(value)))
+        except ValueError:
+            continue
+    return out
+
+
+def render(samples: List[Tuple[str, Dict[str, str], float]]) -> str:
+    """Human table of parsed samples, histogram buckets folded to a
+    count+mean line (the full distribution stays in the file)."""
+    if not samples:
+        return "no samples"
+    rows = []
+    hist: Dict[str, Dict[str, float]] = {}
+    for name, labels, value in samples:
+        if name.endswith("_bucket"):
+            continue  # summarized via _sum/_count below
+        if name.endswith(("_sum", "_count")):
+            base = name.rsplit("_", 1)[0]
+            hist.setdefault(base, {})[name.rsplit("_", 1)[1]] = value
+            continue
+        label = name
+        if labels:
+            label += "{" + ",".join(f"{k}={v}"
+                                    for k, v in sorted(labels.items())) + "}"
+        rows.append((label, f"{value:g}"))
+    for base, parts in sorted(hist.items()):
+        n = parts.get("count", 0)
+        if "sum" not in parts:
+            # Not a histogram pair: a Ring exports <name>_count (+ _last/
+            # _mean gauges printed above) with no _sum — folding it into
+            # a fake "mean=0" row would contradict the real mean beside
+            # it.
+            rows.append((base + "_count", f"{n:g}"))
+            continue
+        mean = parts["sum"] / n if n else 0.0
+        rows.append((base, f"n={n:g} mean={mean:.6g}"))
+    width = max(len(r[0]) for r in rows)
+    return "\n".join(f"{label:{width}s} {value:>18s}"
+                     for label, value in sorted(rows))
+
+
+def _is_xplane_dir(target: str) -> bool:
+    if not os.path.isdir(target):
+        return False
+    from horovod_tpu.utils.profiler import trace_files
+
+    try:
+        return bool(trace_files(target))
+    except Exception:
+        return False
+
+
+def main(argv=None):
+    import argparse
+
+    ap = argparse.ArgumentParser(
+        prog="python -m horovod_tpu.utils.stats",
+        description="Query horovod_tpu telemetry: an HVD_TELEMETRY_FILE "
+                    "exposition file, an xplane capture dir, or 'live'.")
+    ap.add_argument("target",
+                    help="exposition file | xplane capture dir | 'live'")
+    ap.add_argument("--json", action="store_true",
+                    help="machine-readable output")
+    ap.add_argument("--watch", type=float, metavar="SECONDS", default=None,
+                    help="re-read the exposition file every N seconds")
+    ap.add_argument("--steps", type=int, default=1,
+                    help="steps in an xplane capture window (per-step "
+                         "attribution)")
+    args = ap.parse_args(argv)
+
+    if args.target == "live":
+        from horovod_tpu.core import telemetry
+
+        if args.json:
+            print(json.dumps(telemetry.telemetry(), default=str))
+        else:
+            print(telemetry.report())
+        return 0
+
+    if _is_xplane_dir(args.target):
+        from horovod_tpu.utils import xplane
+
+        data = xplane.hbm_json(args.target, steps=args.steps)
+        if args.json:
+            print(json.dumps(data))
+        else:
+            print(xplane.hbm_report(args.target, steps=args.steps))
+        return 0
+
+    while True:
+        try:
+            with open(args.target) as fh:
+                text = fh.read()
+        except OSError as exc:
+            print(f"cannot read {args.target}: {exc}")
+            return 1
+        samples = parse_prometheus(text)
+        if args.json:
+            print(json.dumps([
+                {"name": n, "labels": l, "value": v}
+                for n, l, v in samples]))
+        else:
+            print(render(samples))
+        if args.watch is None:
+            return 0
+        time.sleep(args.watch)
+        print()
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -353,6 +353,30 @@ def fusion_direct_bytes(logdir: str, spaces=None) -> float:
     return float(sum(cat_b.values()))
 
 
+def hbm_json(logdir: str, steps: int = 1, spaces=None) -> dict:
+    """Machine-readable form of the ``--hbm`` attribution (what
+    ``--json`` prints and what bench tooling / the stats CLI consume
+    instead of re-parsing the human table): per-op-class ms + bytes per
+    step, the async-DMA payload, the fusion direct streams, and the
+    true-traffic sum."""
+    if spaces is None:
+        spaces = _load_spaces(logdir)
+    steps = max(steps, 1)
+    dma = dma_bytes(logdir, spaces=spaces)
+    direct = fusion_direct_bytes(logdir, spaces=spaces)
+    classes = class_breakdown(logdir, steps=steps, spaces=spaces)
+    return {
+        "steps": steps,
+        "classes": classes,
+        "dma_bytes": dma["bytes"],
+        "dma_events": dma["events"],
+        "dma_busy_ms": dma["busy_ms"],
+        "fusion_direct_bytes": direct,
+        "true_hbm_bytes_per_step": (dma["bytes"] + direct) / steps,
+        "module_ms": module_ms(logdir, spaces=spaces),
+    }
+
+
 def hbm_report(logdir: str, steps: int = 1, spaces=None) -> str:
     """The measured-roofline table (docs/benchmarks.md "The ceiling,
     measured"): per-category sequencer time, schedule-derived HBM bytes
@@ -473,9 +497,17 @@ def main(argv=None):
                          "true-traffic sum, and the per-op-class "
                          "attribution (collective vs optimizer vs "
                          "conv/matmul bytes) (docs/benchmarks.md)")
+    ap.add_argument("--json", action="store_true",
+                    help="with --hbm: machine-readable attribution "
+                         "(what bench tooling and utils.stats consume)")
     args = ap.parse_args(argv)
     if args.hbm:
-        print(hbm_report(args.logdir, steps=args.steps or 1))
+        import json as _json
+
+        if args.json:
+            print(_json.dumps(hbm_json(args.logdir, steps=args.steps or 1)))
+        else:
+            print(hbm_report(args.logdir, steps=args.steps or 1))
     elif args.dma:
         spaces = _load_spaces(args.logdir)  # parse the (large) pbs once
         d = dma_bytes(args.logdir, spaces=spaces)

@@ -257,6 +257,46 @@ def main():
             assert all(lanes[ev["pid"]] == "staggered" for ev in marks)
             print(f"proc {pid}: rankready marks "
                   f"{sorted(first.items())} counts={per_proc}", flush=True)
+    elif scenario == "engine_straggler":
+        # Straggler attribution (ISSUE 2 acceptance): process 1 delays
+        # every submission ~1 s; the telemetry straggler report — fed
+        # from the negotiation round tables (the RANK_READY data) — must
+        # blame process 1 with the largest cumulative imposed wait, on
+        # EVERY process (each coordinator ticks rounds while idle, so
+        # both sides observe p0's early announcements).
+        import json as _json
+        import time
+
+        from horovod_tpu.core import engine as eng
+        from horovod_tpu.core import telemetry as tele
+
+        e = eng.get_engine()
+        for i in range(3):
+            if pid == 1:
+                time.sleep(1.0)
+            h = e.allreduce_async(f"sg/{i}", np.ones((2,), np.float32),
+                                  False)
+            np.testing.assert_allclose(
+                e.synchronize(h),
+                np.full((2,), float(local_devices * nproc)))
+        snap = tele.STRAGGLERS.snapshot()
+        assert snap["tensors"] >= 3, snap
+        waits = snap["wait_us"]
+        assert set(waits) == set(range(nproc)), waits
+        worst_pid, worst_us = tele.STRAGGLERS.worst()
+        assert worst_pid == 1, (worst_pid, waits)
+        # 3 submissions x ~1 s delay each; generous floor for CI jitter.
+        assert worst_us > 1.5e6, waits
+        assert waits[0] < worst_us / 4, waits
+        # The aggregated class blames the same process, and the stall/
+        # report surfaces name it.
+        assert snap["by_class"]["sg/#"][1] == worst_us, snap
+        assert any("process 1" in ln
+                   for ln in tele.STRAGGLERS.report_lines())
+        # hvd.telemetry() folds the same data in.
+        assert hvd.telemetry()["straggler"]["wait_us"][1] == worst_us
+        print(f"proc {pid}: STRAGGLER " + _json.dumps(
+            {str(p): us for p, us in sorted(waits.items())}), flush=True)
     elif scenario == "engine_peer_shutdown":
         # Cooperative shutdown propagation (reference: shutdown flag in the
         # request list → SHUT_DOWN_ERROR for stragglers,

@@ -140,6 +140,17 @@ def test_two_process_stall_names_missing_process(engine):
                for out in outs), outs[0][-3000:]
 
 
+@pytest.mark.parametrize("engine", ENGINES)
+def test_two_process_straggler_report_blames_delayed_rank(engine):
+    """Per-rank wait attribution (core/telemetry.py StragglerTracker, fed
+    from the negotiation round tables): with process 1 artificially
+    delayed, the straggler report names it with the largest cumulative
+    imposed wait — on every process (ISSUE 2 acceptance)."""
+    outs = _run_world("engine_straggler",
+                      extra_env={"HVD_ENGINE": engine})
+    assert sum("STRAGGLER" in out for out in outs) == 2, outs[0][-3000:]
+
+
 @pytest.mark.parametrize("engine", ["cpp", "python"])
 def test_two_process_negotiation_rankready_marks(engine):
     """NEGOTIATE_* spans carry per-process RANK_READY instants naming who

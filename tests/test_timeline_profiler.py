@@ -143,6 +143,61 @@ def test_fused_timeline_covers_declared_vocabulary(hvd, tmp_path, impl):
         a for a, _, _ in summary["t/plug"]}
 
 
+def test_timeline_truncation_safe(hvd, tmp_path):
+    """Crash-safety (ISSUE 2 satellite): a killed run leaves no closing
+    ']' — the writer's separator-first style must leave no trailing comma
+    either, so the file still loads after appending the bracket (what
+    Perfetto's tolerant JSON-array reader does). Both writers."""
+    from horovod_tpu.core.engine import Engine
+    from horovod_tpu.core.native_engine import NativeEngine
+    from horovod_tpu.core.timeline import Timeline
+
+    py_path = str(tmp_path / "py_trunc.json")
+    t = Timeline(py_path)
+    t.start("t/x", "QUEUE")
+    t.end("t/x", "QUEUE")
+    t._fh.flush()
+    # Simulate SIGKILL: read the file WITHOUT close().
+    raw = open(py_path).read()
+    assert not raw.rstrip().endswith(",")
+    events = json.loads(raw + "]")
+    assert any(ev.get("name") == "QUEUE" for ev in events)
+    t.close()  # idempotent clean close still yields valid JSON
+    events = json.load(open(py_path))
+    assert any(ev.get("name") == "QUEUE" for ev in events)
+    t.close()  # second close is a no-op
+
+    # The C++ writer flushes on its 1 s horizon at event boundaries, so a
+    # mid-run snapshot (the SIGKILL view) is a complete-event prefix with
+    # no trailing comma and no ']'.
+    import time
+
+    cpp_path = str(tmp_path / "cpp_trunc.json")
+    e = NativeEngine(timeline_path=cpp_path)
+    try:
+        e.synchronize(
+            e.allreduce_async("t/c0", np.ones((4,), np.float32), False))
+        time.sleep(1.2)  # cross the flush horizon on the next emit
+        e.synchronize(
+            e.allreduce_async("t/c1", np.ones((4,), np.float32), False))
+        raw = open(cpp_path).read()
+        assert raw.strip() != "[", "flush horizon not crossed"
+        assert not raw.rstrip().endswith(",")
+        assert json.loads(raw + "]")  # loadable after truncation
+    finally:
+        e.shutdown()
+    events = json.load(open(cpp_path))
+    assert any(ev.get("name") == "QUEUE" for ev in events)
+
+    # Python Engine.shutdown closes the timeline it owns (no leak).
+    leak_path = str(tmp_path / "owned.json")
+    eng = Engine(timeline=Timeline(leak_path))
+    eng.synchronize(
+        eng.allreduce_async("t/p", np.ones((2,), np.float32), False))
+    eng.shutdown()
+    assert json.load(open(leak_path))
+
+
 def test_profiler_capture_produces_trace(hvd, tmp_path):
     import jax
 
@@ -241,6 +296,31 @@ def test_xplane_hbm_accounting_on_synthetic_capture(tmp_path):
     # steps divides evenly into per-step figures.
     half = xp.class_breakdown(logdir, steps=2)
     assert half["collective"]["bytes"] == 128 * 4
+
+    # Machine-readable attribution (ISSUE 2 satellite): --json carries
+    # the same numbers as the human table, and the stats CLI consumes a
+    # capture dir through the same helper instead of re-parsing text.
+    data = xp.hbm_json(logdir, steps=1)
+    assert data["classes"]["collective"]["bytes"] == 2 * 128 * 4
+    assert data["dma_bytes"] == 256 * 4
+    assert data["true_hbm_bytes_per_step"] == \
+        data["dma_bytes"] + data["fusion_direct_bytes"]
+    assert data["module_ms"] == pytest.approx(9.0)
+    import io
+    import json as _json
+    from contextlib import redirect_stdout
+
+    buf = io.StringIO()
+    with redirect_stdout(buf):
+        xp.main([logdir, "--hbm", "--json"])
+    assert _json.loads(buf.getvalue()) == _json.loads(_json.dumps(data))
+
+    from horovod_tpu.utils import stats
+
+    buf = io.StringIO()
+    with redirect_stdout(buf):
+        assert stats.main([logdir, "--json"]) == 0
+    assert _json.loads(buf.getvalue())["dma_bytes"] == 256 * 4
 
     # Shape parsing corner cases.
     assert xp._first_shape_bytes("%x = pred[3]{0} y(pred[3] %a)") == 3
