@@ -415,6 +415,30 @@ def main():
         result["telemetry"] = _telemetry.compact()
     except Exception as e:  # pragma: no cover - never fail the bench line
         print(f"# telemetry unavailable: {e}", file=sys.stderr)
+    # Distributed tracing: with HVD_TIMELINE set, report the merged
+    # per-rank trace path. Collected POST-window (the AOT hot path
+    # carries no timeline instrumentation — only the engines' host-side
+    # spans land in it), and strictly best-effort.
+    import os as _os
+
+    tl_env = (_os.environ.get("HVD_TIMELINE")
+              or _os.environ.get("HOROVOD_TIMELINE"))
+    if tl_env:
+        try:
+            from horovod_tpu.core import engine as _eng
+
+            if _eng._engine is not None:
+                _eng.shutdown_engine()  # close per-rank files for merge
+            from horovod_tpu.core import timeline as _tl
+
+            if _tl.is_dir_mode(tl_env):
+                from horovod_tpu.utils import trace as _trace
+
+                result["trace"] = _trace.merge(tl_env)["path"]
+            elif _os.path.exists(tl_env):
+                result["trace"] = tl_env  # single-file spelling
+        except Exception as e:  # pragma: no cover - never fail the bench
+            print(f"# trace merge unavailable: {e}", file=sys.stderr)
     print(json.dumps(result))
     print(f"# {nchips} chip(s), spread {min(rates):.0f}-{max(rates):.0f} "
           f"img/sec over {args.num_iters} iters, "

@@ -115,6 +115,19 @@ class PeerShutdown(Exception):
         self.process = process
 
 
+def is_shutdownish(exc: Exception) -> bool:
+    """True when a negotiation failure means a CLEAN shutdown (peer
+    tombstone or local teardown) rather than a fault. Both engines rate
+    the same messages the same way — post-poison rounds re-raise
+    KVError(dead) whose TEXT still names the original cause, so the
+    check is by substring, and the flight recorder is only dumped for
+    the non-clean cases."""
+    msg = str(exc)
+    return (isinstance(exc, PeerShutdown)
+            or "shut down" in msg       # peer tombstone
+            or "shutting down" in msg)  # local shutdown
+
+
 class NegotiationTimeout(Exception):
     def __init__(self, process: int, waited_s: float):
         super().__init__(
@@ -371,6 +384,19 @@ class Coordinator:
         # next instance is charged afresh).
         self._announce: Dict[str, Dict[int, float]] = {}
         self._blamed: set = set()
+        # Clock-anchor exchange (distributed tracing): once ready,
+        # clock_offset_us is rank 0's wall↔monotonic bridge — the common
+        # time base every per-rank timeline embeds — and clock_rtt_us is
+        # the measured KV round trip bounding the estimate's error
+        # (Cristian-style; on one host the shared CLOCK_MONOTONIC makes
+        # the bridge exact). The exchange is non-blocking: it retries at
+        # round granularity until rank 0's anchor appears.
+        self.clock_offset_us = 0
+        self.clock_rtt_us: Optional[int] = None
+        self.clock_ready = False
+        self._clock_attempts = 0
+        self._clock_published = False
+        self._clock_anchor: Optional[Tuple[float, float]] = None
 
     # -- keys ---------------------------------------------------------------
 
@@ -382,6 +408,9 @@ class Coordinator:
 
     def _tomb_key(self, pid: int) -> str:
         return f"{self.ns}/dead/p{pid}"
+
+    def _clock_key(self, pid: int) -> str:
+        return f"{self.ns}/clock/p{pid}"
 
     # -- lifecycle ----------------------------------------------------------
 
@@ -401,6 +430,7 @@ class Coordinator:
         self._closed = True
         with _residue_lock:
             _residue.append((self.ns, self._tomb_key(self.pid)))
+            _residue.append((self.ns, self._clock_key(self.pid)))
             _residue.append((self.ns, self._round_key(self.round, self.pid)))
             if self.round > 0:
                 _residue.append(
@@ -414,6 +444,49 @@ class Coordinator:
             self.kv.set(self._tomb_key(self.pid), str(self.round))
         except Exception:
             pass  # coordination service may already be down at exit
+
+    # -- clock-anchor exchange (distributed tracing) ------------------------
+
+    def _maybe_clock_sync(self):
+        """Exchange monotonic-clock anchors so per-rank timelines merge on
+        a common base (Cristian-style through the KV store). Each process
+        publishes ``(wall, monotonic)`` captured at one instant — a
+        timeless mapping between its two clocks — and adopts rank 0's
+        wall↔monotonic bridge as the common-base offset. The residual
+        error is the inter-host wall-clock skew plus the measured KV
+        round trip recorded as the bound; same-host processes share
+        CLOCK_MONOTONIC, making the bridge exact. Non-blocking: retried
+        once per round until rank 0's anchor appears, then never again."""
+        if self.clock_ready or self._clock_attempts >= 16:
+            return
+        self._clock_attempts += 1
+        try:
+            if not self._clock_published:
+                self._clock_anchor = (time.time(), time.monotonic())
+                self.kv.set(self._clock_key(self.pid),
+                            json.dumps(list(self._clock_anchor)))
+                self._clock_published = True
+            if self.pid == 0:
+                wall0, mono0 = self._clock_anchor
+            else:
+                raw = self.kv.try_get(self._clock_key(0))
+                if raw is None:
+                    return  # rank 0 not up yet — retry next round
+                wall0, mono0 = json.loads(raw)
+            # The measured KV round trip (the error bound): ONE blocking
+            # read of a key we just proved exists — our own anchor.
+            # Runs exactly once, on the attempt that completes the sync,
+            # with a sub-second cap: a degraded KV store must not stack
+            # multi-second probes onto the negotiation round path for a
+            # telemetry-only bound (the bound is then simply absent).
+            t0 = time.monotonic()
+            self.kv.get(self._clock_key(self.pid), 0.9)
+            rtt_us = int((time.monotonic() - t0) * 1e6)
+            self.clock_offset_us = int((wall0 - mono0) * 1e6)
+            self.clock_rtt_us = rtt_us
+            self.clock_ready = True
+        except (KVTimeout, KVError, ValueError, TypeError):
+            pass  # purely additive — never fail a round over clock sync
 
     # -- the round ----------------------------------------------------------
 
@@ -485,6 +558,7 @@ class Coordinator:
         engine's negotiated path."""
         if self.dead:
             raise KVError(self.dead)
+        self._maybe_clock_sync()
         t_round = time.monotonic()
         rnd = self.round
         msg = {"entries": [m.wire() for m in entries]}

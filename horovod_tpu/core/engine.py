@@ -320,6 +320,12 @@ class Engine:
         self._coord_unavailable = False
         self._negotiating: list = []
         self._extra_wait = 0.0
+        # Clock-anchor sync emitted into the timeline once the
+        # coordinator's exchange completes (distributed tracing).
+        self._clock_synced = False
+        # Post-mortem hook: SIGUSR1 dumps the flight recorder of a live
+        # (possibly hung) run — no env var needed.
+        tl.install_sigusr1(self._dump_flight)
         self._thread = threading.Thread(
             target=self._loop, name="hvd-background", daemon=True
         )
@@ -432,8 +438,24 @@ class Engine:
                 return out
 
     def _drain_with_error(self, err: Exception):
-        for e in self._drain():
+        entries = self._drain()
+        if entries:
+            # Work died in the queue (shutdown with requests outstanding,
+            # poisoned engine): leave a post-mortem trace of the last N
+            # events alongside the error the callers will see.
+            self._dump_flight(
+                f"drained {len(entries)} pending entr"
+                f"{'y' if len(entries) == 1 else 'ies'} with error: {err}")
+        for e in entries:
             self._complete(e, None, err)
+
+    def _dump_flight(self, reason: str):
+        """Dump the flight recorder (+ telemetry snapshot) — called on
+        stalls, failed negotiations, shutdown-drained work and SIGUSR1.
+        Never raises: post-mortem reporting must not take the engine
+        down."""
+        tl.dump_and_warn(self.timeline.recent(), reason,
+                         self.timeline.rank, LOG)
 
     def set_params(self, cycle_time_s: Optional[float] = None,
                    fusion_threshold: Optional[int] = None):
@@ -507,26 +529,33 @@ class Engine:
             tele.REGISTRY.histogram("engine.negotiation_s").observe(
                 time.monotonic() - t_neg)
         except Exception as exc:
-            # Post-poison rounds re-raise KVError(self.dead) whose message
-            # still names the peer shutdown — map by substring exactly like
-            # the native engine does (native_engine.synchronize), so both
-            # twins raise ShutdownError for every completion after a peer
-            # shut down, not just the first batch.
+            # Both twins raise ShutdownError for every completion after a
+            # peer shut down, not just the first batch (the shared
+            # predicate rates post-poison re-raises by message text).
             msg = str(exc)
-            shutdownish = (isinstance(exc, coord.PeerShutdown)
-                           or "shut down" in msg       # peer tombstone
-                           or "shutting down" in msg)  # local shutdown
+            shutdownish = coord.is_shutdownish(exc)
             err = ShutdownError(msg) if shutdownish else EngineError(msg)
             for e in self._negotiating:
                 self.timeline.end(e.name, f"NEGOTIATE_{e.op.upper()}")
                 self._complete(e, None, err)
             self._negotiating.clear()
+            if not shutdownish:
+                # A hung negotiation (timeout, KV failure) is exactly the
+                # post-mortem the flight recorder exists for; a clean
+                # peer/local shutdown is not.
+                self._dump_flight(f"negotiation failed: {msg}")
             return
+        if c.clock_ready and not self._clock_synced:
+            # The anchor exchange completed: embed rank 0's clock bridge
+            # (+ the measured KV round trip) in this rank's trace so the
+            # merge tool can align every rank on one time base.
+            self._clock_synced = True
+            self.timeline.clock_sync(c.clock_offset_us, c.clock_rtt_us)
         self.cycle_time_s = decision.cycle_time_s or self.cycle_time_s
         if decision.fusion_threshold is not None:
             self.fusion_threshold = decision.fusion_threshold
         self._extra_wait = decision.idle_backoff_s
-        if self.timeline.enabled and c.last_tables:
+        if c.last_tables:
             # Per-process readiness instants inside the NEGOTIATE_* span
             # (reference: timeline.cc:106-130) — the trace names who was
             # late, not just that negotiation was long.
@@ -746,6 +775,9 @@ class Engine:
                 "broadcast but have not completed for over %ds: %s",
                 int(self.stall_warning_s), names,
             )
+            # Post-mortem: the stalled world's last N events + telemetry,
+            # dumped while the dispatch thread may itself be hung.
+            self._dump_flight(f"stalled tensors: {names}")
 
     def shutdown(self):
         # Publish the shutdown tombstone first: peers blocked mid-round on
@@ -772,6 +804,9 @@ class Engine:
                 h.error = ShutdownError("Horovod engine has been shut down")
                 h.event.set()
         self.timeline.close()
+        # A later SIGUSR1 must dump a LIVE engine's ring, not this dead
+        # one's — and the module-global handler state must not pin us.
+        tl.uninstall_sigusr1(self._dump_flight)
 
 
 _engine: Optional[Engine] = None

@@ -152,6 +152,13 @@ def load_library():
                                          ctypes.POINTER(HvdStats)]
     lib.hvd_engine_timeline_instant.argtypes = [
         ctypes.c_void_p, ctypes.c_char_p, ctypes.c_char_p, ctypes.c_char_p]
+    lib.hvd_engine_timeline_meta.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.c_char_p]
+    lib.hvd_engine_timeline_now.restype = ctypes.c_longlong
+    lib.hvd_engine_timeline_now.argtypes = [ctypes.c_void_p]
+    lib.hvd_engine_recent_events.restype = ctypes.c_longlong
+    lib.hvd_engine_recent_events.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.c_longlong]
     lib.hvd_engine_shutdown.argtypes = [ctypes.c_void_p]
     lib.hvd_engine_join.argtypes = [ctypes.c_void_p]
     lib.hvd_engine_destroy.argtypes = [ctypes.c_void_p]

@@ -168,6 +168,15 @@ std::string JsonEscape(const std::string& s) {
 
 class Timeline {
  public:
+  Timeline() {
+    // Clock base + flight-recorder capacity are live even with no file:
+    // NowUs() must answer with the real clock (retro-span boundaries)
+    // and the ring records the last-N events for post-mortem dumps.
+    const char* cap = getenv("HVD_FLIGHT_RECORDER_SIZE");
+    ring_cap_ = cap ? atoll(cap) : 512;
+    if (ring_cap_ < 16) ring_cap_ = 16;
+  }
+
   void Initialize(const std::string& path) {
     if (path.empty()) return;
     std::lock_guard<std::mutex> g(mu_);
@@ -175,7 +184,9 @@ class Timeline {
     if (file_.good()) {
       file_ << "[\n";
       active_ = true;
-      start_ = Clock::now();
+      // start_ stays at construction time: the ring may already hold
+      // events, and every clock consumer (NowUs readback, the ring, the
+      // file) must share one base.
     }
   }
 
@@ -202,9 +213,9 @@ class Timeline {
     Emit(name, phase, 'E', "", ts_us);
   }
 
-  long long NowUs() {
-    return active_ ? (long long)(SecondsSince(start_) * 1e6) : 0;
-  }
+  // Always the real clock, file or no file (a timeline enabled mid-run
+  // must never hand callers zero/negative retro timestamps).
+  long long NowUs() { return (long long)(SecondsSince(start_) * 1e6); }
 
   // Zero-duration mark on the tensor's lane (chrome 'i' event) — e.g.
   // RANK_READY instants inside a NEGOTIATE_* span (reference: the
@@ -212,6 +223,49 @@ class Timeline {
   void Instant(const std::string& name, const char* phase,
                const std::string& args = "") {
     Emit(name, phase, 'i', args, -1);
+  }
+
+  // Metadata event on pid 0 (HVD_CLOCK and kin): the clock-sync record
+  // the merge tool reads. `args` is a pre-rendered JSON object body.
+  void Meta(const std::string& name, const std::string& args) {
+    std::lock_guard<std::mutex> g(mu_);
+    long long ts = (long long)(SecondsSince(start_) * 1e6);
+    // Pinned metadata ring: the HVD_CLOCK mapping must never be evicted
+    // by span events — every flight dump carries it (newest last).
+    meta_ring_.push_back(Rec{ts, 'M', "", name, args});
+    if (meta_ring_.size() > 16) meta_ring_.pop_front();
+    if (!active_) return;
+    Sep();
+    file_ << "{\"name\":\"" << JsonEscape(name) << "\",\"ph\":\"M\",\"pid\":0";
+    if (!args.empty()) file_ << ",\"args\":{" << args << "}";
+    file_ << "}";
+    MaybeFlush();
+  }
+
+  // Flight recorder export: the ring as a JSON array of
+  // {"name": activity, "ph": .., "ts": .., "tensor": .., "args": {..}} —
+  // the same event shape the Python twin's Timeline.recent() returns.
+  std::string RecentJson() {
+    std::lock_guard<std::mutex> g(mu_);
+    std::string out = "[";
+    bool first = true;
+    std::deque<Rec> all(meta_ring_);  // pinned metadata leads the dump
+    all.insert(all.end(), ring_.begin(), ring_.end());
+    for (auto& r : all) {
+      if (!first) out += ",";
+      first = false;
+      out += "{\"name\":\"";
+      out += JsonEscape(r.phase);
+      out += "\",\"ph\":\"";
+      out += r.ph;
+      out += "\",\"ts\":" + std::to_string(r.ts);
+      if (!r.tensor.empty())
+        out += ",\"tensor\":\"" + JsonEscape(r.tensor) + "\"";
+      if (!r.args.empty()) out += ",\"args\":{" + r.args + "}";
+      out += "}";
+    }
+    out += "]";
+    return out;
   }
 
   void Close() {
@@ -223,6 +277,18 @@ class Timeline {
   }
 
  private:
+  struct Rec {
+    long long ts;
+    char ph;
+    std::string tensor, phase, args;
+  };
+
+  void Record(long long ts, char ph, const std::string& tensor,
+              const std::string& phase, const std::string& args) {
+    ring_.push_back(Rec{ts, ph, tensor, phase, args});
+    if ((long long)ring_.size() > ring_cap_) ring_.pop_front();
+  }
+
   void Sep() {
     if (first_) {
       first_ = false;
@@ -231,13 +297,22 @@ class Timeline {
     }
   }
 
+  void MaybeFlush() {
+    // 1 s flush horizon like the reference (timeline.h:32).
+    if (SecondsSince(last_flush_) > 1.0) {
+      file_.flush();
+      last_flush_ = Clock::now();
+    }
+  }
+
   void Emit(const std::string& name, const char* phase, char ph,
             const std::string& args, long long ts_us) {
-    if (!active_) return;
     std::lock_guard<std::mutex> g(mu_);
-    if (!active_) return;
     long long ts =
         ts_us >= 0 ? ts_us : (long long)(SecondsSince(start_) * 1e6);
+    // Flight recorder: always on, bounded, never touches disk.
+    Record(ts, ph, name, phase, args);
+    if (!active_) return;
     int pid;
     auto it = lanes_.find(name);
     if (it == lanes_.end()) {
@@ -255,17 +330,15 @@ class Timeline {
     if (ph == 'i') file_ << ",\"s\":\"p\"";  // instant scope: process
     if (!args.empty()) file_ << ",\"args\":{" << args << "}";
     file_ << "}";
-    // 1 s flush horizon like the reference (timeline.h:32).
-    if (SecondsSince(last_flush_) > 1.0) {
-      file_.flush();
-      last_flush_ = Clock::now();
-    }
+    MaybeFlush();
   }
 
   std::mutex mu_;
   std::ofstream file_;
   std::unordered_map<std::string, int> lanes_;
-  Clock::time_point start_, last_flush_ = Clock::now();
+  Clock::time_point start_ = Clock::now(), last_flush_ = Clock::now();
+  std::deque<Rec> ring_, meta_ring_;
+  long long ring_cap_ = 512;
   bool active_ = false;
   bool first_ = true;
 };
@@ -423,7 +496,7 @@ class Engine {
     stats_.submitted_bytes += (long long)e.data.size();
     handles_[e.handle] = std::make_shared<HandleState>();
     long long h = e.handle;
-    if (timeline_.Active()) timeline_.Begin(e.name, "QUEUE");
+    timeline_.Begin(e.name, "QUEUE");  // ring records even with no file
     queue_.push_back(std::move(e));
     lk.unlock();
     cv_.notify_all();
@@ -517,8 +590,26 @@ class Engine {
   // RANK_READY marks here — the negotiation tables live python-side).
   void TimelineInstant(const char* name, const char* phase,
                        const char* args) {
-    if (timeline_.Active())
-      timeline_.Instant(name, phase, args ? args : "");
+    timeline_.Instant(name, phase, args ? args : "");
+  }
+
+  void TimelineMeta(const char* name, const char* args) {
+    timeline_.Meta(name ? name : "", args ? args : "");
+  }
+
+  long long TimelineNow() { return timeline_.NowUs(); }
+
+  // Flight-recorder export: writes the ring as a NUL-terminated JSON
+  // array into `out`. Returns bytes written, or the required size
+  // (> cap) when the buffer is too small — the caller retries bigger.
+  long long RecentEvents(char* out, long long cap) {
+    std::string s = timeline_.RecentJson();
+    if ((long long)s.size() + 1 > cap) {
+      if (cap > 0) out[0] = 0;
+      return (long long)s.size() + 1;
+    }
+    memcpy(out, s.c_str(), s.size() + 1);
+    return (long long)s.size();
   }
 
  private:
@@ -575,7 +666,7 @@ class Engine {
 
   void FailAllNegotiating(const std::string& msg) {
     for (auto& e : negotiating_) {
-      if (timeline_.Active()) timeline_.End(e.name, NegPhase(e.op));
+      timeline_.End(e.name, NegPhase(e.op));
       Complete(e, nullptr, 0, nullptr, msg.c_str());
     }
     negotiating_.clear();
@@ -587,7 +678,7 @@ class Engine {
   void NegotiateCycle(std::deque<Entry>& fresh) {
     Clock::time_point t0 = Clock::now();
     for (auto& e : fresh) {
-      if (timeline_.Active()) timeline_.Begin(e.name, NegPhase(e.op));
+      timeline_.Begin(e.name, NegPhase(e.op));
       negotiating_.push_back(std::move(e));
     }
     if (neg_poisoned_) {
@@ -705,8 +796,7 @@ class Engine {
         group.push_back(&negotiating_[idx]);
       }
       if (bad || group.empty()) continue;  // malformed line: leave pending
-      for (auto* e : group)
-        if (timeline_.Active()) timeline_.End(e->name, NegPhase(e->op));
+      for (auto* e : group) timeline_.End(e->name, NegPhase(e->op));
       if (kind == 'e') {
         for (auto* e : group)
           Complete(*e, nullptr, 0, nullptr,
@@ -821,11 +911,11 @@ class Engine {
     std::vector<char> fused((size_t)(total * itemsize));
     long long off = 0;
     for (auto* e : batch) {
-      if (timeline_.Active() && batch.size() > 1)
+      if (batch.size() > 1)
         timeline_.Begin(e->name, "MEMCPY_IN_FUSION_BUFFER");
       memcpy(fused.data() + off, e->data.data(), e->data.size());
       off += (long long)e->data.size();
-      if (timeline_.Active() && batch.size() > 1)
+      if (batch.size() > 1)
         timeline_.End(e->name, "MEMCPY_IN_FUSION_BUFFER");
     }
     hvd_request req{};
@@ -842,7 +932,7 @@ class Engine {
     hvd_result res{};
     long long t0 = timeline_.NowUs();
     int rc = CallExecutor(&req, &res);
-    if (timeline_.Active()) {
+    {
       // WAIT_FOR_DATA = the host->device staging slice the executor
       // measured; the rest of the round-trip is the collective proper
       // (reference: operations.cc:783-807 then the MPI/NCCL op).
@@ -895,7 +985,7 @@ class Engine {
     hvd_result res{};
     long long t0 = timeline_.NowUs();
     int rc = CallExecutor(&req, &res);
-    if (timeline_.Active()) {
+    {
       long long t1 = timeline_.NowUs();
       long long split = t0 + (long long)(res.stage_s * 1e6);
       if (split > t1) split = t1;
@@ -933,13 +1023,13 @@ class Engine {
     if (error) {
       hs->error = error;
     } else {
-      bool trace_copy = copy_phase && timeline_.Active();
+      bool trace_copy = copy_phase != nullptr;
       if (trace_copy) timeline_.Begin(e.name, copy_phase);
       hs->result.assign(data, data + nbytes);
       if (shape) hs->shape = *shape;
       if (trace_copy) timeline_.End(e.name, copy_phase);
     }
-    if (timeline_.Active()) timeline_.End(e.name, "QUEUE");
+    timeline_.End(e.name, "QUEUE");
     {
       std::lock_guard<std::mutex> g(mu_);
       hs->done = true;
@@ -1091,6 +1181,18 @@ void hvd_engine_get_stats(void* e, hvd_engine_stats* out) {
 void hvd_engine_timeline_instant(void* e, const char* name,
                                  const char* phase, const char* args) {
   static_cast<Engine*>(e)->TimelineInstant(name, phase, args);
+}
+
+void hvd_engine_timeline_meta(void* e, const char* name, const char* args) {
+  static_cast<Engine*>(e)->TimelineMeta(name, args);
+}
+
+long long hvd_engine_timeline_now(void* e) {
+  return static_cast<Engine*>(e)->TimelineNow();
+}
+
+long long hvd_engine_recent_events(void* e, char* out, long long cap) {
+  return static_cast<Engine*>(e)->RecentEvents(out, cap);
 }
 
 void hvd_engine_shutdown(void* e) { static_cast<Engine*>(e)->Shutdown(); }

@@ -12,9 +12,12 @@ common/common.h:77-110.
 from __future__ import annotations
 
 import ctypes
+import json
+import logging
 import os
 import threading
-from typing import Optional
+import time
+from typing import List, Optional
 
 import numpy as np
 
@@ -51,6 +54,14 @@ _DTYPE_CODE = {dt: i for i, dt in enumerate(_DTYPES)}
 
 _OPS = {"allreduce": 0, "allgather": 1, "broadcast": 2}
 _OPS_INV = {v: k for k, v in _OPS.items()}
+
+LOG = logging.getLogger("horovod_tpu.native_engine")
+
+
+def _args_body(d: dict) -> bytes:
+    """Render a dict as the brace-less JSON object body the C++ timeline
+    hooks expect (they wrap it in ``{"args":{...}}`` themselves)."""
+    return json.dumps(d)[1:-1].encode()
 
 
 def _write_cstring(lib, out_pp, text: bytes):
@@ -91,7 +102,13 @@ def _make_negotiator(engine):
             decision = c.negotiate(metas)
             tele.REGISTRY.histogram("engine.negotiation_s").observe(
                 time.monotonic() - t_neg)
-            if engine._timeline_on and c.last_tables:
+            if c.clock_ready and not engine._clock_synced:
+                # Anchor exchange complete: embed rank 0's clock bridge
+                # (+ the measured KV round trip) in the trace metadata
+                # so per-rank files merge on one time base.
+                engine._clock_synced = True
+                engine._emit_clock_meta(c.clock_offset_us, c.clock_rtt_us)
+            if c.last_tables:
                 # Per-process readiness instants inside the NEGOTIATE_*
                 # span (reference: timeline.cc:106-130): the C++ writer
                 # owns the file, the tables live here — mark through the
@@ -130,7 +147,13 @@ def _make_negotiator(engine):
             _write_cstring(lib, out_pp, "\n".join(lines).encode())
             return 0
         except Exception as exc:  # peer shutdown / timeout / KV failure
-            _write_cstring(lib, out_pp, str(exc).encode()[:4000])
+            msg = str(exc)
+            if not coord.is_shutdownish(exc):
+                # A hung negotiation (timeout, KV failure) gets the
+                # post-mortem flight-recorder dump; a clean peer/local
+                # shutdown does not — same rule as the python twin.
+                engine._dump_flight(f"negotiation failed: {msg}")
+            _write_cstring(lib, out_pp, msg.encode()[:4000])
             return 1
 
     return neg
@@ -214,7 +237,6 @@ class NativeEngine:
 
         self._lib = native.load_library()
         self._executor = executor or JaxExecutor()
-        self._timeline_on = bool(timeline_path)
         self._ready_marked: dict = {}  # name -> processes marked RANK_READY
         if timeline_path:
             # Staging time feeds the WAIT_FOR_DATA spans; only measured
@@ -225,6 +247,16 @@ class NativeEngine:
             float(self.cycle_time_s), int(self.fusion_threshold),
             float(stall_warning_s), timeline_path.encode())
         self._lib.hvd_engine_set_executor(self._ptr, self._cb, None)
+        # Distributed-tracing clock metadata: map the C++ timeline clock
+        # (trace ts 0) onto the wall clock and record this process's
+        # wall↔monotonic bridge as the default common-base offset (see
+        # core/timeline.py HVD_CLOCK); replaced by rank 0's bridge once
+        # the coordinator's anchor exchange completes.
+        self._rank = tl._process_index()
+        self._clock_synced = False
+        self._emit_clock_meta(None, None)
+        # Post-mortem hook: SIGUSR1 dumps the C++ flight-recorder ring.
+        tl.install_sigusr1(self._dump_flight)
         # Negotiated multi-controller path: register the control-plane
         # trampoline; it is activated lazily once topology knows several
         # processes exist (set_params is re-applied at hvd.init()).
@@ -252,6 +284,16 @@ class NativeEngine:
         self._last_stats: dict = {}
         self._stats_lock = threading.Lock()
         tele.REGISTRY.register_sync(self._collect_stats)
+
+        # Stall post-mortem parity with the python twin's _check_stalls:
+        # the C++ watchdog prints the warning, this thread dumps the
+        # flight recorder when in-flight work stops making progress.
+        self._stall_stop = threading.Event()
+        if stall_warning_s > 0:
+            self._stall_thread = threading.Thread(
+                target=self._stall_dump_loop,
+                name="hvd-native-stall-dump", daemon=True)
+            self._stall_thread.start()
 
     # Registry counter name <- HvdStats field (the parity contract with
     # the python engine's record_* helpers in core/engine.py).
@@ -283,6 +325,89 @@ class NativeEngine:
                     self._last_stats[field] = value
             tele.REGISTRY.gauge("engine.queue_depth").set(
                 int(st.queue_depth))
+
+    def _emit_clock_meta(self, offset_us: Optional[int],
+                         rtt_us: Optional[int]):
+        """Write an HVD_CLOCK metadata event through the C++ timeline.
+        ``offset_us=None`` means 'use this process's own wall↔monotonic
+        bridge' (the single-host-exact default); the coordinator's anchor
+        exchange later supplies rank 0's bridge + the measured KV round
+        trip. The merge tool uses the LAST HVD_CLOCK event per trace."""
+        if self._ptr is None:
+            return
+        now_us = int(self._lib.hvd_engine_timeline_now(self._ptr))
+        wall = time.time()
+        mono = time.monotonic()
+        args = {"rank": self._rank,
+                "epoch_wall_us": int(wall * 1e6) - now_us,
+                "offset_us": (int((wall - mono) * 1e6)
+                              if offset_us is None else int(offset_us))}
+        if rtt_us is not None:
+            args["rtt_us"] = int(rtt_us)
+        self._lib.hvd_engine_timeline_meta(
+            self._ptr, tl.CLOCK_SYNC.encode(), _args_body(args))
+
+    def recent_events(self) -> List[dict]:
+        """The C++ engine's flight-recorder ring (always on, bounded by
+        HVD_FLIGHT_RECORDER_SIZE) — same event shape as the python
+        twin's ``Timeline.recent()``."""
+        ptr = self._ptr  # snapshot: a racing shutdown() nulls the attr,
+        # but the engine object itself is deliberately leaked, so a
+        # captured pointer stays valid for the whole call.
+        if ptr is None:
+            return []
+        cap = 1 << 20
+        while True:
+            buf = ctypes.create_string_buffer(cap)
+            n = self._lib.hvd_engine_recent_events(ptr, buf, cap)
+            if n <= cap:
+                return json.loads(buf.value.decode() or "[]")
+            cap = int(n) + 1  # ring grew past the buffer — retry sized
+
+    def _dump_flight(self, reason: str):
+        """Dump the C++ ring (+ telemetry snapshot) — stalls,
+        negotiation failures and SIGUSR1 route here. Never raises."""
+        try:
+            events = self.recent_events()
+        except Exception:
+            events = []
+        tl.dump_and_warn(events, reason, self._rank, LOG)
+
+    def _stall_dump_loop(self):
+        """Dump the flight recorder when tensors sit in flight with no
+        completions/errors for a full stall window — the python twin
+        dumps from _check_stalls; the C++ loop's own watchdog only
+        warns (the hung thread may be inside the executor callback, so
+        detection must live outside it). Heuristic mirror over the
+        stats snapshot: depth > 0 with frozen progress counters."""
+        interval = max(self._stall_warning_s / 5.0, 0.01)
+        last_progress = None
+        stuck_since = None
+        last_dump = 0.0
+        while not self._stall_stop.wait(interval):
+            ptr = self._ptr
+            if ptr is None:
+                return
+            st = native.HvdStats()
+            try:
+                self._lib.hvd_engine_get_stats(ptr, ctypes.byref(st))
+            except Exception:
+                return
+            now = time.monotonic()
+            progress = (int(st.completed), int(st.errors))
+            if int(st.queue_depth) > 0 and progress == last_progress:
+                if stuck_since is None:
+                    stuck_since = now
+                elif (now - stuck_since > self._stall_warning_s
+                        and now - last_dump > self._stall_warning_s):
+                    last_dump = now
+                    self._dump_flight(
+                        f"stalled: {int(st.queue_depth)} tensor(s) in "
+                        f"flight with no completions for "
+                        f"{int(now - stuck_since)}s")
+            else:
+                stuck_since = None
+            last_progress = progress
 
     def _maybe_activate_negotiation(self):
         """Build the coordinator + flip the C++ loop into negotiated mode
@@ -408,6 +533,7 @@ class NativeEngine:
     def shutdown(self):
         if self._ptr is None:
             return
+        self._stall_stop.set()
         # Stop the registry syncing first: it must never read through a
         # dead engine pointer.
         tele.REGISTRY.unregister_sync(self._collect_stats)
@@ -428,3 +554,6 @@ class NativeEngine:
         self._collect_stats()
         self._ptr = None
         self._meta.clear()
+        # A later SIGUSR1 must dump a LIVE engine's ring, not this dead
+        # one's — and the module-global handler state must not pin us.
+        tl.uninstall_sigusr1(self._dump_flight)

@@ -1,16 +1,36 @@
 """Chrome-tracing timeline (reference: horovod/common/timeline.{h,cc} —
 same phase vocabulary, same per-tensor lanes, same HOROVOD_TIMELINE
 activation; device-side spans come from the XLA profiler instead of CUDA
-events)."""
+events).
+
+Distributed-tracing extensions beyond the reference:
+
+- ``HVD_TIMELINE=<dir>`` writes ONE trace per controller process
+  (``timeline.rank{N}.json``); each trace embeds an ``HVD_CLOCK``
+  metadata event mapping its timeline clock onto a common time base
+  (see :meth:`Timeline.clock_sync` and utils/trace.py ``merge``). The
+  single-file spelling (``HVD_TIMELINE=/path/trace.json``) still works
+  and records exactly the reference's rank-local view.
+- An always-on **flight recorder**: a bounded in-memory ring of the most
+  recent events, recorded whether or not a trace file is being written
+  (the C++ engine keeps its own ring — hvdcore.cc — exported through
+  ``hvd_engine_recent_events`` with the same event shape). The engines
+  dump it (with a telemetry snapshot) on stalls, failed negotiations,
+  shutdown-drained work and SIGUSR1, so a hung or dying run yields a
+  post-mortem trace without any env var set.
+"""
 
 from __future__ import annotations
 
 import atexit
 import json
 import os
+import signal
+import tempfile
 import threading
 import time
-from typing import Optional
+from collections import deque
+from typing import Callable, List, Optional
 
 # Activity names (reference: operations.h:29-50).
 QUEUE = "QUEUE"
@@ -27,31 +47,86 @@ MEMCPY_OUT_FUSION_BUFFER = "MEMCPY_OUT_FUSION_BUFFER"
 # (reference: the per-rank readiness events timeline.cc:106-130 records
 # while a tensor is NEGOTIATING — the trace then shows who was late).
 RANK_READY = "RANK_READY"
+# Clock metadata event: maps this trace's timeline clock onto the common
+# time base (utils/trace.py merge). args: rank, epoch_wall_us (wall-clock
+# µs at trace ts 0), offset_us (subtract from epoch_wall_us+ts to land on
+# the common base — the wall↔monotonic bridge, replaced by rank 0's
+# bridge once the coordinator's anchor exchange completes), rtt_us (the
+# measured KV round trip bounding the exchange's error).
+CLOCK_SYNC = "HVD_CLOCK"
 
 _FLUSH_INTERVAL_S = 1.0  # reference: timeline.h:32
 
 
-class Timeline:
-    """Rank-0 chrome://tracing JSON writer. One "pid" lane per tensor name
-    (reference: timeline.cc:60-96 metadata events)."""
+def flight_recorder_size() -> int:
+    try:
+        return max(16, int(os.environ.get("HVD_FLIGHT_RECORDER_SIZE", "512")))
+    except ValueError:
+        return 512
 
-    def __init__(self, path: Optional[str]):
+
+def _process_index() -> int:
+    """This controller's process index, resolvable before hvd.init():
+    topology when initialized, else the launcher's HVD_PROCESS_ID."""
+    try:
+        from horovod_tpu.common import topology as topo
+
+        if topo.is_initialized():
+            return topo.process_index()
+    except Exception:
+        pass
+    try:
+        return int(os.environ.get("HVD_PROCESS_ID", "0"))
+    except ValueError:
+        return 0
+
+
+class Timeline:
+    """Per-process chrome://tracing JSON writer. One "pid" lane per tensor
+    name (reference: timeline.cc:60-96 metadata events). The clock base
+    and the flight-recorder ring are live even with no file (path=None):
+    ``now_us`` always returns the real clock and ``recent()`` always holds
+    the last-N events."""
+
+    def __init__(self, path: Optional[str], rank: Optional[int] = None):
         self._path = path
         self._lock = threading.RLock()
         self._fh = None
         self._pids = {}
         self._last_flush = 0.0
         self._first = True
+        # The clock base is captured unconditionally: a disabled timeline
+        # must still answer now_us() with the real clock (callers compute
+        # retro-span boundaries from it) and stamp ring events.
+        wall = time.time()
+        self._start = time.monotonic()
+        self.rank = _process_index() if rank is None else rank
+        # Wall-clock µs corresponding to trace ts 0, and the wall↔
+        # monotonic bridge: epoch_wall_us + ts - offset_us lands every
+        # same-host rank on the shared CLOCK_MONOTONIC base. clock_sync
+        # replaces offset_us with rank 0's bridge (exchanged through the
+        # KV store) so multi-host traces merge on rank 0's frame too.
+        self.epoch_wall_us = int(wall * 1e6)
+        self.offset_us = int((wall - self._start) * 1e6)
+        self.rtt_us: Optional[int] = None
+        self._ring: deque = deque(maxlen=flight_recorder_size())
+        # Metadata (the HVD_CLOCK mapping) is pinned in its own tiny ring
+        # so a busy run's span events can never evict it — every flight
+        # dump must carry the clock mapping or cross-rank alignment of
+        # dumps silently degrades to local time.
+        self._meta_ring: deque = deque(maxlen=16)
         if path:
             self._fh = open(path, "w")
             self._fh.write("[\n")
-            self._start = time.monotonic()
             # Crash-safety: a killed run leaves a truncated file. Events
             # are separator-FIRST (no trailing comma after the last one),
             # which the chrome/Perfetto JSON-array reader accepts without
             # the closing ']'; a clean interpreter exit that never reached
             # close() (engine leaked, Ctrl-C mid-run) is closed here.
             atexit.register(self.close)
+        # Recorded in the ring even with no file (the C++ twin does the
+        # same), so flight-recorder dumps carry the clock mapping too.
+        self._emit_clock_meta()
 
     @property
     def enabled(self) -> bool:
@@ -85,18 +160,51 @@ class Timeline:
     def now_us(self) -> int:
         """Current timeline clock, for retro-emitted spans (a caller that
         learns a phase boundary only after the fact — e.g. WAIT_FOR_DATA
-        split out of an executor round-trip — records explicit ts)."""
-        return self._ts_us() if self.enabled else 0
+        split out of an executor round-trip — records explicit ts). Valid
+        whether or not a file is being written: the base is captured at
+        construction, so a timeline enabled mid-run never receives a
+        zero/negative retro timestamp."""
+        return self._ts_us()
+
+    def _clock_args(self) -> dict:
+        args = {"rank": self.rank, "epoch_wall_us": self.epoch_wall_us,
+                "offset_us": self.offset_us}
+        if self.rtt_us is not None:
+            args["rtt_us"] = self.rtt_us
+        return args
+
+    def _emit_clock_meta(self):
+        args = self._clock_args()
+        with self._lock:
+            self._meta_ring.append({"name": CLOCK_SYNC, "ph": "M",
+                                    "ts": self._ts_us(), "args": args})
+            if self._fh is not None:
+                self._emit({"name": CLOCK_SYNC, "ph": "M", "pid": 0,
+                            "args": args})
+
+    def clock_sync(self, offset_us: int, rtt_us: Optional[int]):
+        """Record the coordinator's clock-anchor exchange result: rank 0's
+        wall↔monotonic bridge (the common-base offset every rank now
+        shares) plus the measured KV round trip that bounds the estimate's
+        error. Re-emits the HVD_CLOCK metadata; the merge tool uses the
+        LAST one per trace."""
+        self.offset_us = int(offset_us)
+        self.rtt_us = None if rtt_us is None else int(rtt_us)
+        self._emit_clock_meta()
 
     def _event(self, phase: str, tensor: str, activity: str,
                args: Optional[dict], ts_us: Optional[int] = None):
-        if not self.enabled:
-            return
+        ts = self._ts_us() if ts_us is None else ts_us
+        rec = {"name": activity, "ph": phase, "ts": ts, "tensor": tensor}
+        if args:
+            rec["args"] = args
         with self._lock:
-            if self._fh is None:  # closed between the check and the lock
+            # Flight recorder: always on, bounded, never touches disk.
+            self._ring.append(rec)
+            if self._fh is None:  # no file (disabled, or closed)
                 return
             ev = {"name": activity, "ph": phase, "pid": self._pid(tensor),
-                  "ts": self._ts_us() if ts_us is None else ts_us}
+                  "ts": ts}
             if phase == "i":
                 ev["s"] = "p"  # instant scope: process
             if args:
@@ -117,6 +225,16 @@ class Timeline:
         e.g. RANK_READY instants inside a NEGOTIATE_* span."""
         self._event("i", tensor, activity, args)
 
+    def recent(self) -> List[dict]:
+        """The flight-recorder ring: the most recent events (bounded by
+        HVD_FLIGHT_RECORDER_SIZE), each ``{"name", "ph", "ts", "tensor",
+        "args"?}`` — the same shape the C++ engine's ring exports. The
+        pinned metadata (HVD_CLOCK, newest last) leads the list so the
+        clock mapping survives however many span events followed it."""
+        with self._lock:
+            return ([dict(ev) for ev in self._meta_ring]
+                    + [dict(ev) for ev in self._ring])
+
     def close(self):
         if not self.enabled:
             return
@@ -133,10 +251,180 @@ class Timeline:
 
 
 def timeline_path_from_env() -> Optional[str]:
-    """HOROVOD_TIMELINE=<file> activation (reference: operations.cc:1732-1736);
-    HVD_TIMELINE is the native spelling."""
-    return os.environ.get("HVD_TIMELINE") or os.environ.get("HOROVOD_TIMELINE")
+    """HOROVOD_TIMELINE=<file-or-dir> activation (reference:
+    operations.cc:1732-1736); HVD_TIMELINE is the native spelling. A
+    directory target (anything not ending in ``.json``, or an existing
+    directory) resolves to one file per process inside it."""
+    raw = os.environ.get("HVD_TIMELINE") or os.environ.get("HOROVOD_TIMELINE")
+    if not raw:
+        return None
+    return resolve_timeline_path(raw)
+
+
+def is_dir_mode(raw: str) -> bool:
+    """True when an HVD_TIMELINE value means per-rank-traces-in-a-dir
+    (an existing directory, or a not-yet-existing path without a
+    ``.json`` suffix). An existing plain FILE is always file mode —
+    the reference allowed arbitrary trace filenames, and treating a
+    legacy ``HOROVOD_TIMELINE=/tmp/hvd.trace`` leftover as a directory
+    would crash engine init on makedirs. The ONE definition of the
+    rule — the launcher and bench.py classify through this too, so
+    where children write always matches where the mergers look."""
+    if os.path.isdir(raw):
+        return True
+    if os.path.isfile(raw):
+        return False
+    return not raw.endswith(".json")
+
+
+def resolve_timeline_path(raw: str, rank: Optional[int] = None) -> str:
+    """Map the HVD_TIMELINE value to this process's trace file. Dir mode
+    (the distributed-tracing default) creates the directory and returns
+    ``<dir>/timeline.rank{N}.json``; a ``.json`` path is used verbatim
+    (the reference's single-file spelling)."""
+    if not is_dir_mode(raw):
+        return raw
+    rank = _process_index() if rank is None else rank
+    os.makedirs(raw, exist_ok=True)
+    return os.path.join(raw, f"timeline.rank{rank}.json")
 
 
 def from_env() -> Timeline:
     return Timeline(timeline_path_from_env())
+
+
+# ---------------------------------------------------------------------------
+# Flight-recorder dumps (post-mortem traces for hung or dying runs)
+# ---------------------------------------------------------------------------
+
+
+def flight_recorder_dir() -> str:
+    return (os.environ.get("HVD_FLIGHT_DIR")
+            or tempfile.gettempdir())
+
+
+def dump_flight_recorder(events: List[dict], reason: str,
+                         rank: Optional[int] = None,
+                         path: Optional[str] = None) -> Optional[str]:
+    """Write a post-mortem dump: the flight-recorder events plus a
+    telemetry snapshot (counters + the straggler report — the same data
+    ``hvd.telemetry()`` serves). Written atomically (tmp + replace) so a
+    concurrent reader never sees a torn file. Returns the path, or None
+    when writing failed (dumping must never take the caller down)."""
+    rank = _process_index() if rank is None else rank
+    payload = {
+        "reason": str(reason),
+        "rank": rank,
+        "pid": os.getpid(),
+        "wall_us": int(time.time() * 1e6),
+        "events": list(events),
+    }
+    try:
+        from horovod_tpu.core import telemetry as tele
+
+        payload["telemetry"] = tele.compact()
+        payload["straggler"] = tele.STRAGGLERS.snapshot()
+        payload["report"] = tele.report()
+    except Exception:
+        pass  # telemetry is additive; the events are the dump's core
+    if path is None:
+        path = os.path.join(flight_recorder_dir(),
+                            f"hvd_flight.rank{rank}.{os.getpid()}.json")
+    tmp = f"{path}.tmp"
+    try:
+        with open(tmp, "w") as fh:
+            json.dump(payload, fh)
+        os.replace(tmp, path)
+        return path
+    except OSError:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        return None
+
+
+def dump_and_warn(events: List[dict], reason: str, rank: Optional[int],
+                  logger) -> Optional[str]:
+    """The engines' shared dump wrapper (their post-mortem semantics
+    must stay twins): write the flight dump, warn with the path, never
+    raise. Returns the path or None."""
+    try:
+        path = dump_flight_recorder(events, reason, rank=rank)
+        if path:
+            logger.warning("flight recorder dumped to %s (%s)", path,
+                           str(reason).splitlines()[0][:200])
+        return path
+    except Exception:
+        return None
+
+
+_sigusr1_lock = threading.Lock()
+_sigusr1_dump: Optional[Callable[[str], None]] = None
+_sigusr1_installed = False
+_sigusr1_prev = None  # the application's handler, chained after ours
+
+
+def install_sigusr1(dump_fn: Callable[[str], None]):
+    """Register ``dump_fn("SIGUSR1")`` to run on SIGUSR1 (the live-engine
+    post-mortem hook: ``kill -USR1 <pid>`` dumps the flight recorder of a
+    hung run with no env var set). The latest registrant wins — each
+    engine generation re-registers its own dumper. A handler the
+    application installed first is preserved and chained after the dump
+    (e.g. SLURM preemption checkpointing must keep working). Installable
+    only from the main thread (the signal module's rule); elsewhere the
+    request is recorded but the handler of a previous main-thread install
+    serves it."""
+    global _sigusr1_dump, _sigusr1_installed, _sigusr1_prev
+    with _sigusr1_lock:
+        _sigusr1_dump = dump_fn
+        if _sigusr1_installed:
+            return
+        try:
+            _sigusr1_prev = signal.signal(signal.SIGUSR1, _on_sigusr1)
+            _sigusr1_installed = True
+        except (ValueError, AttributeError, OSError):
+            pass  # non-main thread, or a platform without SIGUSR1
+
+
+def uninstall_sigusr1(dump_fn: Callable[[str], None]):
+    """Drop ``dump_fn`` if it is the current SIGUSR1 dumper (engine
+    shutdown calls this): the module global must not keep a strong
+    reference pinning a dead engine — and a later SIGUSR1 must not dump
+    a shut-down engine's stale ring as if it were live state. A newer
+    registrant is left untouched."""
+    global _sigusr1_dump
+    with _sigusr1_lock:
+        # == not `is`: each `self._dump_flight` access builds a fresh
+        # bound-method object; equality compares (__self__, __func__).
+        if _sigusr1_dump == dump_fn:
+            _sigusr1_dump = None
+
+
+def _on_sigusr1(signum, frame):
+    fn = _sigusr1_dump
+    if fn is not None:
+        try:
+            # Hand off to a thread: the handler interrupts the main
+            # thread at an arbitrary bytecode boundary, possibly INSIDE a
+            # telemetry or timeline critical section — dumping inline
+            # would deadlock on the non-reentrant lock the interrupted
+            # frame still holds. A separate thread simply waits its turn.
+            threading.Thread(target=_safe_dump, args=(fn,),
+                             name="hvd-sigusr1-dump", daemon=True).start()
+        except Exception:
+            pass  # a signal handler must never raise into arbitrary frames
+    if callable(_sigusr1_prev):
+        # Chain the application's own handler (SIG_DFL/SIG_IGN are ints,
+        # not callables) — the dump is additive, never a replacement.
+        try:
+            _sigusr1_prev(signum, frame)
+        except Exception:
+            pass
+
+
+def _safe_dump(fn):
+    try:
+        fn("SIGUSR1")
+    except Exception:
+        pass

@@ -47,6 +47,11 @@ def main(argv=None):
     ap.add_argument("--cpu", action="store_true", default=False,
                     help="force the CPU platform (default: inherit)")
     ap.add_argument("--tag-output", action="store_true", default=True)
+    ap.add_argument("--timeline", metavar="DIR", default=None,
+                    help="distributed tracing: every process writes "
+                         "timeline.rank{N}.json into DIR (sets "
+                         "HVD_TIMELINE), and the launcher merges them "
+                         "into one Perfetto trace at exit")
     ap.add_argument("command", nargs=argparse.REMAINDER,
                     help="command to run, e.g. python train.py --epochs 1")
     args = ap.parse_args(argv)
@@ -56,6 +61,40 @@ def main(argv=None):
     if cmd[0] == "--":
         cmd = cmd[1:]
 
+    # Distributed tracing: --timeline DIR (or an inherited HVD_TIMELINE)
+    # rides into every child; children resolve their own per-rank file
+    # from HVD_PROCESS_ID (core/timeline.py), the launcher auto-merges.
+    timeline = args.timeline or os.environ.get("HVD_TIMELINE") \
+        or os.environ.get("HOROVOD_TIMELINE")
+    timeline_dir = None
+    if timeline:
+        from horovod_tpu.core.timeline import is_dir_mode
+
+        if is_dir_mode(timeline):
+            os.makedirs(timeline, exist_ok=True)
+            timeline_dir = timeline
+            # A reused dir must not leak a previous run's ranks into the
+            # merge: a -np 2 rerun over an old -np 4 capture would
+            # attribute waits to ranks that were never in this world.
+            import glob as _glob
+
+            for stale in _glob.glob(
+                    os.path.join(timeline, "timeline.rank*.json")) + \
+                    _glob.glob(os.path.join(timeline,
+                                            "timeline.merged.json")):
+                try:
+                    os.unlink(stale)
+                except OSError:
+                    pass
+        elif args.num_proc > 1:
+            # N children opening ONE .json would clobber each other into
+            # an interleaved, unloadable trace — and there would be
+            # nothing to merge. Refuse loudly instead of corrupting.
+            ap.error(
+                f"--timeline/HVD_TIMELINE={timeline} is a single file; "
+                f"{args.num_proc} processes need a directory "
+                "(per-rank traces + auto-merge)")
+
     port = _free_port()
     procs = []
     threads = []
@@ -64,6 +103,8 @@ def main(argv=None):
         env["HVD_COORDINATOR_ADDRESS"] = f"127.0.0.1:{port}"
         env["HVD_NUM_PROCESSES"] = str(args.num_proc)
         env["HVD_PROCESS_ID"] = str(i)
+        if timeline:
+            env["HVD_TIMELINE"] = timeline
         if args.cpu:
             # HVD_PLATFORM is applied via jax.config inside hvd.init()
             # (plain JAX_PLATFORMS can be preempted by plugins).
@@ -110,6 +151,20 @@ def main(argv=None):
             time.sleep(0.05)
     for t in threads:
         t.join(timeout=5)
+    if timeline_dir:
+        # Collect + auto-merge the per-rank traces (whatever landed on
+        # disk — the truncation-tolerant reader handles ranks that died
+        # mid-write). Best-effort: a merge failure must not change the
+        # job's exit code.
+        try:
+            from horovod_tpu.utils import trace as trace_mod
+
+            info = trace_mod.merge(timeline_dir)
+            sys.stderr.write(
+                f"[launcher] merged timeline: {info['files']} rank "
+                f"file(s), {info['events']} events -> {info['path']}\n")
+        except Exception as exc:
+            sys.stderr.write(f"[launcher] timeline merge failed: {exc}\n")
     return rc
 
 

@@ -111,32 +111,31 @@ def main(argv=None):
     ap.add_argument("--json", action="store_true",
                     help="machine-readable output")
     ap.add_argument("--watch", type=float, metavar="SECONDS", default=None,
-                    help="re-read the exposition file every N seconds")
+                    help="redraw the report every N seconds (exposition "
+                         "file or 'live'); Ctrl-C exits cleanly")
     ap.add_argument("--steps", type=int, default=1,
                     help="steps in an xplane capture window (per-step "
                          "attribution)")
     args = ap.parse_args(argv)
 
-    if args.target == "live":
-        from horovod_tpu.core import telemetry
+    def render_once() -> int:
+        if args.target == "live":
+            from horovod_tpu.core import telemetry
 
-        if args.json:
-            print(json.dumps(telemetry.telemetry(), default=str))
-        else:
-            print(telemetry.report())
-        return 0
+            if args.json:
+                print(json.dumps(telemetry.telemetry(), default=str))
+            else:
+                print(telemetry.report())
+            return 0
+        if _is_xplane_dir(args.target):
+            from horovod_tpu.utils import xplane
 
-    if _is_xplane_dir(args.target):
-        from horovod_tpu.utils import xplane
-
-        data = xplane.hbm_json(args.target, steps=args.steps)
-        if args.json:
-            print(json.dumps(data))
-        else:
-            print(xplane.hbm_report(args.target, steps=args.steps))
-        return 0
-
-    while True:
+            if args.json:
+                print(json.dumps(xplane.hbm_json(args.target,
+                                                 steps=args.steps)))
+            else:
+                print(xplane.hbm_report(args.target, steps=args.steps))
+            return 0
         try:
             with open(args.target) as fh:
                 text = fh.read()
@@ -150,10 +149,21 @@ def main(argv=None):
                 for n, l, v in samples]))
         else:
             print(render(samples))
-        if args.watch is None:
-            return 0
-        time.sleep(args.watch)
-        print()
+        return 0
+
+    # --watch: the poor-man's dashboard, now for 'live' too (stalls can
+    # be watched as they develop from inside the driving process).
+    # Ctrl-C is the documented way out — exit cleanly, not with a
+    # KeyboardInterrupt stack trace.
+    try:
+        while True:
+            rc = render_once()
+            if args.watch is None or rc != 0:
+                return rc
+            time.sleep(args.watch)
+            print()
+    except KeyboardInterrupt:
+        return 0
 
 
 if __name__ == "__main__":

@@ -1,0 +1,590 @@
+"""Trace-analysis CLI over the per-rank distributed timelines.
+
+    python -m horovod_tpu.utils.trace merge|skew|critical-path|stats <dir>
+
+``HVD_TIMELINE=<dir>`` makes every controller process write
+``timeline.rank{N}.json`` (core/timeline.py); each file embeds an
+``HVD_CLOCK`` metadata event mapping its timeline clock onto a common
+time base (rank 0's wall↔monotonic bridge, exchanged Cristian-style
+through the negotiation KV store — the recorded ``rtt_us`` bounds the
+estimate's error; same-host processes share CLOCK_MONOTONIC, making the
+alignment exact).
+
+Subcommands:
+
+- ``merge``   — one Perfetto/chrome-tracing file: pid = rank, tid =
+  tensor lane, all ranks on the common time base. The reference's
+  timeline showed ONE process; this is the cross-rank view the TPU-pod
+  scaling failure mode (cross-rank skew, arxiv 1909.09756) needs.
+- ``skew``    — per-tensor negotiate skew reconstructed from the
+  RANK_READY instants: who announced late, and how much wait each
+  process imposed on the world (cross-checkable against the telemetry
+  straggler report — ``--prom`` compares against an
+  ``HVD_TELEMETRY_FILE`` exposition).
+- ``critical-path`` — per-phase time shares through
+  QUEUE→NEGOTIATE→MEMCPY→ALLREDUCE→MEMCPY_OUT and the slowest tensor
+  instances' phase chains.
+- ``stats``   — per-rank event counts, activity durations, clock info.
+
+Every reader is **truncation-tolerant**: a rank killed mid-write leaves
+a file with no closing bracket — possibly cut mid-event — and it still
+loads (the writers are separator-first, one event per line). Flight-
+recorder dumps (``hvd_flight.rank*.json``) are accepted wherever a
+trace file is: their ``events`` list uses the same shape with a
+``tensor`` field instead of a lane pid.
+
+This module's own code is stdlib-only with no intra-package imports;
+note that running it as ``python -m horovod_tpu.utils.trace`` still
+imports the ``horovod_tpu`` package (and therefore jax) — on a machine
+without jax, copy this one file out and run it directly.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import re
+import sys
+from typing import Dict, List, Optional, Tuple
+
+CLOCK_EVENT = "HVD_CLOCK"
+RANK_READY = "RANK_READY"
+_COLLECTIVES = ("ALLREDUCE", "ALLGATHER", "BROADCAST")
+# Phase display order for critical-path output.
+_PHASE_ORDER = ("NEGOTIATE", "MEMCPY_IN_FUSION_BUFFER", "WAIT_FOR_DATA",
+                "COLLECTIVE", "MEMCPY_OUT_FUSION_BUFFER", "OTHER")
+
+_RANK_FILE_RE = re.compile(r"(?:timeline|hvd_flight)\.rank(\d+)[.\w]*\.json$")
+
+
+# ---------------------------------------------------------------------------
+# Truncation-tolerant loading
+# ---------------------------------------------------------------------------
+
+
+def load_events(path: str) -> List[dict]:
+    """Load a chrome-trace JSON array (or a flight-recorder dump),
+    tolerating any truncation a killed writer can produce: missing ']',
+    trailing comma, or a final line cut mid-event."""
+    with open(path) as fh:
+        raw = fh.read()
+    for candidate in (raw, raw.rstrip().rstrip(",") + "\n]"):
+        try:
+            data = json.loads(candidate)
+            break
+        except ValueError:
+            continue
+    else:
+        # Cut mid-event: drop trailing lines until the prefix parses
+        # (the writers emit one event per line, separator-first).
+        lines = raw.splitlines()
+        data = []
+        while lines:
+            lines.pop()
+            body = "\n".join(lines).rstrip().rstrip(",")
+            if body.strip() in ("", "["):
+                break
+            try:
+                data = json.loads(body + "\n]")
+                break
+            except ValueError:
+                continue
+    if isinstance(data, dict):  # flight-recorder dump
+        data = data.get("events", [])
+    return [ev for ev in data if isinstance(ev, dict)]
+
+
+def rank_files(target: str) -> List[str]:
+    """Per-rank trace files under a directory (sorted by rank), or the
+    single file itself. A directory holding only flight-recorder dumps
+    (the SIGUSR1 / stall post-mortem recipe) is analyzable too: the
+    newest ``hvd_flight.rank{N}.*.json`` per rank stands in for the
+    rank's trace."""
+    if not os.path.isdir(target):
+        return [target]
+    files = glob.glob(os.path.join(target, "timeline.rank*.json"))
+    if files:
+        return sorted(files, key=lambda f: _file_rank(f) or 0)
+    newest: Dict[int, str] = {}
+    for f in glob.glob(os.path.join(target, "hvd_flight.rank*.json")):
+        r = _file_rank(f)
+        if r is None:
+            continue
+        if r not in newest or os.path.getmtime(f) > \
+                os.path.getmtime(newest[r]):
+            newest[r] = f
+    return [newest[r] for r in sorted(newest)]
+
+
+def _file_rank(path: str) -> Optional[int]:
+    m = _RANK_FILE_RE.search(os.path.basename(path))
+    return int(m.group(1)) if m else None
+
+
+class RankTrace:
+    """One rank's loaded trace: events, tensor-lane names, and the clock
+    mapping onto the common time base."""
+
+    def __init__(self, path: str):
+        self.path = path
+        self.events = load_events(path)
+        self.lanes: Dict[int, str] = {}
+        self.clock: dict = {}
+        clock_ranks = set()
+        for ev in self.events:
+            if ev.get("ph") != "M":
+                continue
+            if ev.get("name") == "process_name" and "pid" in ev:
+                self.lanes[ev["pid"]] = ev.get("args", {}).get("name", "")
+            elif ev.get("name") == CLOCK_EVENT:
+                self.clock = dict(ev.get("args", {}))  # LAST one wins
+                clock_ranks.add(self.clock.get("rank"))
+        if len(clock_ranks) > 1:
+            # Clock records from SEVERAL ranks in one file ⇒ this is
+            # merge's own output (already rebased). Re-analyzing it
+            # would silently double-shift every timestamp and collapse
+            # the ranks — refuse with directions instead.
+            raise ValueError(
+                f"{path} is a MERGED trace (clock records from ranks "
+                f"{sorted(clock_ranks)}); point the CLI at the per-rank "
+                "directory instead")
+        rank = self.clock.get("rank")
+        if rank is None:
+            rank = _file_rank(path)
+        self.rank = 0 if rank is None else int(rank)
+
+    def tensor_of(self, ev: dict) -> Optional[str]:
+        if "tensor" in ev:  # flight-recorder shape
+            return ev["tensor"]
+        return self.lanes.get(ev.get("pid"))
+
+    def common_ts(self, ts: int) -> int:
+        """Map a trace-local timestamp onto the common base:
+        epoch_wall_us + ts − offset_us (see core/timeline.py HVD_CLOCK).
+        Traces without clock metadata stay in their own frame."""
+        return (int(self.clock.get("epoch_wall_us", 0)) + int(ts)
+                - int(self.clock.get("offset_us", 0)))
+
+
+def load_traces(target: str) -> List[RankTrace]:
+    traces = [RankTrace(f) for f in rank_files(target)]
+    if not traces:
+        raise FileNotFoundError(
+            f"no timeline.rank*.json under {target!r} (and it is not a "
+            "trace file)")
+    return traces
+
+
+# ---------------------------------------------------------------------------
+# merge
+# ---------------------------------------------------------------------------
+
+
+def merge(target: str, out: Optional[str] = None) -> dict:
+    """Merge per-rank traces into one Perfetto-loadable file: pid = rank
+    (process_name "rank N"), tid = tensor lane (thread_name = tensor),
+    all timestamps rebased onto the common time base. Returns
+    {"path", "files", "events", "ranks"}."""
+    traces = load_traces(target)
+    if out is None:
+        out = (os.path.join(target, "timeline.merged.json")
+               if os.path.isdir(target)
+               else os.path.splitext(target)[0] + ".merged.json")
+    bases = [t.common_ts(ev.get("ts", 0))
+             for t in traces for ev in t.events if ev.get("ph") != "M"]
+    base = min(bases) if bases else 0
+    merged: List[dict] = []
+    nevents = 0
+    for t in traces:
+        merged.append({"name": "process_name", "ph": "M", "pid": t.rank,
+                       "args": {"name": f"rank {t.rank}"}})
+        if t.clock:
+            merged.append({"name": CLOCK_EVENT, "ph": "M", "pid": t.rank,
+                           "args": t.clock})
+        tids: Dict[str, int] = {}
+        for ev in t.events:
+            if ev.get("ph") == "M":
+                continue
+            tensor = t.tensor_of(ev) or "?"
+            if tensor not in tids:
+                tids[tensor] = len(tids) + 1
+                merged.append({"name": "thread_name", "ph": "M",
+                               "pid": t.rank, "tid": tids[tensor],
+                               "args": {"name": tensor}})
+            one = {"name": ev.get("name"), "ph": ev.get("ph"),
+                   "pid": t.rank, "tid": tids[tensor],
+                   "ts": t.common_ts(ev.get("ts", 0)) - base}
+            if ev.get("ph") == "i":
+                one["s"] = "t"  # instant scope: thread (its tensor lane)
+            if "args" in ev:
+                one["args"] = ev["args"]
+            merged.append(one)
+            nevents += 1
+    with open(out, "w") as fh:
+        json.dump(merged, fh)
+    return {"path": out, "files": len(traces), "events": nevents,
+            "ranks": [t.rank for t in traces]}
+
+
+# ---------------------------------------------------------------------------
+# skew
+# ---------------------------------------------------------------------------
+
+
+def _self_marks(trace: RankTrace) -> Dict[str, List[int]]:
+    """Per tensor, the common-base times at which THIS rank observed its
+    own announcement (the RANK_READY instant with process == own rank) —
+    the per-rank readiness series the negotiate-skew reconstruction
+    pairs across ranks."""
+    marks: Dict[str, List[int]] = {}
+    for ev in trace.events:
+        if ev.get("name") != RANK_READY or ev.get("ph") != "i":
+            continue
+        if ev.get("args", {}).get("process") != trace.rank:
+            continue
+        tensor = trace.tensor_of(ev)
+        if tensor is None:
+            continue
+        marks.setdefault(tensor, []).append(trace.common_ts(ev["ts"]))
+    for series in marks.values():
+        series.sort()
+    return marks
+
+
+def skew_data(target: str) -> dict:
+    """Reconstruct per-tensor negotiate skew across ranks. The k-th
+    instance of a tensor pairs the k-th self-announcement of every rank;
+    each instance charges rank r ``t_r − min(t)`` µs of imposed wait —
+    the same quantity the telemetry straggler report accumulates from
+    the round tables, here measured from the traces themselves."""
+    traces = load_traces(target)
+    per_rank_marks = {t.rank: _self_marks(t) for t in traces}
+    ranks = sorted(per_rank_marks)
+    wait_us: Dict[int, int] = {r: 0 for r in ranks}
+    late_count: Dict[int, int] = {r: 0 for r in ranks}
+    per_tensor: Dict[str, dict] = {}
+    worst = None
+    instances = 0
+    tensors = sorted({n for m in per_rank_marks.values() for n in m})
+    for name in tensors:
+        series = {r: per_rank_marks[r].get(name, []) for r in ranks}
+        covered = [r for r in ranks if series[r]]
+        if len(covered) < 2:
+            continue  # skew needs at least two ranks' announcements
+        n = min(len(series[r]) for r in covered)
+        tw: Dict[int, int] = {r: 0 for r in covered}
+        for k in range(n):
+            times = {r: series[r][k] for r in covered}
+            t0 = min(times.values())
+            late = max(times, key=times.get)
+            instances += 1
+            for r, t in times.items():
+                tw[r] += t - t0
+                wait_us[r] += t - t0
+            skew = times[late] - t0
+            if skew <= 0:
+                continue  # a tie imposed no wait — blame nobody
+            late_count[late] += 1
+            if worst is None or skew > worst["skew_us"]:
+                worst = {"tensor": name, "instance": k, "rank": late,
+                         "skew_us": skew}
+        per_tensor[name] = {
+            "instances": n,
+            "wait_us": tw,
+            "worst_rank": max(tw, key=tw.get) if any(tw.values()) else None,
+        }
+    return {
+        "ranks": ranks,
+        "instances": instances,
+        "wait_us": wait_us,
+        "late_count": late_count,
+        "per_tensor": per_tensor,
+        "worst": worst,
+        "clock": {t.rank: t.clock for t in traces},
+    }
+
+
+_STRAGGLER_SAMPLE_RE = re.compile(
+    r'^hvd_straggler_wait_microseconds\{process="(\d+)"\}\s+'
+    r'([0-9.eE+\-]+)\s*$')
+
+
+def parse_straggler_prom(path: str) -> Dict[int, int]:
+    """Per-process imposed wait from an HVD_TELEMETRY_FILE exposition
+    (hvd_straggler_wait_microseconds{process="N"}) — the cross-check
+    target for the trace-reconstructed skew. Parsed inline (not via
+    utils/stats.py) so this file stays runnable standalone."""
+    out: Dict[int, int] = {}
+    with open(path) as fh:
+        for line in fh:
+            m = _STRAGGLER_SAMPLE_RE.match(line.strip())
+            if m:
+                out[int(m.group(1))] = int(float(m.group(2)))
+    return out
+
+
+def skew_report(target: str, prom: Optional[str] = None) -> str:
+    d = skew_data(target)
+    lines = [f"trace skew over {len(d['ranks'])} rank(s), "
+             f"{d['instances']} tensor instance(s)"]
+    if not d["instances"]:
+        lines.append("  (no multi-rank RANK_READY instants — single-rank "
+                     "trace, or negotiation never ran)")
+    tele = {}
+    if prom is None and os.path.isdir(target):
+        candidates = sorted(glob.glob(os.path.join(target, "*.prom")))
+        prom = candidates[0] if candidates else None
+    if prom:
+        try:
+            tele = parse_straggler_prom(prom)
+        except OSError:
+            tele = {}
+    for r, us in sorted(d["wait_us"].items(), key=lambda kv: -kv[1]):
+        line = (f"  process {r}: imposed wait {us / 1e6:.3f} s cumulative "
+                f"(late on {d['late_count'][r]}/{d['instances']} instances)")
+        if r in tele:
+            line += f" [telemetry straggler report: {tele[r] / 1e6:.3f} s]"
+        lines.append(line)
+    for name, pt in sorted(d["per_tensor"].items()):
+        if pt["worst_rank"] is not None:
+            lines.append(
+                f"  {name}: slowest process {pt['worst_rank']} "
+                f"(+{pt['wait_us'][pt['worst_rank']] / 1e3:.1f} ms over "
+                f"{pt['instances']} instance(s))")
+    if d["worst"]:
+        w = d["worst"]
+        lines.append(f"  worst instance: {w['tensor']}#{w['instance']} — "
+                     f"process {w['rank']} announced "
+                     f"{w['skew_us'] / 1e6:.3f} s after the first rank")
+    for r, clk in sorted(d["clock"].items()):
+        if clk:
+            rtt = clk.get("rtt_us")
+            lines.append(
+                f"  clock rank {r}: offset {clk.get('offset_us', 0)} us"
+                + (f", kv round-trip {rtt} us (skew error bound)"
+                   if rtt is not None else " (no anchor exchange recorded)"))
+    return "\n".join(lines)
+
+
+# ---------------------------------------------------------------------------
+# critical path / phase shares
+# ---------------------------------------------------------------------------
+
+
+def _spans(trace: RankTrace) -> Dict[Tuple[str, str], List[Tuple[int, int]]]:
+    """(tensor, activity) → [(begin, end)] in common time, from B/E
+    pairs. Unbalanced begins (truncated trace) are dropped."""
+    out: Dict[Tuple[str, str], List[Tuple[int, int]]] = {}
+    open_spans: Dict[Tuple[str, str], List[int]] = {}
+    for ev in trace.events:
+        ph = ev.get("ph")
+        if ph not in ("B", "E"):
+            continue
+        tensor = trace.tensor_of(ev)
+        if tensor is None:
+            continue
+        key = (tensor, ev.get("name", ""))
+        if ph == "B":
+            open_spans.setdefault(key, []).append(trace.common_ts(ev["ts"]))
+        else:
+            stack = open_spans.get(key)
+            if stack:
+                out.setdefault(key, []).append(
+                    (stack.pop(), trace.common_ts(ev["ts"])))
+    for v in out.values():
+        v.sort()
+    return out
+
+
+def _phase_of(activity: str) -> Optional[str]:
+    if activity.startswith("NEGOTIATE_"):
+        return "NEGOTIATE"
+    if activity in _COLLECTIVES:
+        return "COLLECTIVE"
+    if activity in ("MEMCPY_IN_FUSION_BUFFER", "WAIT_FOR_DATA",
+                    "MEMCPY_OUT_FUSION_BUFFER"):
+        return activity
+    return None
+
+
+def critical_path_data(target: str) -> dict:
+    """Per-phase time decomposition of every completed tensor instance
+    (one QUEUE span), aggregated into phase shares, plus the slowest
+    instances' phase chains — the critical path through
+    QUEUE→NEGOTIATE→MEMCPY→ALLREDUCE→MEMCPY_OUT."""
+    traces = load_traces(target)
+    phase_us = {p: 0 for p in _PHASE_ORDER}
+    instances: List[dict] = []
+    for t in traces:
+        spans = _spans(t)
+        nested: Dict[str, List[Tuple[int, int, str]]] = {}
+        for (tensor, act), sp in spans.items():
+            phase = _phase_of(act)
+            if phase is None:
+                continue
+            for b, e in sp:
+                nested.setdefault(tensor, []).append((b, e, phase))
+        for (tensor, act), sp in spans.items():
+            if act != "QUEUE":
+                continue
+            for b, e in sp:
+                inst = {"rank": t.rank, "tensor": tensor,
+                        "total_us": e - b,
+                        "phases": {p: 0 for p in _PHASE_ORDER}}
+                for pb, pe, phase in nested.get(tensor, []):
+                    if pb >= b and pe <= e:
+                        inst["phases"][phase] += pe - pb
+                accounted = sum(inst["phases"][p] for p in _PHASE_ORDER
+                                if p != "OTHER")
+                inst["phases"]["OTHER"] = max(0, inst["total_us"] - accounted)
+                for p in _PHASE_ORDER:
+                    phase_us[p] += inst["phases"][p]
+                instances.append(inst)
+    total = sum(phase_us.values())
+    shares = {p: (phase_us[p] / total if total else 0.0)
+              for p in _PHASE_ORDER}
+    instances.sort(key=lambda i: -i["total_us"])
+    return {"instances": len(instances), "phase_us": phase_us,
+            "shares": shares, "slowest": instances[:5]}
+
+
+def critical_path_report(target: str) -> str:
+    d = critical_path_data(target)
+    lines = [f"critical path over {d['instances']} completed tensor "
+             "instance(s)", "phase shares of total in-flight time:"]
+    for p in _PHASE_ORDER:
+        lines.append(f"  {p:26s} {d['phase_us'][p] / 1e3:12.1f} ms "
+                     f"{d['shares'][p] * 100:5.1f}%")
+    if d["slowest"]:
+        lines.append("slowest instances (the critical path):")
+        for inst in d["slowest"]:
+            chain = " -> ".join(
+                f"{p}:{inst['phases'][p] / 1e3:.1f}ms"
+                for p in _PHASE_ORDER if inst["phases"][p] > 0)
+            lines.append(f"  rank {inst['rank']} {inst['tensor']}: "
+                         f"{inst['total_us'] / 1e3:.1f} ms ({chain})")
+    return "\n".join(lines)
+
+
+# ---------------------------------------------------------------------------
+# stats
+# ---------------------------------------------------------------------------
+
+
+def stats_data(target: str) -> dict:
+    traces = load_traces(target)
+    ranks = {}
+    for t in traces:
+        counts: Dict[str, int] = {}
+        durations: Dict[str, int] = {}
+        first = last = None
+        for ev in t.events:
+            if ev.get("ph") == "M":
+                continue
+            ts = t.common_ts(ev.get("ts", 0))
+            first = ts if first is None else min(first, ts)
+            last = ts if last is None else max(last, ts)
+            counts[ev.get("name", "?")] = counts.get(ev.get("name", "?"),
+                                                     0) + 1
+        for (tensor, act), sp in _spans(t).items():
+            durations[act] = durations.get(act, 0) + sum(
+                e - b for b, e in sp)
+        ranks[t.rank] = {
+            "file": os.path.basename(t.path),
+            "events": sum(counts.values()),
+            "counts": counts,
+            "span_duration_us": durations,
+            "window_us": (last - first) if first is not None else 0,
+            "clock": t.clock,
+        }
+    return {"ranks": ranks}
+
+
+def stats_report(target: str) -> str:
+    d = stats_data(target)
+    lines = []
+    for r, info in sorted(d["ranks"].items()):
+        lines.append(f"rank {r} ({info['file']}): {info['events']} events "
+                     f"over {info['window_us'] / 1e6:.3f} s")
+        for act in sorted(info["counts"]):
+            dur = info["span_duration_us"].get(act)
+            lines.append(
+                f"  {act:26s} x{info['counts'][act]:<6d}"
+                + (f" {dur / 1e3:10.1f} ms total" if dur else ""))
+        clk = info["clock"]
+        if clk:
+            lines.append(f"  clock: epoch_wall_us={clk.get('epoch_wall_us')}"
+                         f" offset_us={clk.get('offset_us')}"
+                         f" rtt_us={clk.get('rtt_us')}")
+    return "\n".join(lines)
+
+
+# ---------------------------------------------------------------------------
+# CLI
+# ---------------------------------------------------------------------------
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m horovod_tpu.utils.trace",
+        description="Analyze per-rank HVD_TIMELINE traces: merge onto a "
+                    "common clock, reconstruct cross-rank skew, "
+                    "decompose the critical path.")
+    sub = ap.add_subparsers(dest="cmd", required=True)
+    p = sub.add_parser("merge", help="merge per-rank files into one "
+                                     "Perfetto trace (pid=rank)")
+    p.add_argument("target")
+    p.add_argument("-o", "--out", default=None)
+    p = sub.add_parser("skew", help="per-tensor negotiate skew: who was "
+                                    "late, imposed wait per process")
+    p.add_argument("target")
+    p.add_argument("--prom", default=None,
+                   help="HVD_TELEMETRY_FILE exposition to cross-check "
+                        "against (default: *.prom in the trace dir)")
+    p.add_argument("--json", action="store_true")
+    p = sub.add_parser("critical-path", help="phase shares + slowest "
+                                             "instances")
+    p.add_argument("target")
+    p.add_argument("--json", action="store_true")
+    p = sub.add_parser("stats", help="per-rank event counts and durations")
+    p.add_argument("target")
+    p.add_argument("--json", action="store_true")
+    args = ap.parse_args(argv)
+
+    try:
+        if args.cmd == "merge":
+            info = merge(args.target, args.out)
+            print(f"merged {info['files']} rank file(s), "
+                  f"{info['events']} events -> {info['path']}")
+        elif args.cmd == "skew":
+            if args.json:
+                d = skew_data(args.target)
+                d["wait_us"] = {str(k): v for k, v in d["wait_us"].items()}
+                d["late_count"] = {str(k): v
+                                   for k, v in d["late_count"].items()}
+                d["clock"] = {str(k): v for k, v in d["clock"].items()}
+                print(json.dumps(d))
+            else:
+                print(skew_report(args.target, prom=args.prom))
+        elif args.cmd == "critical-path":
+            if args.json:
+                print(json.dumps(critical_path_data(args.target)))
+            else:
+                print(critical_path_report(args.target))
+        elif args.cmd == "stats":
+            if args.json:
+                d = stats_data(args.target)
+                d["ranks"] = {str(k): v for k, v in d["ranks"].items()}
+                print(json.dumps(d))
+            else:
+                print(stats_report(args.target))
+    except (FileNotFoundError, ValueError) as exc:
+        print(f"error: {exc}", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

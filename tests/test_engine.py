@@ -194,8 +194,11 @@ def test_timeline_written(tmp_path):
     names = {ev.get("name") for ev in events if ev}
     assert tl.ALLREDUCE in names and tl.BROADCAST in names and tl.QUEUE in names
     lanes = {ev["args"]["name"] for ev in events
-             if ev and ev.get("ph") == "M"}
+             if ev and ev.get("ph") == "M"
+             and ev.get("name") == "process_name"}
     assert {"tensor_a", "tensor_b"} <= lanes
+    # Distributed tracing: the clock mapping rides every trace.
+    assert any(ev.get("name") == "HVD_CLOCK" for ev in events if ev)
 
 
 def test_fused_many_small_beats_unfused(hvd):

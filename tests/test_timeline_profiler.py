@@ -35,8 +35,11 @@ def _summarize(path):
     for ev in json.load(open(path)):
         if not ev:
             continue
-        if ev.get("name") == "process_name":
-            lanes[ev["pid"]] = ev["args"]["name"]
+        if ev.get("ph") == "M":
+            # Structural metadata: lane names feed the summary; the
+            # HVD_CLOCK record (distributed tracing) is not a span.
+            if ev.get("name") == "process_name":
+                lanes[ev["pid"]] = ev["args"]["name"]
             continue
         pid = ev.get("pid")
         args = ev.get("args")

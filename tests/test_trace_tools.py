@@ -1,0 +1,504 @@
+"""Tier-1 tests for the distributed-tracing surface (ISSUE 3): the
+trace-analysis CLI over a checked-in synthetic two-rank capture (one
+rank truncated mid-event — pins the truncation-tolerant reader), the
+always-on flight recorder of BOTH engines, clock-anchor exchange, the
+``now_us`` disabled-timeline fix, and ``stats --watch live``."""
+
+import json
+import os
+import signal
+import threading
+import time
+
+import numpy as np
+import pytest
+
+DATA = os.path.join(os.path.dirname(__file__), "data", "trace_tworank")
+
+
+# ---------------------------------------------------------------------------
+# Truncation-tolerant reader + merge/skew over the checked-in capture
+# ---------------------------------------------------------------------------
+
+
+def test_truncated_rank_file_still_loads():
+    """rank1's file is cut mid-event (no closing bracket, a dangling
+    half-written line) — the reader must recover every complete event."""
+    from horovod_tpu.utils import trace
+
+    events = trace.load_events(os.path.join(DATA, "timeline.rank1.json"))
+    assert events, "truncated file yielded nothing"
+    # The half-written trailing event is dropped; the last COMPLETE one
+    # (rank 1's second self RANK_READY) survives.
+    assert events[-1]["name"] == "RANK_READY"
+    assert events[-1]["ts"] == 249800
+    names = {ev["name"] for ev in events}
+    assert {"QUEUE", "NEGOTIATE_ALLREDUCE", "RANK_READY"} <= names
+
+
+def test_merge_aligns_ranks_on_common_base(tmp_path):
+    """pid = rank, tid = tensor lane, and the two ranks' NEGOTIATE spans
+    for the same tensor overlap once mapped through HVD_CLOCK."""
+    from horovod_tpu.utils import trace
+
+    out = str(tmp_path / "merged.json")
+    info = trace.merge(DATA, out=out)
+    assert info["files"] == 2 and info["ranks"] == [0, 1]
+    merged = json.load(open(out))
+    procs = {ev["pid"]: ev["args"]["name"] for ev in merged
+             if ev.get("name") == "process_name"}
+    assert procs == {0: "rank 0", 1: "rank 1"}
+    lanes = {(ev["pid"], ev["tid"]): ev["args"]["name"] for ev in merged
+             if ev.get("name") == "thread_name"}
+    assert lanes[(0, 1)] == lanes[(1, 1)] == "grad/0"
+    spans = {}
+    stack = {}
+    for ev in merged:
+        if ev.get("name") != "NEGOTIATE_ALLREDUCE":
+            continue
+        key = ev["pid"]
+        if ev["ph"] == "B":
+            stack.setdefault(key, []).append(ev["ts"])
+        elif ev["ph"] == "E" and stack.get(key):
+            spans.setdefault(key, []).append((stack[key].pop(), ev["ts"]))
+    (b0, e0) = sorted(spans[0])[0]
+    (b1, e1) = sorted(spans[1])[0]
+    assert b0 < e1 and b1 < e0, (spans[0], spans[1])  # overlap
+    # The fixture's clocks: rank0 base 999501100, rank1's first
+    # NEGOTIATE begins inside rank0's span on the common base.
+    assert b0 < b1 < e0
+
+
+def test_skew_blames_late_rank_with_exact_waits():
+    from horovod_tpu.utils import trace
+
+    d = trace.skew_data(DATA)
+    assert d["ranks"] == [0, 1]
+    assert d["instances"] == 2  # paired self-announcements per rank
+    # Fixture arithmetic: rank1 late by 99500 us then 49600 us.
+    assert d["wait_us"] == {0: 0, 1: 149100}
+    assert d["late_count"][1] == 2
+    assert d["worst"]["rank"] == 1 and d["worst"]["skew_us"] == 99500
+    assert d["per_tensor"]["grad/0"]["worst_rank"] == 1
+    # The clock metadata (and its error bound) is surfaced.
+    assert d["clock"][1]["rtt_us"] == 900
+
+
+def test_skew_cross_checks_telemetry_prom(tmp_path, capsys):
+    from horovod_tpu.utils import trace
+
+    prom = tmp_path / "tele.prom"
+    prom.write_text(
+        "# TYPE hvd_straggler_wait_microseconds counter\n"
+        'hvd_straggler_wait_microseconds{process="0"} 120\n'
+        'hvd_straggler_wait_microseconds{process="1"} 150000\n')
+    assert trace.parse_straggler_prom(str(prom)) == {0: 120, 1: 150000}
+    report = trace.skew_report(DATA, prom=str(prom))
+    assert "process 1: imposed wait 0.149 s" in report
+    assert "telemetry straggler report: 0.150 s" in report
+
+
+def test_critical_path_and_stats_over_capture():
+    from horovod_tpu.utils import trace
+
+    d = trace.critical_path_data(DATA)
+    # rank0 has 2 complete QUEUE instances, rank1 has 1 (the truncated
+    # second instance has no QUEUE end and is dropped).
+    assert d["instances"] == 3
+    assert d["phase_us"]["NEGOTIATE"] > 0
+    assert d["phase_us"]["COLLECTIVE"] == 7000 + 500  # the two allreduces
+    assert abs(sum(d["shares"].values()) - 1.0) < 1e-9
+    assert d["slowest"][0]["total_us"] >= d["slowest"][-1]["total_us"]
+
+    s = trace.stats_data(DATA)
+    assert set(s["ranks"]) == {0, 1}
+    assert s["ranks"][0]["counts"]["RANK_READY"] == 4
+    assert s["ranks"][1]["clock"]["rank"] == 1
+
+
+def test_trace_cli_subcommands(tmp_path, capsys):
+    from horovod_tpu.utils import trace
+
+    out = str(tmp_path / "m.json")
+    assert trace.main(["merge", DATA, "-o", out]) == 0
+    assert "2 rank file(s)" in capsys.readouterr().out
+    assert json.load(open(out))
+
+    assert trace.main(["skew", DATA]) == 0
+    text = capsys.readouterr().out
+    assert "process 1: imposed wait 0.149 s" in text
+    assert "skew error bound" in text
+
+    assert trace.main(["skew", DATA, "--json"]) == 0
+    d = json.loads(capsys.readouterr().out)
+    assert d["wait_us"]["1"] == 149100
+
+    assert trace.main(["critical-path", DATA]) == 0
+    assert "phase shares" in capsys.readouterr().out
+    assert trace.main(["stats", DATA]) == 0
+    assert "rank 0" in capsys.readouterr().out
+
+    assert trace.main(["merge", str(tmp_path / "nonexistent")]) == 1
+    # Re-analyzing merge's own output would silently double-rebase the
+    # timestamps — refused with directions instead.
+    assert trace.main(["skew", out]) == 1
+    assert "MERGED trace" in capsys.readouterr().err
+
+
+# ---------------------------------------------------------------------------
+# now_us satellite: the disabled timeline returns the real clock
+# ---------------------------------------------------------------------------
+
+
+def test_now_us_returns_real_clock_when_disabled(hvd):
+    """A caller computing retro-span boundaries from now_us() must never
+    receive 0 from a disabled timeline (a timeline enabled mid-run would
+    then emit zero/negative timestamps). Both writers."""
+    from horovod_tpu.core.timeline import Timeline
+
+    t = Timeline(None)
+    a = t.now_us()
+    time.sleep(0.01)
+    b = t.now_us()
+    assert b > a >= 0
+    t2 = Timeline(None)
+    # Two disabled timelines tick the same clock family (monotonic).
+    assert t2.now_us() >= 0
+
+    from horovod_tpu.core.native_engine import NativeEngine
+
+    e = NativeEngine(timeline_path="")
+    n1 = int(e._lib.hvd_engine_timeline_now(e._ptr))
+    time.sleep(0.01)
+    n2 = int(e._lib.hvd_engine_timeline_now(e._ptr))
+    assert n2 > n1 >= 0
+    e.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# Flight recorder: both engines, identical event names, no file needed
+# ---------------------------------------------------------------------------
+
+
+def _flight_ops(engine):
+    engine.synchronize(
+        engine.allreduce_async("f/a", np.ones((4,), np.float32), False))
+    engine.synchronize(
+        engine.allgather_async("f/g", np.ones((2, 3), np.float32)))
+    engine.synchronize(
+        engine.broadcast_async("f/c", np.ones((5,), np.float32), 0))
+
+
+def test_flight_recorder_parity_without_timeline_file(hvd):
+    """The acceptance contract: the last-N events are recorded by BOTH
+    engine implementations under identical event names, with no
+    HVD_TIMELINE set."""
+    from horovod_tpu.core.engine import Engine
+    from horovod_tpu.core.native_engine import NativeEngine
+    from horovod_tpu.core.timeline import Timeline
+
+    e_py = Engine(timeline=Timeline(None))
+    _flight_ops(e_py)
+    py_events = e_py.timeline.recent()
+    e_py.shutdown()
+
+    e_cpp = NativeEngine(timeline_path="")
+    _flight_ops(e_cpp)
+    cpp_events = e_cpp.recent_events()
+    e_cpp.shutdown()
+
+    py_names = {ev["name"] for ev in py_events}
+    cpp_names = {ev["name"] for ev in cpp_events}
+    assert py_names == cpp_names, py_names ^ cpp_names
+    assert {"QUEUE", "WAIT_FOR_DATA", "ALLREDUCE", "ALLGATHER",
+            "BROADCAST", "HVD_CLOCK"} <= py_names
+    # Same (tensor, activity, phase) coverage for the span events.
+    def shape(evs):
+        return {(ev.get("tensor"), ev["name"], ev["ph"]) for ev in evs
+                if ev["ph"] in ("B", "E")}
+    assert shape(py_events) == shape(cpp_events)
+
+
+def test_flight_dump_loadable_and_carries_telemetry(hvd, tmp_path,
+                                                    monkeypatch):
+    monkeypatch.setenv("HVD_FLIGHT_DIR", str(tmp_path))
+    from horovod_tpu.core import timeline as tl
+
+    events = [{"name": "QUEUE", "ph": "B", "ts": 1, "tensor": "t"}]
+    path = tl.dump_flight_recorder(events, "unit test", rank=3)
+    assert path and os.path.dirname(path) == str(tmp_path)
+    dump = json.load(open(path))
+    assert dump["rank"] == 3 and dump["reason"] == "unit test"
+    assert dump["events"] == events
+    assert "telemetry" in dump and "straggler" in dump
+    # The trace CLI accepts dump files wherever a trace file goes.
+    from horovod_tpu.utils import trace
+
+    assert trace.load_events(path) == events
+
+
+def test_sigusr1_dumps_flight_recorder(hvd, tmp_path, monkeypatch):
+    monkeypatch.setenv("HVD_FLIGHT_DIR", str(tmp_path))
+    from horovod_tpu.core.engine import Engine
+    from horovod_tpu.core.timeline import Timeline
+
+    e = Engine(timeline=Timeline(None))
+    try:
+        e.synchronize(
+            e.allreduce_async("s/x", np.ones((2,), np.float32), False))
+        os.kill(os.getpid(), signal.SIGUSR1)
+        deadline = time.monotonic() + 5.0
+        dumps = []
+        while time.monotonic() < deadline and not dumps:
+            dumps = [f for f in os.listdir(tmp_path)
+                     if f.startswith("hvd_flight.rank")
+                     and f.endswith(".json")]
+            time.sleep(0.01)
+        assert dumps, os.listdir(tmp_path)
+        dump = json.load(open(tmp_path / dumps[0]))
+        assert dump["reason"] == "SIGUSR1"
+        assert any(ev["name"] == "ALLREDUCE" for ev in dump["events"])
+    finally:
+        e.shutdown()
+    # Shutdown unregisters the dumper: the module global must not pin a
+    # dead engine, and a later SIGUSR1 must not dump its stale ring.
+    from horovod_tpu.core import timeline as tl
+
+    assert tl._sigusr1_dump is None
+
+
+def test_stall_warning_dumps_flight_recorder(hvd, tmp_path, monkeypatch):
+    """A stalled tensor leaves a post-mortem (_check_stalls dump)."""
+    monkeypatch.setenv("HVD_FLIGHT_DIR", str(tmp_path))
+    from horovod_tpu.core.engine import Engine
+    from horovod_tpu.core.timeline import Timeline
+
+    gate = threading.Event()
+
+    class Plug:
+        def allreduce(self, flat, average):
+            gate.wait(10.0)
+            return flat.copy()
+
+    e = Engine(executor=Plug(), stall_warning_s=0.05,
+               timeline=Timeline(None))
+    try:
+        h = e.allreduce_async("stuck", np.ones((2,), np.float32), False)
+        deadline = time.monotonic() + 5.0
+        dumps = []
+        while time.monotonic() < deadline and not dumps:
+            dumps = [f for f in os.listdir(tmp_path)
+                     if f.startswith("hvd_flight.rank")
+                     and f.endswith(".json")]
+            time.sleep(0.02)
+        assert dumps, "no stall dump written"
+        dump = json.load(open(tmp_path / dumps[0]))
+        assert "stalled" in dump["reason"] and "stuck" in dump["reason"]
+    finally:
+        gate.set()
+        e.synchronize(h)
+        e.shutdown()
+
+
+def test_native_stall_dump_written(hvd, tmp_path, monkeypatch):
+    """Stall-dump parity: the C++ engine's python-side watchdog dumps
+    when in-flight work stops progressing (the twin of the python
+    engine's _check_stalls dump)."""
+    monkeypatch.setenv("HVD_FLIGHT_DIR", str(tmp_path))
+    from horovod_tpu.core.native_engine import NativeEngine
+
+    gate = threading.Event()
+
+    class Plug:
+        def allreduce(self, flat, average):
+            gate.wait(15.0)
+            return flat.copy()
+
+    e = NativeEngine(executor=Plug(), stall_warning_s=0.2,
+                     timeline_path="")
+    try:
+        h = e.allreduce_async("stuck", np.ones((2,), np.float32), False)
+        deadline = time.monotonic() + 8.0
+        dumps = []
+        while time.monotonic() < deadline and not dumps:
+            dumps = [f for f in os.listdir(tmp_path)
+                     if f.startswith("hvd_flight.rank")
+                     and f.endswith(".json")]
+            time.sleep(0.05)
+        assert dumps, "no native stall dump written"
+        dump = json.load(open(tmp_path / dumps[0]))
+        assert "stalled" in dump["reason"], dump["reason"]
+        assert any(ev["name"] == "QUEUE" for ev in dump["events"])
+    finally:
+        gate.set()
+        e.synchronize(h)
+        e.shutdown()
+
+
+def test_skew_over_flight_dump_directory(tmp_path, monkeypatch):
+    """The documented post-mortem recipe: a dir of hvd_flight.rank*.json
+    dumps (no timeline files) is analyzable by the CLI — the newest
+    dump per rank stands in for the rank's trace."""
+    from horovod_tpu.core import timeline as tl
+    from horovod_tpu.utils import trace
+
+    monkeypatch.setenv("HVD_FLIGHT_DIR", str(tmp_path))
+    for rank, (epoch, self_ts) in enumerate(
+            [(1000000000, 1000), (1000200000, 101000)]):
+        events = [
+            {"name": "HVD_CLOCK", "ph": "M", "ts": 0,
+             "args": {"rank": rank, "epoch_wall_us": epoch,
+                      "offset_us": 500000 + rank * 200000}},
+            {"name": "NEGOTIATE_ALLREDUCE", "ph": "B", "ts": self_ts - 100,
+             "tensor": "g"},
+            {"name": "RANK_READY", "ph": "i", "ts": self_ts, "tensor": "g",
+             "args": {"process": rank}},
+        ]
+        tl.dump_flight_recorder(events, "test", rank=rank)
+    d = trace.skew_data(str(tmp_path))
+    # Common base: rank0 self at 999501000, rank1 at 999601000.
+    assert d["instances"] == 1
+    assert d["wait_us"] == {0: 0, 1: 100000}
+    assert d["late_count"][1] == 1
+
+
+# ---------------------------------------------------------------------------
+# Clock-anchor exchange (unit, LocalKV)
+# ---------------------------------------------------------------------------
+
+
+def test_clock_anchor_exchange_over_localkv():
+    """Both coordinators converge on rank 0's wall↔monotonic bridge with
+    a finite measured KV round trip — the merge tool's common base."""
+    from horovod_tpu.core.coordinator import Coordinator, LocalKV
+
+    store = {}
+    coords = {}
+    errors = []
+
+    def worker(pid):
+        c = Coordinator(LocalKV(store), 2, pid, 0.005, 0, timeout_s=10.0)
+        coords[pid] = c
+        try:
+            for _ in range(3):  # sync converges within a round or two
+                c.negotiate([])
+        except Exception as exc:  # pragma: no cover - surfaced below
+            errors.append(exc)
+
+    threads = [threading.Thread(target=worker, args=(p,)) for p in (0, 1)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(20)
+    assert not errors
+    c0, c1 = coords[0], coords[1]
+    assert c0.clock_ready and c1.clock_ready
+    assert c0.clock_rtt_us >= 0 and c1.clock_rtt_us >= 0
+    # Same process ⇒ identical wall/monotonic clocks ⇒ the bridges agree
+    # to well under a second; rank 1 adopted rank 0's exactly.
+    assert c1.clock_offset_us == c0.clock_offset_us
+    # close() queues the clock keys as residue for the next generation.
+    c0.close()
+    from horovod_tpu.core import coordinator as coord
+
+    with coord._residue_lock:
+        assert any(k.endswith("/clock/p0") for _, k in coord._residue)
+        coord._residue[:] = [e for e in coord._residue
+                             if e[0] != c0.ns]  # leave no cross-test junk
+    c1.close()
+    with coord._residue_lock:
+        coord._residue[:] = [e for e in coord._residue if e[0] != c1.ns]
+
+
+def test_timeline_clock_sync_reemits_metadata(tmp_path):
+    from horovod_tpu.core.timeline import Timeline
+
+    path = str(tmp_path / "tl.json")
+    t = Timeline(path, rank=2)
+    t.start("x", "QUEUE")
+    t.clock_sync(123456, 789)
+    t.end("x", "QUEUE")
+    t.close()
+    events = json.load(open(path))
+    clocks = [ev for ev in events if ev.get("name") == "HVD_CLOCK"]
+    assert len(clocks) == 2  # open-time + post-exchange
+    last = clocks[-1]["args"]
+    assert last == {"rank": 2, "epoch_wall_us": t.epoch_wall_us,
+                    "offset_us": 123456, "rtt_us": 789}
+    # The merge tool uses the LAST one.
+    from horovod_tpu.utils.trace import RankTrace
+
+    rt = RankTrace(path)
+    assert rt.clock["offset_us"] == 123456 and rt.rank == 2
+
+
+def test_timeline_legacy_file_paths_stay_file_mode(tmp_path):
+    """An existing plain file (the reference allowed arbitrary trace
+    filenames, e.g. HOROVOD_TIMELINE=/tmp/hvd.trace) must never be
+    classified as a directory — makedirs on it would crash engine
+    init."""
+    from horovod_tpu.core import timeline as tl
+
+    legacy = tmp_path / "hvd.trace"
+    legacy.write_text("[\n")
+    assert not tl.is_dir_mode(str(legacy))
+    assert tl.resolve_timeline_path(str(legacy), rank=0) == str(legacy)
+    # Nonexistent non-.json path: dir mode (the documented rule).
+    assert tl.is_dir_mode(str(tmp_path / "traces"))
+
+
+def test_sigusr1_chains_the_application_handler(monkeypatch, tmp_path):
+    """The dump handler must be additive: an application handler
+    installed before hvd (e.g. SLURM preemption checkpointing) still
+    runs on SIGUSR1."""
+    from horovod_tpu.core import timeline as tl
+
+    dumped, chained = [], []
+    monkeypatch.setattr(tl, "_sigusr1_dump", dumped.append)
+    monkeypatch.setattr(tl, "_sigusr1_prev",
+                        lambda signum, frame: chained.append(signum))
+    tl._on_sigusr1(signal.SIGUSR1, None)
+    deadline = time.monotonic() + 5.0
+    while time.monotonic() < deadline and not dumped:
+        time.sleep(0.01)
+    assert dumped == ["SIGUSR1"]
+    assert chained == [signal.SIGUSR1]
+
+
+def test_timeline_dir_mode_resolves_per_rank(tmp_path, monkeypatch):
+    from horovod_tpu.core import timeline as tl
+
+    d = str(tmp_path / "traces")
+    monkeypatch.setenv("HVD_TIMELINE", d)
+    monkeypatch.setenv("HVD_PROCESS_ID", "5")
+    # Process index comes from topology once initialized; the hvd
+    # fixture may have run, so force the env path by asking explicitly.
+    assert tl.resolve_timeline_path(d, rank=5) == \
+        os.path.join(d, "timeline.rank5.json")
+    assert os.path.isdir(d)
+    # The single-file spelling is untouched.
+    f = str(tmp_path / "single.json")
+    assert tl.resolve_timeline_path(f, rank=5) == f
+
+
+# ---------------------------------------------------------------------------
+# stats --watch live (satellite)
+# ---------------------------------------------------------------------------
+
+
+def test_stats_live_watch_redraws_and_exits_cleanly(monkeypatch, capsys):
+    from horovod_tpu.utils import stats
+
+    sleeps = []
+
+    def fake_sleep(s):
+        sleeps.append(s)
+        if len(sleeps) >= 2:
+            raise KeyboardInterrupt  # the user's Ctrl-C
+
+    monkeypatch.setattr(stats.time, "sleep", fake_sleep)
+    assert stats.main(["live", "--watch", "0.5"]) == 0
+    out = capsys.readouterr().out
+    # Redrawn once per sleep: at least two reports before the interrupt.
+    assert out.count("\n\n") >= 1 or len(out.splitlines()) >= 2
+    assert sleeps == [0.5, 0.5]
