@@ -26,6 +26,14 @@ Modes:
 Run: PYTHONPATH=. python examples/allreduce_benchmark.py --sizes-mb 1 16 64
      PYTHONPATH=. python examples/allreduce_benchmark.py --engine \
          --sizes-kb 1 64 1024 65536 --tensors 16
+
+Multi-process (the engine control plane under negotiation — the
+``--decompose`` table then carries the NEGOTIATE phase, split cached vs
+full by the response cache; compare against HVD_CACHE_CAPACITY=0 run
+sequentially for the measured win, docs/running.md "Negotiation cache"):
+     python -m horovod_tpu.run -np 2 --cpu -- python \
+         examples/allreduce_benchmark.py --engine --tensors 8 \
+         --sizes-kb 64 --iters 30 --decompose --json
 """
 
 import argparse
@@ -54,12 +62,20 @@ def _decompose_timeline(path, n_ops):
     submitted together OVERLAP — per-op queue time is what a caller
     experiences, not a wall-clock component), WAIT_FOR_DATA the
     host→device staging leg, ALLREDUCE the eager collective incl. the
-    device→host fetch, MEMCPY_* the fusion-buffer pack/unpack."""
+    device→host fetch, MEMCPY_* the fusion-buffer pack/unpack.
+
+    Multi-controller runs additionally carry NEGOTIATE_* spans; those
+    are split by the ``cached`` arg the engines stamp on the span end —
+    the negotiate-phase column comparing response-cache fast rounds vs
+    full-table rounds (run once with the default cache and once with
+    HVD_CACHE_CAPACITY=0 for the measured win). Returns the data for
+    ``--json``."""
     import collections
     import json
 
     stack = {}
     totals = collections.defaultdict(float)
+    neg_durs = {"cached": [], "full": []}
     for ev in json.load(open(path)):
         if not ev or ev.get("ph") not in ("B", "E"):
             continue
@@ -68,16 +84,48 @@ def _decompose_timeline(path, n_ops):
             stack.setdefault(key, []).append((ev.get("name"), ev["ts"]))
         elif stack.get(key):
             name, ts0 = stack[key].pop()
-            totals[name] += (ev["ts"] - ts0) / 1e6
+            dur_s = (ev["ts"] - ts0) / 1e6
+            totals[name] += dur_s
+            if str(name).startswith("NEGOTIATE_"):
+                cached = ev.get("args", {}).get("cached")
+                if cached is not None:
+                    neg_durs["cached" if cached else "full"].append(dur_s)
     accounted = sum(totals.values())
     print(f"# per-op phase decomposition ({n_ops} ops):")
     for name, s in sorted(totals.items(), key=lambda kv: -kv[1]):
         print(f"#   {s / n_ops * 1e3:10.2f} ms/op "
               f"{100 * s / accounted:5.1f}%  {name}")
+    negotiate = {}
+    for kind, durs in neg_durs.items():
+        if durs:
+            durs.sort()
+            negotiate[kind] = {
+                "n": len(durs),
+                "median_ms": round(durs[len(durs) // 2] * 1e3, 3),
+                "total_ms": round(sum(durs) * 1e3, 2),
+            }
+    if negotiate:
+        import os
+
+        parts = [f"{k} n={v['n']} median={v['median_ms']:.3f} ms"
+                 for k, v in sorted(negotiate.items())]
+        print(f"#   negotiate rounds (HVD_CACHE_CAPACITY="
+              f"{os.environ.get('HVD_CACHE_CAPACITY', 'default')}): "
+              + " | ".join(parts))
+    return {
+        "phases_ms_per_op": {k: round(v / n_ops * 1e3, 4)
+                             for k, v in totals.items()},
+        "negotiate": negotiate or None,
+    }
 
 
 def run_engine(args, tl_path):
-    """Engine-path sweep: bytes/µs through the async host engine."""
+    """Engine-path sweep: bytes/µs through the async host engine.
+    Tensor names are STABLE across iterations (``bench/{i}`` — the
+    per-step-gradient pattern a training loop exhibits), so on a
+    multi-process world steady-state negotiation rides the response
+    cache's bitvector fast path; compare against HVD_CACHE_CAPACITY=0
+    for the measured control-plane win."""
     from horovod_tpu.core import engine as eng
 
     e = eng.get_engine()
@@ -86,6 +134,7 @@ def run_engine(args, tl_path):
           f"{e.fusion_threshold}, tensors/iter={args.tensors}")
     print(f"# {'size/tensor':>12s} {'total':>10s} {'time':>10s} "
           f"{'bytes/us':>9s} {'host_bw':>9s}")
+    rows = []
     for kb in args.sizes_kb:
         # --decompose shuts the engine down after each size to flush its
         # timeline; a fresh singleton picks up cleanly.
@@ -94,31 +143,37 @@ def run_engine(args, tl_path):
         tensors = [np.ones((elems,), np.float32) for _ in range(args.tensors)]
         total = sum(t.nbytes for t in tensors)
 
-        def one_iter(it):
+        def one_iter():
             handles = [
-                e.allreduce_async(f"bench/{it}/{i}", t, average=False)
+                e.allreduce_async(f"bench/{i}", t, average=False)
                 for i, t in enumerate(tensors)
             ]
             for h in handles:
                 e.synchronize(h)
 
-        for w in range(args.warmup):
-            one_iter(f"w{w}")
+        for _ in range(args.warmup):
+            one_iter()
         t0 = time.perf_counter()
-        for i in range(args.iters):
-            one_iter(i)
+        for _ in range(args.iters):
+            one_iter()
         wall = time.perf_counter() - t0
         dt = wall / args.iters
         print(f"  {kb:10.1f}kB {total/1e6:8.2f}MB {dt*1e3:8.3f}ms "
               f"{total/dt/1e6:9.1f} {total/dt/1e9:7.2f}GB/s")
+        row = {"size_kb": kb, "total_mb": round(total / 1e6, 3),
+               "ms_per_iter": round(dt * 1e3, 4),
+               "bytes_per_us": round(total / dt / 1e6, 2)}
         if tl_path:
             from horovod_tpu.core import engine as _e
 
             # Flush the timeline for parsing; the next size's fresh
             # engine reopens the path with mode "w" and truncates it.
             _e.shutdown_engine()
-            _decompose_timeline(tl_path,
-                                (args.warmup + args.iters) * args.tensors)
+            row["decompose"] = _decompose_timeline(
+                tl_path, (args.warmup + args.iters) * args.tensors)
+        rows.append(row)
+    return {"mode": "engine", "engine": kind, "tensors": args.tensors,
+            "iters": args.iters, "rows": rows}
 
 
 def main():
@@ -151,6 +206,13 @@ def main():
                          "HOROVOD_HIERARCHICAL_ALLREDUCE). Needs a "
                          "two-tier world: multi-process, or "
                          "HVD_TWO_TIER_SHAPE=o,i to split one host.")
+    ap.add_argument("--json", action="store_true",
+                    help="additionally print ONE machine-readable JSON "
+                         "line with the sweep results (and, with "
+                         "--decompose, the per-phase + negotiate "
+                         "cached/full split) — the engine-path analogue "
+                         "of bench.py's line, for tracking round-trip "
+                         "latency across rounds")
     args = ap.parse_args()
 
     import os
@@ -169,7 +231,23 @@ def main():
         os.environ["HVD_TIMELINE"] = tl_path
     hvd.init()
     if args.engine:
-        run_engine(args, tl_path)
+        result = run_engine(args, tl_path)
+        if args.json:
+            import json as _json
+
+            result["nproc"] = hvd.num_processes()
+            result["cache_capacity"] = os.environ.get(
+                "HVD_CACHE_CAPACITY", "default")
+            try:
+                from horovod_tpu.core import telemetry as _tele
+
+                flat = _tele.REGISTRY.flat()
+                result["negotiation_cache"] = {
+                    k.rsplit(".", 1)[1]: v for k, v in flat.items()
+                    if k.startswith("engine.negotiation.cache_")}
+            except Exception:
+                pass
+            print(_json.dumps(result))
         return
     n = hvd.size()
     mesh = hvd.mesh()
@@ -183,6 +261,7 @@ def main():
     print(f"# world: {n} chip(s), platform="
           f"{jax.devices()[0].platform}, mode={mode}")
 
+    rows = []
     for mb in args.sizes_mb:
         elems = int(mb * 1024 * 1024 / 4)
         # Per-chip payload of `elems` f32, stacked over the mesh.
@@ -205,6 +284,9 @@ def main():
         print(f"size={mb:8.1f} MB/chip  time={dt*1e3:8.3f} ms  "
               f"busbw={bus_bytes/dt/1e9:8.2f} GB/s  "
               f"alg_bw={payload/dt/1e9:8.2f} GB/s")
+        rows.append({"size_mb": mb, "ms": round(dt * 1e3, 4),
+                     "busbw_gbs": round(bus_bytes / dt / 1e9, 3),
+                     "alg_bw_gbs": round(payload / dt / 1e9, 3)})
 
         if not args.decompose:
             continue
@@ -238,6 +320,14 @@ def main():
               f"all_gather={t_ag*1e3:8.3f} ms  "
               f"rs+ag={(t_rs+t_ag)*1e3:8.3f} ms  "
               f"(allreduce {dt*1e3:8.3f} ms)")
+        rows[-1]["phases_ms"] = {
+            "reduce_scatter": round(t_rs * 1e3, 4),
+            "all_gather": round(t_ag * 1e3, 4)}
+    if args.json:
+        import json as _json
+
+        print(_json.dumps({"mode": "spmd", "world": n,
+                           "collective_mode": mode, "rows": rows}))
 
 
 if __name__ == "__main__":

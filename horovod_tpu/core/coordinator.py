@@ -30,15 +30,33 @@ also what powers missing-rank stall attribution (reference:
 CheckForStalledTensors, operations.cc:1535-1581): every round each process
 sees exactly who has NOT yet submitted a stalled tensor.
 
-Cleanup: a process deletes its round-``N-1`` key after completing round
-``N`` reads (everyone publishing round ``N`` proves round ``N-1`` was
-fully consumed). Shutdown publishes a tombstone key peers poll while
-blocked, so a clean exit propagates as ``ShutdownError`` instead of a
-hang (reference: shutdown flag in MPIRequestList, operations.cc:2008-2011).
+Cleanup: after completing round ``N`` a process deletes every consumed
+round key it still owns (everyone publishing round ``N`` proves all
+rounds ``< N`` were fully consumed). Shutdown publishes a tombstone key
+peers poll while blocked, so a clean exit propagates as ``ShutdownError``
+instead of a hang (reference: shutdown flag in MPIRequestList,
+operations.cc:2008-2011).
+
+Response cache (reference: horovod/common/response_cache.cc, the
+optimization arxiv 1802.05799 + the MPI-coordination study 1810.11112
+motivate — per-tensor negotiation dominates small-tensor overhead at
+scale): a training loop submits the SAME tensor set thousands of times,
+so each process keeps a capacity-bounded LRU of previously-agreed
+request identities (:class:`ResponseCache`). When every entry of a
+round hits the cache on every process, the round degrades to exchanging
+one compact bitvector (+ cache-epoch) instead of the full wire tables,
+and ``decide()`` is skipped for a memoized group composition. Coherence
+is lockstep by construction: cache mutations (inserts, recency, LRU
+evictions) happen only from round data every process observes
+identically, and the epoch carried by every message detects any
+divergence — on mismatch ALL processes complete the round with nothing
+scheduled, clear their caches, and resynchronize on the next full-table
+round, so a stale hit is structurally impossible.
 """
 
 from __future__ import annotations
 
+import heapq
 import json
 import logging
 import os
@@ -99,6 +117,29 @@ def aggregation_enabled() -> bool:
     val = (os.environ.get("HVD_NEGOTIATION_AGGREGATE")
            or os.environ.get("HOROVOD_NEGOTIATION_AGGREGATE") or "0")
     return val.lower() not in ("0", "false", "off")
+
+
+def cache_capacity_from_env() -> int:
+    """HVD_CACHE_CAPACITY: max cached tensor identities per process
+    (0 disables the negotiation response cache). Must be set identically
+    on every process — like the reference's HOROVOD_CACHE_CAPACITY
+    (response_cache.cc), mixed settings are a misconfiguration the
+    protocol fails fast on."""
+    val = (os.environ.get("HVD_CACHE_CAPACITY")
+           or os.environ.get("HOROVOD_CACHE_CAPACITY"))
+    if not val:
+        return 1024
+    if val.lower() in ("false", "off", "no"):
+        # The sibling boolean knobs (HVD_NEGOTIATION*) accept these
+        # spellings for "disabled" — honor them here too rather than
+        # silently enabling the cache at the default.
+        return 0
+    try:
+        return max(0, int(val))
+    except ValueError:
+        LOG.warning("unparseable HVD_CACHE_CAPACITY=%r; using the "
+                    "default 1024 (set 0/off to disable)", val)
+        return 1024
 
 
 class KVTimeout(Exception):
@@ -261,6 +302,171 @@ class Decision:
     cycle_time_s: Optional[float] = None
     fusion_threshold: Optional[int] = None
     idle_backoff_s: float = 0.0
+    # True when this round took the response-cache bitvector fast path
+    # (decide() skipped; groups from the memoized composition).
+    cached: bool = False
+
+
+class ResponseCache:
+    """Per-process LRU of previously-agreed request identities, keyed by
+    tensor name (reference: horovod/common/response_cache.cc).
+
+    Every process mutates its cache ONLY from round data all processes
+    observe identically (the agreed tables of full rounds, the decoded
+    bit-union of fast rounds), so bit assignment, LRU order, evictions
+    and the epoch advance in lockstep — equal epochs imply equal
+    name↔bit structure on every process, which is what makes a peer's
+    bitvector decodable locally. NOT thread-safe: owned by the
+    coordinator, driven by the engine's dispatch thread."""
+
+    def __init__(self, capacity: int):
+        self.capacity = int(capacity)
+        # name -> [bit, identity, wire_len]; insertion/touch order IS the
+        # LRU order (dict preserves it; _touch re-appends).
+        self._slots: Dict[str, list] = {}
+        self._names: Dict[int, str] = {}  # bit -> name
+        self._next_bit = 0
+        # Evicted positions, reused smallest-first (a min-heap): the
+        # bitvector mask stays bounded by the live-set high-water mark
+        # instead of growing with cumulative distinct-name insertions
+        # under churn (train/eval phase alternation). Reuse is safe:
+        # frees and re-allocations happen only in lockstep full-round
+        # maintenance, and every eviction advances the epoch.
+        self._free_bits: List[int] = []
+        self.epoch = 0
+        self.evictions = 0  # cumulative, for telemetry/invalidations
+
+    def __len__(self):
+        return len(self._slots)
+
+    @staticmethod
+    def _identity(m: RequestMeta) -> tuple:
+        """The full request identity a hit must match — stricter than
+        ``_fingerprint`` (no allgather dim-0 wildcard: a per-step-varying
+        first dim must renegotiate; everything except the submit-time
+        ``age_s`` counts)."""
+        return (m.op, m.dtype, m.itemsize, tuple(m.shape), m.average,
+                m.root_rank, m.prescale, m.nbytes)
+
+    def lookup(self, m: RequestMeta) -> Optional[int]:
+        """Bit of a cached identical request, or None (a changed shape/
+        dtype/op under the same name is a miss, never a stale hit)."""
+        slot = self._slots.get(m.name)
+        if slot is None or slot[1] != self._identity(m):
+            return None
+        return slot[0]
+
+    def bit_of(self, name: str) -> Optional[int]:
+        slot = self._slots.get(name)
+        return None if slot is None else slot[0]
+
+    def meta_of(self, bit: int) -> Optional[RequestMeta]:
+        name = self._names.get(bit)
+        if name is None:
+            return None
+        ident = self._slots[name][1]
+        op, dtype, itemsize, shape, average, root, prescale, nbytes = ident
+        return RequestMeta(name=name, op=op, dtype=dtype,
+                           itemsize=itemsize, shape=shape, average=average,
+                           root_rank=root, prescale=prescale,
+                           nbytes=nbytes)
+
+    def wire_len(self, bit: int) -> int:
+        name = self._names.get(bit)
+        return 0 if name is None else self._slots[name][2]
+
+    def insert(self, m: RequestMeta):
+        """Insert or update one agreed request. Callers drive this in a
+        DETERMINISTIC order from round data every process shares."""
+        slot = self._slots.get(m.name)
+        ident = self._identity(m)
+        wire_len = len(json.dumps(m.wire()))
+        if slot is not None:
+            slot[1] = ident  # same bit: the update is lockstep too
+            slot[2] = wire_len
+            self._touch(m.name)
+            return
+        if self._free_bits:
+            bit = heapq.heappop(self._free_bits)
+        else:
+            bit = self._next_bit
+            self._next_bit += 1
+        self._slots[m.name] = [bit, ident, wire_len]
+        self._names[bit] = m.name
+
+    def _touch(self, name: str):
+        self._slots[name] = self._slots.pop(name)
+
+    def touch(self, names) -> None:
+        """Refresh recency for every cached name in ``names`` —
+        iterated sorted so LRU order stays identical everywhere."""
+        for n in sorted(names):
+            if n in self._slots:
+                self._touch(n)
+
+    def evict_over_capacity(self) -> int:
+        """Drop LRU entries beyond capacity. Any eviction advances the
+        epoch (a freed bit must never be misread by an in-flight
+        assumption) — and means the evicted tensor's next submission
+        misses, forcing a full-table round."""
+        evicted = 0
+        while len(self._slots) > self.capacity:
+            name = next(iter(self._slots))
+            bit = self._slots.pop(name)[0]
+            del self._names[bit]
+            heapq.heappush(self._free_bits, bit)
+            evicted += 1
+        if evicted:
+            self.epoch += 1
+            self.evictions += evicted
+        return evicted
+
+    def evict(self, name: str) -> bool:
+        """Drop one entry (epoch advances). Normal operation never calls
+        this asymmetrically — it exists for coherence tests and for a
+        future invalidate-by-name surface."""
+        slot = self._slots.pop(name, None)
+        if slot is None:
+            return False
+        del self._names[slot[0]]
+        heapq.heappush(self._free_bits, slot[0])
+        self.epoch += 1
+        self.evictions += 1
+        return True
+
+    def invalidate(self, epoch: Optional[int] = None):
+        """Full clear + epoch advance (the lockstep divergence
+        resolution: every process clears to the same fresh epoch)."""
+        self._slots.clear()
+        self._names.clear()
+        self._next_bit = 0
+        self._free_bits.clear()
+        self.epoch = (self.epoch + 1) if epoch is None else int(epoch)
+
+    # -- bitvector wire form -------------------------------------------------
+
+    @staticmethod
+    def encode(bits) -> str:
+        """Set of bit positions -> compact hex mask (the wire form: the
+        mask is bounded by the live-set high-water mark — evicted
+        positions are reused — so a 1024-entry cache stays ~256 hex
+        chars vs the full per-tensor wire tables)."""
+        mask = 0
+        for b in bits:
+            mask |= 1 << b
+        return format(mask, "x")
+
+    @staticmethod
+    def decode_mask(hexmask: str):
+        mask = int(hexmask, 16)
+        out = set()
+        bit = 0
+        while mask:
+            if mask & 1:
+                out.add(bit)
+            mask >>= 1
+            bit += 1
+        return out
 
 
 def _fingerprint(m: RequestMeta):
@@ -300,6 +506,32 @@ def _mismatch_message(name: str, metas: Dict[int, RequestMeta]) -> str:
     return f"Mismatched collective '{name}'"
 
 
+def _fuse_names(ready: Sequence[RequestMeta],
+                fusion_threshold: int) -> List[List[str]]:
+    """Group ready requests for execution: lexicographic name order,
+    allreduces fused per (dtype, average, prescale) up to the threshold.
+    Pure + deterministic — shared by ``decide`` (full rounds) and the
+    response-cache fast path (which memoizes the result)."""
+    name_groups: List[List[str]] = []
+    open_groups: Dict[tuple, List[str]] = {}
+    open_bytes: Dict[tuple, int] = {}
+    for m in sorted(ready, key=lambda m: m.name):
+        if m.op != "allreduce" or fusion_threshold <= 0:
+            name_groups.append([m.name])
+            continue
+        key = (m.dtype, m.average, m.prescale)
+        g = open_groups.get(key)
+        if g is not None and open_bytes[key] + m.nbytes <= fusion_threshold:
+            g.append(m.name)
+            open_bytes[key] += m.nbytes
+        else:
+            g = [m.name]
+            open_groups[key] = g
+            open_bytes[key] = m.nbytes
+            name_groups.append(g)
+    return name_groups
+
+
 def decide(tables: Dict[int, List[RequestMeta]], my_entries: Sequence[RequestMeta],
            fusion_threshold: int) -> List[Group]:
     """The pure decision function — MUST be deterministic in its inputs,
@@ -323,24 +555,8 @@ def decide(tables: Dict[int, List[RequestMeta]], my_entries: Sequence[RequestMet
         else:
             ready.append(metas[0] if 0 in metas else next(iter(metas.values())))
 
-    groups: List[Group] = []
-    open_groups: Dict[tuple, Group] = {}
-    open_bytes: Dict[tuple, int] = {}
-    for m in sorted(ready, key=lambda m: m.name):
-        idx = local_index[m.name]
-        if m.op != "allreduce" or fusion_threshold <= 0:
-            groups.append(Group([idx]))
-            continue
-        key = (m.dtype, m.average, m.prescale)
-        g = open_groups.get(key)
-        if g is not None and open_bytes[key] + m.nbytes <= fusion_threshold:
-            g.indices.append(idx)
-            open_bytes[key] += m.nbytes
-        else:
-            g = Group([idx])
-            open_groups[key] = g
-            open_bytes[key] = m.nbytes
-            groups.append(g)
+    groups = [Group([local_index[n] for n in names])
+              for names in _fuse_names(ready, fusion_threshold)]
     for name in sorted(errors):
         groups.append(Group([local_index[name]], errors[name]))
     return groups
@@ -354,7 +570,8 @@ class Coordinator:
                  cycle_time_s: float, fusion_threshold: int,
                  stall_warning_s: float = 60.0,
                  timeout_s: Optional[float] = None,
-                 namespace: str = "hvd/neg/g0"):
+                 namespace: str = "hvd/neg/g0",
+                 cache_capacity: Optional[int] = None):
         self.kv = kv
         self.nproc = num_processes
         self.pid = process_index
@@ -375,8 +592,25 @@ class Coordinator:
         # cost"): rounds completed, wall time inside negotiate(), and
         # actual KV get attempts (each blocking poll slice counts — the
         # O(P) reads/round that make total KV load O(P^2)/round).
-        self.stats = {"rounds": 0, "round_s": 0.0, "kv_gets": 0}
+        self.stats = {"rounds": 0, "round_s": 0.0, "kv_gets": 0,
+                      "fast_rounds": 0}
         self.aggregate = aggregation_enabled()
+        # Negotiation response cache (the bitvector fast path). Off under
+        # the gather-tree round shape: aggregation already collapses the
+        # per-round KV load to O(P) through p0's digest, and the digest
+        # republish would carry the full tables regardless.
+        if cache_capacity is None:
+            cache_capacity = cache_capacity_from_env()
+        self.cache = (ResponseCache(cache_capacity)
+                      if cache_capacity > 0 and not self.aggregate else None)
+        # (frozenset of ready bits, fusion) -> agreed [[name, ...], ...]:
+        # the memoized group composition a fast round reuses so decide()
+        # is skipped entirely. Valid only between cache mutations — full
+        # rounds clear it.
+        self._group_memo: Dict[tuple, List[List[str]]] = {}
+        self._cache_bytes_saved = 0
+        # Consumed round keys are reclaimed up to (excluding) this round.
+        self._gc_round = 0
         # Straggler attribution state: first-observed announce time per
         # (name, process) from the round tables, and the names already
         # charged to the telemetry tracker (a recurring name — per-step
@@ -561,22 +795,60 @@ class Coordinator:
         self._maybe_clock_sync()
         t_round = time.monotonic()
         rnd = self.round
-        msg = {"entries": [m.wire() for m in entries]}
+        cache = self.cache
+        my_bits: Optional[set] = None
+        if cache is not None:
+            bits = [cache.lookup(m) for m in entries]
+            nhits = sum(b is not None for b in bits)
+            if nhits:
+                _tele.REGISTRY.counter(
+                    "engine.negotiation.cache_hits").inc(nhits)
+            if len(bits) - nhits:
+                _tele.REGISTRY.counter(
+                    "engine.negotiation.cache_misses").inc(len(bits) - nhits)
+            if nhits == len(bits):
+                # Every local entry hit (vacuously true when idle): this
+                # process's half of the round is one compact bitvector +
+                # the cache epoch instead of the full wire tables.
+                my_bits = set(bits)
+        if my_bits is not None:
+            msg = {"bits": ResponseCache.encode(my_bits), "ce": cache.epoch,
+                   "cc": cache.capacity}
+        else:
+            msg = {"entries": [m.wire() for m in entries]}
+            if cache is not None:
+                msg["ce"] = cache.epoch
+                msg["cc"] = cache.capacity
         if self.pid == 0:
             msg["params"] = [self.cycle_time_s, self.fusion_threshold]
+        payload = json.dumps(msg)
         if not (self.aggregate and self.pid == 0):
             # In gather-tree mode p0's table rides the digest only —
             # publishing its per-round key too would be a dead KV write
             # on exactly the plane aggregation exists to unload.
             try:
-                self.kv.set(self._round_key(rnd, self.pid), json.dumps(msg))
+                self.kv.set(self._round_key(rnd, self.pid), payload)
             except KVError as exc:
                 self.dead = str(exc)
                 self.close()  # tombstone: let peers fail fast, not time out
                 raise
+        if my_bits is not None:
+            # Wire bytes NOT published: the full table this process would
+            # have sent, minus the bitvector it did send.
+            full_len = (sum(cache.wire_len(b) for b in my_bits)
+                        + 2 * len(my_bits) + 16)
+            self._cache_bytes_saved += max(0, full_len - len(payload))
 
         tables: Dict[int, List[RequestMeta]] = {
             self.pid: list(entries)}
+        # Processes whose round message was a decodable bitvector (self
+        # included when publishing one) — the round is a FAST round when
+        # this covers the whole world.
+        bit_tables: Dict[int, set] = {}
+        if my_bits is not None:
+            bit_tables[self.pid] = my_bits
+        epochs_seen = {cache.epoch} if cache is not None else set()
+        epoch_mismatch = False
         params = msg.get("params")
         try:
             if self.aggregate and self.pid != 0:
@@ -601,10 +873,55 @@ class Coordinator:
                         continue
                     peer_msg = self._read_peer(rnd, peer,
                                                deadline=gather_deadline)
-                    tables[peer] = [RequestMeta.from_wire(w)
-                                    for w in peer_msg.get("entries", [])]
                     if peer == 0:
                         params = peer_msg.get("params")
+                    # Capacity handshake: every cache-carrying message
+                    # names its capacity, so ANY mix — zero vs nonzero,
+                    # or two different nonzero values (whose lone-rank
+                    # evictions would otherwise oscillate the world
+                    # through endless epoch resets) — fails fast by
+                    # name on the very first round.
+                    peer_cc = peer_msg.get("cc")
+                    my_cc = None if cache is None else cache.capacity
+                    if peer_cc != my_cc:
+                        raise KVError(
+                            "HVD_CACHE_CAPACITY mismatch: process "
+                            f"{peer} runs response-cache capacity "
+                            f"{peer_cc or 0} while this process runs "
+                            f"{my_cc or 0} — set HVD_CACHE_CAPACITY "
+                            "identically on every process")
+                    if "bits" in peer_msg:
+                        epochs_seen.add(peer_msg.get("ce"))
+                        if peer_msg.get("ce") != cache.epoch:
+                            # Divergent cache state (e.g. a peer evicted
+                            # on its own): resolved in lockstep below.
+                            epoch_mismatch = True
+                            continue
+                        pbits = ResponseCache.decode_mask(peer_msg["bits"])
+                        metas = [cache.meta_of(b) for b in sorted(pbits)]
+                        if any(m is None for m in metas):
+                            # Equal epochs imply identical name<->bit
+                            # structure; an unknown slot is a protocol
+                            # invariant violation — surface it, never
+                            # guess a table.
+                            raise KVError(
+                                "negotiation response cache corrupt: "
+                                f"process {peer} referenced an unknown "
+                                f"cache slot at epoch {cache.epoch}")
+                        bit_tables[peer] = pbits
+                        tables[peer] = metas
+                        full_len = (sum(cache.wire_len(b) for b in pbits)
+                                    + 2 * len(pbits) + 16)
+                        self._cache_bytes_saved += max(
+                            0, full_len - len(json.dumps(peer_msg)))
+                    else:
+                        ce = peer_msg.get("ce")
+                        if ce is not None:
+                            epochs_seen.add(ce)
+                            if cache is not None and ce != cache.epoch:
+                                epoch_mismatch = True
+                        tables[peer] = [RequestMeta.from_wire(w)
+                                        for w in peer_msg.get("entries", [])]
                 if self.aggregate:
                     # Gather-tree mode, root: republish the round once.
                     self.kv.set(self._digest_key(rnd), json.dumps({
@@ -627,14 +944,20 @@ class Coordinator:
             # out the full negotiation timeout.
             self.close()
             raise
+        if cache is not None:
+            _tele.REGISTRY.gauge(
+                "engine.negotiation.cache_bytes_saved").set(
+                    self._cache_bytes_saved)
         self.round = rnd + 1
-        # Everyone has published round `rnd`, so round `rnd-1` keys are
-        # fully consumed — reclaim ours.
-        if rnd > 0:
-            self.kv.delete(self._round_key(rnd - 1, self.pid))
+        # Everyone has published round `rnd`, so every round `< rnd` is
+        # fully consumed — reclaim all of ours that are still out there,
+        # so a long training's store stays bounded (satellite: KV GC).
+        while self._gc_round < rnd:
+            self.kv.delete(self._round_key(self._gc_round, self.pid))
             if self.aggregate and self.pid == 0:
-                self.kv.delete(self._digest_key(rnd - 1))
-        elif rnd == 0:
+                self.kv.delete(self._digest_key(self._gc_round))
+            self._gc_round += 1
+        if rnd == 0:
             # Every peer is in THIS generation now, so no one can ever
             # read a prior generation's keys again — reclaim the residue
             # its close() recorded (round keys + tombstones).
@@ -656,7 +979,47 @@ class Coordinator:
         # The DECISION still uses the round's published params on every
         # process — batch composition must be computed from identical
         # inputs everywhere; a newer local value joins the next round.
-        groups = decide(tables, entries, int(fusion))
+
+        if epoch_mismatch:
+            # Lockstep coherence reset: every process read the SAME
+            # message set, so every process observes the mismatch,
+            # schedules NOTHING this round (entries stay pending — a
+            # stale hit is structurally impossible), clears its cache to
+            # the same fresh epoch, and resynchronizes on the next
+            # full-table round.
+            cache.invalidate(max(e for e in epochs_seen
+                                 if e is not None) + 1)
+            self._group_memo.clear()
+            _tele.REGISTRY.counter(
+                "engine.negotiation.cache_invalidations").inc()
+            LOG.warning(
+                "negotiation response cache epoch diverged across "
+                "processes; caches cleared in lockstep (epoch %d), "
+                "renegotiating with full tables", cache.epoch)
+            self.stats["rounds"] += 1
+            self.stats["round_s"] += time.monotonic() - t_round
+            return Decision(groups=[], cycle_time_s=cycle_s,
+                            fusion_threshold=int(fusion))
+
+        fast = (my_bits is not None and len(bit_tables) == self.nproc)
+        if fast:
+            # Every process's round was an equal-epoch bitvector: the
+            # identities are pinned by the cache agreement, so readiness
+            # is pure set intersection and decide() is skipped for the
+            # memoized composition.
+            ready_bits = set(my_bits)
+            for s in bit_tables.values():
+                ready_bits &= s
+            groups = self._fast_groups(entries, ready_bits, int(fusion))
+            announced = set()
+            for metas in tables.values():
+                announced.update(m.name for m in metas)
+            cache.touch(announced)  # recency from common knowledge
+            self.stats["fast_rounds"] += 1
+        else:
+            groups = decide(tables, entries, int(fusion))
+            if cache is not None:
+                self._cache_maintain(tables, groups, entries)
         self.last_tables = {pid: {m.name for m in metas}
                             for pid, metas in tables.items()}
         self._track_stragglers()
@@ -671,7 +1034,53 @@ class Coordinator:
         self.stats["round_s"] += time.monotonic() - t_round
         return Decision(groups=groups, cycle_time_s=cycle_s,
                         fusion_threshold=int(fusion),
-                        idle_backoff_s=backoff)
+                        idle_backoff_s=backoff, cached=fast)
+
+    # -- response-cache internals -------------------------------------------
+
+    def _fast_groups(self, entries: Sequence[RequestMeta], ready_bits,
+                     fusion: int) -> List[Group]:
+        """Group composition of a fast round without decide(): the ready
+        set is the bit intersection, the composition is memoized per
+        (ready set, fusion threshold) — same agreed grouping on every
+        process because the cached identities are identical."""
+        key = (frozenset(ready_bits), int(fusion))
+        name_groups = self._group_memo.get(key)
+        if name_groups is None:
+            cache = self.cache
+            ready = [m for m in entries
+                     if cache.bit_of(m.name) in ready_bits]
+            name_groups = _fuse_names(ready, int(fusion))
+            if len(self._group_memo) > 256:
+                self._group_memo.clear()  # bounded memory
+            self._group_memo[key] = name_groups
+        local_index = {m.name: i for i, m in enumerate(entries)}
+        return [Group([local_index[n] for n in g]) for g in name_groups]
+
+    def _cache_maintain(self, tables: Dict[int, List[RequestMeta]],
+                        groups: List[Group],
+                        entries: Sequence[RequestMeta]):
+        """Lockstep cache update after a full round. Inputs — the agreed
+        tables and the decision computed from them — are identical on
+        every process, and every mutation below iterates them in sorted
+        order, so insertions, bit assignment, recency and LRU evictions
+        advance identically everywhere (the induction that makes equal
+        epochs imply identical caches)."""
+        cache = self.cache
+        announced = set()
+        for metas in tables.values():
+            announced.update(m.name for m in metas)
+        cache.touch(announced)
+        agreed = [i for g in groups if g.error is None for i in g.indices]
+        for i in sorted(agreed, key=lambda i: entries[i].name):
+            cache.insert(entries[i])
+        evicted = cache.evict_over_capacity()
+        if evicted:
+            # Evicted identities will miss on their next submission —
+            # the eviction-driven full-round fallback.
+            _tele.REGISTRY.counter(
+                "engine.negotiation.cache_invalidations").inc(evicted)
+        self._group_memo.clear()  # composition may reference new state
 
     # -- stall attribution (reference: CheckForStalledTensors,
     # operations.cc:1535-1581 — names the ranks holding up each tensor) ----
@@ -787,7 +1196,9 @@ _generation = 0
 
 def make_coordinator(cycle_time_s: float, fusion_threshold: int,
                      stall_warning_s: float,
-                     warn_stalls: bool = True) -> Optional[Coordinator]:
+                     warn_stalls: bool = True,
+                     cache_capacity: Optional[int] = None
+                     ) -> Optional[Coordinator]:
     """Build a Coordinator for the current topology, or None when the run
     is single-controller / negotiation is disabled / no KV service."""
     global _generation
@@ -803,11 +1214,12 @@ def make_coordinator(cycle_time_s: float, fusion_threshold: int,
     except KVError:
         LOG.warning("multi-controller run without a jax.distributed "
                     "coordination service; negotiation disabled (fusion "
-                    "stays off)")
+                    "and the response cache stay off)")
         return None
     gen = _generation
     _generation += 1
     return Coordinator(kv, topo.num_processes(), topo.process_index(),
                        cycle_time_s, fusion_threshold,
                        stall_warning_s if warn_stalls else 0.0,
-                       namespace=f"hvd/neg/g{gen}")
+                       namespace=f"hvd/neg/g{gen}",
+                       cache_capacity=cache_capacity)

@@ -216,12 +216,30 @@ def _negotiated() -> bool:
         return False
 
 
+def record_cache_config(capacity: int, forced_off: bool = False):
+    """Surface the EFFECTIVE negotiation-cache capacity in telemetry
+    (`hvd.telemetry_report()` then says whether the cache is on, and
+    whether the negotiation-fallback rule forced it off along with
+    fusion)."""
+    tele.REGISTRY.gauge("engine.negotiation.cache_capacity").set(
+        int(capacity))
+    # Always written (not only when 1): a later engine generation with
+    # negotiation available must clear a stale forced-off marker, or the
+    # report would say "capacity 1024" and "forced off" at once.
+    tele.REGISTRY.gauge("engine.negotiation.cache_forced_off").set(
+        1 if forced_off else 0)
+
+
 def config_from_env(cycle_time_s: Optional[float],
                     fusion_threshold: Optional[int],
                     stall_warning_s: float):
     """Shared env-knob parsing for both engine implementations (reference:
     operations.cc:1732-1804). Returns (cycle_time_s, fusion_threshold,
-    stall_warning_s)."""
+    stall_warning_s, cache_capacity).
+
+    The negotiation response cache follows the same fallback rule as
+    fusion: HVD_NEGOTIATION=0 or no usable KV store forces it off —
+    without negotiated rounds there is no control plane to cache."""
     if cycle_time_s is None:
         ms = os.environ.get("HVD_CYCLE_TIME") or os.environ.get(
             "HOROVOD_CYCLE_TIME")
@@ -230,8 +248,22 @@ def config_from_env(cycle_time_s: Optional[float],
         b = os.environ.get("HVD_FUSION_THRESHOLD") or os.environ.get(
             "HOROVOD_FUSION_THRESHOLD")
         fusion_threshold = int(b) if b else DEFAULT_FUSION_THRESHOLD
-    if _multi_controller() and not _negotiated():
-        fusion_threshold = 0
+    from horovod_tpu.core import coordinator as _coord
+
+    cache_capacity = _coord.cache_capacity_from_env()
+    if _multi_controller():
+        if not _negotiated():
+            fusion_threshold = 0
+            forced = cache_capacity > 0
+            cache_capacity = 0
+            record_cache_config(0, forced_off=forced)
+        else:
+            if _coord.aggregation_enabled():
+                # Gather-tree rounds republish full tables through p0's
+                # digest by design — the Coordinator keeps the cache off,
+                # and telemetry must say 0, not pretend it is on.
+                cache_capacity = 0
+            record_cache_config(cache_capacity)
     st = os.environ.get("HVD_STALL_CHECK_TIME") or os.environ.get(
         "HOROVOD_STALL_CHECK_TIME")
     if st:  # seconds; reference hardcodes 60 (operations.cc:253)
@@ -239,7 +271,7 @@ def config_from_env(cycle_time_s: Optional[float],
     if os.environ.get("HVD_STALL_CHECK_DISABLE") or os.environ.get(
             "HOROVOD_STALL_CHECK_DISABLE"):
         stall_warning_s = 0.0
-    return cycle_time_s, fusion_threshold, stall_warning_s
+    return cycle_time_s, fusion_threshold, stall_warning_s, cache_capacity
 
 
 def record_submit(op: str, nbytes: int, queue_depth: int):
@@ -295,8 +327,9 @@ class Engine:
         stall_warning_s: float = STALL_WARNING_TIME_S,
         timeline: Optional[tl.Timeline] = None,
     ):
-        self.cycle_time_s, self.fusion_threshold, stall_warning_s = \
-            config_from_env(cycle_time_s, fusion_threshold, stall_warning_s)
+        (self.cycle_time_s, self.fusion_threshold, stall_warning_s,
+         self.cache_capacity) = config_from_env(
+            cycle_time_s, fusion_threshold, stall_warning_s)
         self.stall_warning_s = stall_warning_s or STALL_WARNING_TIME_S
         self.stall_check_disabled = stall_warning_s == 0.0
         self.executor = executor or JaxExecutor()
@@ -471,6 +504,12 @@ class Engine:
             self.fusion_threshold = 0 if (
                 _multi_controller() and not _negotiated()
             ) else fusion_threshold
+        if (self.cache_capacity and _multi_controller()
+                and not _negotiated()):
+            # The response cache follows fusion's fallback rule: no
+            # negotiated rounds, nothing to cache.
+            self.cache_capacity = 0
+            record_cache_config(0, forced_off=True)
         if self._coordinator is not None:
             self._coordinator.cycle_time_s = self.cycle_time_s
             self._coordinator.fusion_threshold = self.fusion_threshold
@@ -495,11 +534,16 @@ class Engine:
         self._coordinator = coord.make_coordinator(
             self.cycle_time_s, self.fusion_threshold,
             0.0 if self.stall_check_disabled else self.stall_warning_s,
-            warn_stalls=False)
+            warn_stalls=False, cache_capacity=self.cache_capacity)
         if self._coordinator is None:
-            # Fall back to the unfused, name-ordered local path for good.
+            # Fall back to the unfused, name-ordered local path for good
+            # (the response cache rides the same rule: no rounds to
+            # compress).
             self._coord_unavailable = True
             self.fusion_threshold = 0
+            if self.cache_capacity:
+                self.cache_capacity = 0
+                record_cache_config(0, forced_off=True)
 
     def _negotiated_cycle(self, entries):
         """One negotiation round: agree on batch composition with every
@@ -567,11 +611,16 @@ class Engine:
                                               {"process": p})
         done = set()
         executed_bytes = 0
+        # `cached` on the span end: whether the round that RESOLVED this
+        # tensor took the response-cache bitvector fast path — the trace
+        # CLI attributes fast vs full rounds from it.
+        neg_args = {"cached": decision.cached}
         for g in decision.groups:
             ents = [self._negotiating[i] for i in g.indices]
             done.update(g.indices)
             for e in ents:
-                self.timeline.end(e.name, f"NEGOTIATE_{e.op.upper()}")
+                self.timeline.end(e.name, f"NEGOTIATE_{e.op.upper()}",
+                                  neg_args)
             if g.error:
                 for e in ents:
                     self._complete(e, None, EngineError(g.error))

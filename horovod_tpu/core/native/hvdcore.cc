@@ -103,6 +103,9 @@ typedef int (*hvd_exec_fn)(void* ctx, hvd_request* req, hvd_result* res);
 // writes an hvd_alloc()'d decision string to *decision_out (the engine
 // frees it):
 //   p <cycle_s> <fusion_bytes>      agreed engine params for this round
+//   c <0|1>                         round took the response-cache fast path
+//                                   (stamped as the NEGOTIATE span's
+//                                   `cached` arg)
 //   w <seconds>                     one-shot extra wait before next cycle
 //   g <i,i,...>                     execute these entries as one group
 //   e <i,i,...> <message>           complete these entries with an error
@@ -199,8 +202,11 @@ class Timeline {
              const std::string& args = "") {
     Emit(name, phase, 'B', args, -1);
   }
-  void End(const std::string& name, const char* phase) {
-    Emit(name, phase, 'E', "", -1);
+  // End may carry args too (e.g. the `cached` flag on NEGOTIATE_* spans
+  // — the attribution is only known when the round resolves).
+  void End(const std::string& name, const char* phase,
+           const std::string& args = "") {
+    Emit(name, phase, 'E', args, -1);
   }
 
   // Retro-emission at explicit timestamps: a phase boundary learned only
@@ -753,6 +759,7 @@ class Engine {
   long long ParseAndExecute(const std::string& decision) {
     std::vector<bool> done(negotiating_.size(), false);
     long long executed_bytes = 0;
+    bool cached = false;  // response-cache fast round ('c 1' line)
     size_t pos = 0;
     while (pos < decision.size()) {
       size_t eol = decision.find('\n', pos);
@@ -767,6 +774,10 @@ class Engine {
         long long fus = -1;
         if (sscanf(rest.c_str(), "%lf %lld", &cyc, &fus) == 2)
           SetParams(cyc, fus);
+        continue;
+      }
+      if (kind == 'c') {
+        cached = atoi(rest.c_str()) != 0;
         continue;
       }
       if (kind == 'w') {
@@ -796,7 +807,9 @@ class Engine {
         group.push_back(&negotiating_[idx]);
       }
       if (bad || group.empty()) continue;  // malformed line: leave pending
-      for (auto* e : group) timeline_.End(e->name, NegPhase(e->op));
+      for (auto* e : group)
+        timeline_.End(e->name, NegPhase(e->op),
+                      cached ? "\"cached\": true" : "\"cached\": false");
       if (kind == 'e') {
         for (auto* e : group)
           Complete(*e, nullptr, 0, nullptr,

@@ -32,6 +32,7 @@ from horovod_tpu.core.engine import (
     _negotiated,
     config_from_env,
     make_autotuner,
+    record_cache_config,
     record_submit,
 )
 
@@ -135,7 +136,11 @@ def _make_negotiator(engine):
                     for i in g.indices:
                         seen.pop(metas[i].name, None)
             lines = [f"p {decision.cycle_time_s} "
-                     f"{decision.fusion_threshold}"]
+                     f"{decision.fusion_threshold}",
+                     # Whether this round took the response-cache fast
+                     # path — the C++ loop stamps it as the `cached` arg
+                     # on the NEGOTIATE_* span ends it owns.
+                     f"c {1 if decision.cached else 0}"]
             if decision.idle_backoff_s:
                 lines.append(f"w {decision.idle_backoff_s}")
             for g in decision.groups:
@@ -229,8 +234,9 @@ class NativeEngine:
                  fusion_threshold: Optional[int] = None,
                  stall_warning_s: float = STALL_WARNING_TIME_S,
                  timeline_path: Optional[str] = None):
-        self.cycle_time_s, self.fusion_threshold, stall_warning_s = \
-            config_from_env(cycle_time_s, fusion_threshold, stall_warning_s)
+        (self.cycle_time_s, self.fusion_threshold, stall_warning_s,
+         self.cache_capacity) = config_from_env(
+            cycle_time_s, fusion_threshold, stall_warning_s)
         self._stall_warning_s = stall_warning_s
         if timeline_path is None:
             timeline_path = tl.timeline_path_from_env() or ""
@@ -419,7 +425,8 @@ class NativeEngine:
         from horovod_tpu.core import coordinator as coord
 
         self._coordinator = coord.make_coordinator(
-            self.cycle_time_s, self.fusion_threshold, self._stall_warning_s)
+            self.cycle_time_s, self.fusion_threshold, self._stall_warning_s,
+            cache_capacity=self.cache_capacity)
         if self._coordinator is not None:
             self._lib.hvd_engine_set_negotiation_active(self._ptr, 1)
 
@@ -500,10 +507,14 @@ class NativeEngine:
         self._maybe_activate_negotiation()
         if _multi_controller() and self._coordinator is None:
             # No negotiation available: fall back to unfused, name-ordered
-            # execution (see engine.config_from_env).
+            # execution (see engine.config_from_env) — and the response
+            # cache follows the same rule.
             self._lib.hvd_engine_set_sort_by_name(self._ptr, 1)
             if fusion_threshold is not None:
                 fusion_threshold = 0
+            if self.cache_capacity:
+                self.cache_capacity = 0
+                record_cache_config(0, forced_off=True)
         self._lib.hvd_engine_set_params(
             self._ptr,
             -1.0 if cycle_time_s is None else float(cycle_time_s),

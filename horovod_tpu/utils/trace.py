@@ -261,6 +261,14 @@ def skew_data(target: str) -> dict:
     the round tables, here measured from the traces themselves."""
     traces = load_traces(target)
     per_rank_marks = {t.rank: _self_marks(t) for t in traces}
+    negotiate_rounds = {}
+    for t in traces:
+        counts = {"cached": 0, "full": 0}
+        for _, _, cached in _negotiate_rounds(
+                _spans(t, with_end_args=True)):
+            if cached is not None:
+                counts["cached" if cached else "full"] += 1
+        negotiate_rounds[t.rank] = counts
     ranks = sorted(per_rank_marks)
     wait_us: Dict[int, int] = {r: 0 for r in ranks}
     late_count: Dict[int, int] = {r: 0 for r in ranks}
@@ -302,6 +310,7 @@ def skew_data(target: str) -> dict:
         "late_count": late_count,
         "per_tensor": per_tensor,
         "worst": worst,
+        "negotiate_rounds": negotiate_rounds,
         "clock": {t.rank: t.clock for t in traces},
     }
 
@@ -346,6 +355,10 @@ def skew_report(target: str, prom: Optional[str] = None) -> str:
                 f"(late on {d['late_count'][r]}/{d['instances']} instances)")
         if r in tele:
             line += f" [telemetry straggler report: {tele[r] / 1e6:.3f} s]"
+        nr = d["negotiate_rounds"].get(r, {})
+        if nr.get("cached") or nr.get("full"):
+            line += (f" [negotiate spans: {nr['cached']} cached / "
+                     f"{nr['full']} full]")
         lines.append(line)
     for name, pt in sorted(d["per_tensor"].items()):
         if pt["worst_rank"] is not None:
@@ -373,10 +386,13 @@ def skew_report(target: str, prom: Optional[str] = None) -> str:
 # ---------------------------------------------------------------------------
 
 
-def _spans(trace: RankTrace) -> Dict[Tuple[str, str], List[Tuple[int, int]]]:
+def _spans(trace: RankTrace, with_end_args: bool = False
+           ) -> Dict[Tuple[str, str], List[tuple]]:
     """(tensor, activity) → [(begin, end)] in common time, from B/E
-    pairs. Unbalanced begins (truncated trace) are dropped."""
-    out: Dict[Tuple[str, str], List[Tuple[int, int]]] = {}
+    pairs; ``with_end_args`` appends the END event's args as a third
+    element (e.g. the `cached` attribution on NEGOTIATE spans).
+    Unbalanced begins (truncated trace) are dropped."""
+    out: Dict[Tuple[str, str], List[tuple]] = {}
     open_spans: Dict[Tuple[str, str], List[int]] = {}
     for ev in trace.events:
         ph = ev.get("ph")
@@ -391,11 +407,51 @@ def _spans(trace: RankTrace) -> Dict[Tuple[str, str], List[Tuple[int, int]]]:
         else:
             stack = open_spans.get(key)
             if stack:
-                out.setdefault(key, []).append(
-                    (stack.pop(), trace.common_ts(ev["ts"])))
+                span = (stack.pop(), trace.common_ts(ev["ts"]))
+                if with_end_args:
+                    span += (ev.get("args", {}),)
+                out.setdefault(key, []).append(span)
     for v in out.values():
-        v.sort()
+        v.sort(key=lambda s: s[:2])  # args dicts are not orderable
     return out
+
+
+def _negotiate_rounds(spans: Dict[Tuple[str, str], List[tuple]]
+                      ) -> List[Tuple[str, int, Optional[bool]]]:
+    """Every completed NEGOTIATE_* span of an already-paired span dict
+    (``_spans(trace, with_end_args=True)``) as (tensor, duration_us,
+    cached) — ``cached`` is the response-cache attribution the engines
+    stamp on the span END (only the resolving round knows whether it
+    took the bitvector fast path); None for traces predating the arg."""
+    out: List[Tuple[str, int, Optional[bool]]] = []
+    for (tensor, act), sp in spans.items():
+        if not act.startswith("NEGOTIATE_"):
+            continue
+        for b, e, args in sp:
+            out.append((tensor, e - b, args.get("cached")))
+    return out
+
+
+def negotiate_attribution(span_dicts) -> dict:
+    """Fast-vs-full attribution of negotiate time across ranks: counts,
+    total µs and median µs of spans resolved by cached (bitvector)
+    rounds vs full-table rounds. Takes the per-trace span dicts the
+    caller already computed — no second pass over the events."""
+    split = {"cached": [], "full": [], "unknown": []}
+    for spans in span_dicts:
+        for _, dur, cached in _negotiate_rounds(spans):
+            bucket = ("unknown" if cached is None
+                      else "cached" if cached else "full")
+            split[bucket].append(dur)
+
+    def stats(durs):
+        if not durs:
+            return {"count": 0, "us": 0, "median_us": None}
+        durs = sorted(durs)
+        return {"count": len(durs), "us": sum(durs),
+                "median_us": durs[len(durs) // 2]}
+
+    return {k: stats(v) for k, v in split.items()}
 
 
 def _phase_of(activity: str) -> Optional[str]:
@@ -417,19 +473,21 @@ def critical_path_data(target: str) -> dict:
     traces = load_traces(target)
     phase_us = {p: 0 for p in _PHASE_ORDER}
     instances: List[dict] = []
+    span_dicts = []  # reused for the negotiate attribution: ONE pass
     for t in traces:
-        spans = _spans(t)
+        spans = _spans(t, with_end_args=True)
+        span_dicts.append(spans)
         nested: Dict[str, List[Tuple[int, int, str]]] = {}
         for (tensor, act), sp in spans.items():
             phase = _phase_of(act)
             if phase is None:
                 continue
-            for b, e in sp:
+            for b, e, _ in sp:
                 nested.setdefault(tensor, []).append((b, e, phase))
         for (tensor, act), sp in spans.items():
             if act != "QUEUE":
                 continue
-            for b, e in sp:
+            for b, e, _ in sp:
                 inst = {"rank": t.rank, "tensor": tensor,
                         "total_us": e - b,
                         "phases": {p: 0 for p in _PHASE_ORDER}}
@@ -447,7 +505,8 @@ def critical_path_data(target: str) -> dict:
               for p in _PHASE_ORDER}
     instances.sort(key=lambda i: -i["total_us"])
     return {"instances": len(instances), "phase_us": phase_us,
-            "shares": shares, "slowest": instances[:5]}
+            "shares": shares, "slowest": instances[:5],
+            "negotiate": negotiate_attribution(span_dicts)}
 
 
 def critical_path_report(target: str) -> str:
@@ -457,6 +516,18 @@ def critical_path_report(target: str) -> str:
     for p in _PHASE_ORDER:
         lines.append(f"  {p:26s} {d['phase_us'][p] / 1e3:12.1f} ms "
                      f"{d['shares'][p] * 100:5.1f}%")
+    neg = d.get("negotiate", {})
+    if any(neg.get(k, {}).get("count") for k in ("cached", "full")):
+        # Response-cache attribution: which negotiate rounds rode the
+        # bitvector fast path vs full wire tables.
+        parts = []
+        for k in ("cached", "full"):
+            s = neg.get(k, {"count": 0})
+            if s["count"]:
+                parts.append(f"{k} n={s['count']} "
+                             f"median={s['median_us'] / 1e3:.2f} ms")
+        lines.append("negotiate rounds (response cache): "
+                     + " | ".join(parts))
     if d["slowest"]:
         lines.append("slowest instances (the critical path):")
         for inst in d["slowest"]:

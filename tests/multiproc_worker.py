@@ -425,6 +425,104 @@ def main():
         # member — skip interpreter teardown after the PASS line.
         print(f"proc {pid}: SCENARIO {scenario} PASSED", flush=True)
         os._exit(0)
+    elif scenario == "engine_cache":
+        # Negotiation response cache (ISSUE 4): a STABLE tensor set
+        # re-submitted every step — the per-step-gradient pattern — must
+        # collapse steady-state rounds to the bitvector fast path (hit
+        # counter >> miss counter, zero steady-state misses), a changed
+        # tensor set must fall back to a full-table round and stay
+        # correct, and reductions must be BITWISE identical to a
+        # cache-off world (the test diffs RESULT digests across runs
+        # with HVD_CACHE_CAPACITY unset vs =0).
+        import hashlib
+        import json as _json
+
+        from horovod_tpu.core import engine as eng
+        from horovod_tpu.core import telemetry as tele
+
+        cache_off = os.environ.get("HVD_CACHE_CAPACITY") == "0"
+        e = eng.get_engine()
+        digest = hashlib.sha1()
+
+        def step(names, step_no):
+            hs = [e.allreduce_async(
+                n, np.full((32,), float((i + 1) * (pid + 1) + step_no),
+                           np.float32) * 0.3, True)
+                  for i, n in enumerate(names)]
+            for h in hs:
+                digest.update(e.synchronize(h).tobytes())
+
+        names = [f"grad/{i}" for i in range(4)]
+        # Warmup absorbs startup skew (a pending entry re-counts a miss
+        # every round until the whole world announces it — only the
+        # steady-state deltas below are load-independent).
+        for s in range(3):
+            step(names, s)
+        c1 = tele.REGISTRY.flat_counters()
+        steady_steps = 8
+        for s in range(3, 3 + steady_steps):
+            step(names, s)
+        c2 = tele.REGISTRY.flat_counters()
+        if not cache_off:
+            hits = (c2.get("engine.negotiation.cache_hits", 0)
+                    - c1.get("engine.negotiation.cache_hits", 0))
+            misses = (c2.get("engine.negotiation.cache_misses", 0)
+                      - c1.get("engine.negotiation.cache_misses", 0))
+            assert hits >= len(names) * steady_steps, (hits, misses)
+            assert misses == 0, (hits, misses)  # steady state: all hit
+            c = getattr(e, "_coordinator", None)
+            assert c is not None and c.stats["fast_rounds"] > 0, c.stats
+            flat = tele.REGISTRY.flat()
+            assert flat.get("engine.negotiation.cache_bytes_saved", 0) > 0
+        # Changed tensor set: the new name misses -> full round; correct.
+        h = e.allreduce_async("late/extra",
+                              np.full((8,), float(pid + 2), np.float32),
+                              False)
+        out = e.synchronize(h)
+        expect = local_devices * sum(p + 2 for p in range(nproc))
+        np.testing.assert_allclose(out, np.full((8,), float(expect)))
+        digest.update(out.tobytes())
+        c3 = tele.REGISTRY.flat_counters()
+        if not cache_off:
+            assert c3.get("engine.negotiation.cache_misses", 0) > \
+                c2.get("engine.negotiation.cache_misses", 0), c3
+            assert "engine.negotiation.cache_invalidations" not in c3, c3
+        else:
+            # HVD_CACHE_CAPACITY=0: the cache must be fully inert.
+            assert "engine.negotiation.cache_hits" not in c3, c3
+        print("RESULT " + digest.hexdigest(), flush=True)
+        print(f"proc {pid}: CACHE " + _json.dumps(
+            {"hits": int(c3.get("engine.negotiation.cache_hits", 0)),
+             "misses": int(c3.get("engine.negotiation.cache_misses", 0))}),
+            flush=True)
+    elif scenario == "engine_cache_evict":
+        # Eviction-driven fallback (ISSUE 4 adversarial satellite): a
+        # capacity-2 cache (HVD_CACHE_CAPACITY=2, set by the test) can
+        # never hold the 4-tensor steady set — every round some entry
+        # missed or was just evicted, rounds stay FULL, evictions bump
+        # the invalidations counter in lockstep, and every reduction
+        # stays correct.
+        from horovod_tpu.core import engine as eng
+        from horovod_tpu.core import telemetry as tele
+
+        e = eng.get_engine()
+        for _ in range(6):
+            hs = [e.allreduce_async(
+                f"ev/{i}", np.full((8,), float(i + 1 + pid), np.float32),
+                False) for i in range(4)]
+            for i, h in enumerate(hs):
+                expect = local_devices * sum(i + 1 + p
+                                             for p in range(nproc))
+                np.testing.assert_allclose(
+                    e.synchronize(h), np.full((8,), float(expect)))
+        counters = tele.REGISTRY.flat_counters()
+        assert counters.get("engine.negotiation.cache_invalidations",
+                            0) > 0, counters
+        assert counters.get("engine.negotiation.cache_misses", 0) > 0
+        c = getattr(e, "_coordinator", None)
+        assert c is not None and c.cache is not None
+        assert len(c.cache) <= 2, len(c.cache)
+        print(f"proc {pid}: EVICT OK", flush=True)
     elif scenario == "engine_peer_shutdown":
         # Cooperative shutdown propagation (reference: shutdown flag in the
         # request list → SHUT_DOWN_ERROR for stragglers,

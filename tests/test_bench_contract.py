@@ -1,0 +1,85 @@
+"""CI guards for bench.py's external contract (CLAUDE.md architecture
+invariants): `bench.py --help` / `--dry` stay import-free (no jax, no
+framework — argparse errors must never pay the multi-second import), and
+the one-JSON-line output shape survives refactors. Also pins the
+machine-readable `--json` surface of examples/allreduce_benchmark.py at
+the argparse level (its full run needs a device world — covered by the
+examples smoke tier)."""
+
+import json
+import os
+import re
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+BENCH = os.path.join(REPO, "bench.py")
+
+
+@pytest.fixture()
+def poisoned_env(tmp_path):
+    """Environment where importing jax (or the framework package, which
+    imports jax) raises immediately — proves a subprocess never touched
+    either. The real PYTHONPATH is APPENDED (never replaced: the TPU
+    plugin path must survive, CLAUDE.md), with the poison dir first."""
+    poison = tmp_path / "poison"
+    poison.mkdir()
+    (poison / "jax").mkdir()
+    (poison / "jax" / "__init__.py").write_text(
+        "raise ImportError('bench.py --help/--dry must not import jax')\n")
+    env = dict(os.environ)
+    env["PYTHONPATH"] = str(poison) + os.pathsep + env.get("PYTHONPATH", "")
+    return env
+
+
+def test_bench_help_is_import_free(poisoned_env):
+    proc = subprocess.run([sys.executable, BENCH, "--help"],
+                          capture_output=True, text=True, timeout=60,
+                          env=poisoned_env, cwd=REPO)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    assert "usage" in proc.stdout.lower()
+    assert "must not import jax" not in proc.stderr
+
+
+def test_bench_argparse_error_is_import_free(poisoned_env):
+    proc = subprocess.run([sys.executable, BENCH, "--no-such-flag"],
+                          capture_output=True, text=True, timeout=60,
+                          env=poisoned_env, cwd=REPO)
+    assert proc.returncode == 2  # argparse usage error, not ImportError
+    assert "must not import jax" not in proc.stderr
+
+
+def test_bench_dry_one_json_line_contract(poisoned_env):
+    proc = subprocess.run([sys.executable, BENCH, "--dry", "--model",
+                           "resnet50", "--batch-size", "32"],
+                          capture_output=True, text=True, timeout=60,
+                          env=poisoned_env, cwd=REPO)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    # EXACTLY one stdout line, and it is a JSON object (the contract
+    # bench.py's consumers — BENCH_r*.json collection — regex for).
+    lines = [ln for ln in proc.stdout.splitlines() if ln.strip()]
+    assert len(lines) == 1, proc.stdout
+    assert re.match(r"^\{.*\}$", lines[0])
+    rec = json.loads(lines[0])
+    for key in ("metric", "value", "unit", "vs_baseline", "step_time_ms",
+                "gflops_per_step", "mfu", "hbm_gb_per_step", "hbm_source",
+                "membw_util", "dry"):
+        assert key in rec, (key, rec)
+    assert rec["metric"] == "resnet50_train_images_per_sec_per_chip_bs32"
+    assert rec["unit"] == "images/sec/chip"
+    assert rec["dry"] is True
+
+
+def test_allreduce_benchmark_has_json_flag():
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env["JAX_PLATFORMS"] = "cpu"
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "examples",
+                                      "allreduce_benchmark.py"), "--help"],
+        capture_output=True, text=True, timeout=120, cwd=REPO, env=env)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    assert "--json" in proc.stdout
+    assert "--decompose" in proc.stdout

@@ -238,6 +238,40 @@ def test_launcher_collects_and_merges_timeline(tmp_path):
 
 
 @pytest.mark.parametrize("engine", ENGINES)
+def test_two_process_negotiation_cache_steady_state(engine):
+    """ISSUE 4 acceptance: with a stable tensor set, steady-state
+    negotiated cycles take the response-cache bitvector fast path
+    (hit counter >> miss counter, zero steady-state misses — asserted in
+    multiproc_worker.py), a changed set falls back to a full round, and
+    reduction outputs are BITWISE identical cache-on vs
+    HVD_CACHE_CAPACITY=0 — both engines."""
+    on = _run_world("engine_cache", extra_env={"HVD_ENGINE": engine})
+    off = _run_world("engine_cache",
+                     extra_env={"HVD_ENGINE": engine,
+                                "HVD_CACHE_CAPACITY": "0"})
+
+    def digests(outs):
+        return sorted(line for out in outs for line in out.splitlines()
+                      if line.startswith("RESULT "))
+
+    d_on, d_off = digests(on), digests(off)
+    assert len(d_on) == 2 and len(set(d_on)) == 1, d_on  # agree across ranks
+    assert d_on == d_off, (d_on, d_off)  # bitwise: cache-on == cache-off
+    assert sum("CACHE" in out for out in on) == 2, on[0][-2000:]
+
+
+@pytest.mark.parametrize("engine", ENGINES)
+def test_two_process_cache_eviction_forces_full_rounds(engine):
+    """HVD_CACHE_CAPACITY=2 under a 4-tensor steady set: LRU evictions
+    advance the epoch in lockstep, evicted tensors miss and force
+    full-table rounds, results stay correct (ISSUE 4 satellite)."""
+    outs = _run_world("engine_cache_evict",
+                      extra_env={"HVD_ENGINE": engine,
+                                 "HVD_CACHE_CAPACITY": "2"})
+    assert sum("EVICT OK" in out for out in outs) == 2, outs[0][-2000:]
+
+
+@pytest.mark.parametrize("engine", ENGINES)
 def test_two_process_peer_shutdown_propagates(engine):
     """A peer stopping its engine fails outstanding collectives with
     ShutdownError instead of hanging (reference: SHUT_DOWN_ERROR,

@@ -1,0 +1,350 @@
+"""Unit tests for the negotiation response cache — the bitvector fast
+path of the engine control plane (horovod_tpu/core/coordinator.py).
+
+Reference: horovod/common/response_cache.cc — steady-state coordination
+collapses to a small bitvector exchange because a training loop submits
+the SAME tensor set thousands of times (arxiv 1802.05799; the
+MPI-coordination study 1810.11112 identifies per-tensor negotiation as
+the dominant small-tensor overhead).
+
+Pinned here: LRU/fingerprint/epoch semantics of :class:`ResponseCache`,
+the full→fast round transition, set-intersection readiness, the
+eviction-driven full-round fallback, KV garbage collection, and the
+adversarial coherence case — one rank evicting mid-run must yield a
+lockstep invalidation with nothing scheduled, never a stale hit."""
+
+import threading
+
+from horovod_tpu.core import telemetry as tele
+from horovod_tpu.core.coordinator import (
+    Coordinator,
+    KVError,
+    LocalKV,
+    RequestMeta,
+    ResponseCache,
+    decide,
+)
+
+
+def meta(name, op="allreduce", dtype="float32", shape=(4,), **kw):
+    import numpy as np
+
+    nbytes = int(np.prod(shape)) * 4
+    return RequestMeta(name=name, op=op, dtype=dtype, itemsize=4,
+                       shape=tuple(shape), nbytes=nbytes, **kw)
+
+
+class TestResponseCache:
+    def test_lookup_requires_exact_identity(self):
+        c = ResponseCache(8)
+        c.insert(meta("x"))
+        assert c.lookup(meta("x")) is not None
+        # age_s is submit-time noise, never part of the identity.
+        assert c.lookup(meta("x", age_s=3.5)) is not None
+        # Any identity change — shape, dtype, op, root — is a miss.
+        assert c.lookup(meta("x", shape=(8,))) is None
+        assert c.lookup(meta("x", dtype="float64")) is None
+        assert c.lookup(meta("x", op="broadcast")) is None
+        assert c.lookup(meta("y")) is None
+
+    def test_allgather_first_dim_change_is_a_miss(self):
+        # _fingerprint wildcards allgather's dim 0 for cross-process
+        # agreement; the CACHE must not — a varying first dim has to
+        # renegotiate or peers would decode a stale size.
+        c = ResponseCache(8)
+        c.insert(meta("g", op="allgather", shape=(2, 3)))
+        assert c.lookup(meta("g", op="allgather", shape=(2, 3))) is not None
+        assert c.lookup(meta("g", op="allgather", shape=(5, 3))) is None
+
+    def test_bits_roundtrip(self):
+        assert ResponseCache.decode_mask(ResponseCache.encode(set())) == set()
+        bits = {0, 3, 64, 700}
+        assert ResponseCache.decode_mask(ResponseCache.encode(bits)) == bits
+
+    def test_lru_eviction_bumps_epoch(self):
+        c = ResponseCache(2)
+        c.insert(meta("a"))
+        c.insert(meta("b"))
+        assert c.evict_over_capacity() == 0
+        c.touch(["a"])  # b is now least-recently used
+        c.insert(meta("c"))
+        epoch0 = c.epoch
+        assert c.evict_over_capacity() == 1
+        assert c.lookup(meta("b")) is None          # evicted
+        assert c.lookup(meta("a")) is not None
+        assert c.lookup(meta("c")) is not None
+        assert c.epoch == epoch0 + 1                # coherence signal
+
+    def test_update_in_place_keeps_bit(self):
+        c = ResponseCache(8)
+        c.insert(meta("x"))
+        bit = c.bit_of("x")
+        c.insert(meta("x", shape=(16,)))
+        assert c.bit_of("x") == bit
+        assert c.lookup(meta("x", shape=(16,))) == bit
+        assert c.lookup(meta("x")) is None
+
+    def test_evicted_bits_are_reused(self):
+        # Under name churn (alternating train/eval sets) the bitvector
+        # mask must stay bounded by the live-set high-water mark, not
+        # grow with cumulative insertions — evicted positions are
+        # recycled smallest-first.
+        c = ResponseCache(2)
+        for cycle in range(50):
+            c.insert(meta(f"a{cycle}"))
+            c.insert(meta(f"b{cycle}"))
+            c.evict_over_capacity()
+        bits = {c.bit_of(n) for n in (f"a{49}", f"b{49}")}
+        assert all(b is not None and b < 4 for b in bits), bits
+        assert c._next_bit <= 4, c._next_bit
+
+    def test_invalidate_clears_and_advances_epoch(self):
+        c = ResponseCache(8)
+        c.insert(meta("x"))
+        c.invalidate()
+        assert len(c) == 0 and c.epoch == 1
+        c.invalidate(7)
+        assert c.epoch == 7
+
+
+class World:
+    """N coordinators over one LocalKV, persisted across rounds — the
+    steady-state (same coordinators, advancing rounds) the cache exists
+    for, which run_round-style one-shot helpers cannot exercise."""
+
+    def __init__(self, nproc=2, fusion=1 << 26, capacity=1024,
+                 timeout_s=10.0, namespace="hvd/neg/cache-test"):
+        self.store = {}
+        self.coords = [
+            Coordinator(LocalKV(self.store), nproc, p, 0.005, fusion,
+                        timeout_s=timeout_s, cache_capacity=capacity,
+                        namespace=namespace)
+            for p in range(nproc)
+        ]
+
+    def round(self, per_pid):
+        results = [None] * len(self.coords)
+        errors = [None] * len(self.coords)
+
+        def worker(p):
+            try:
+                results[p] = self.coords[p].negotiate(per_pid[p])
+            except Exception as exc:
+                errors[p] = exc
+
+        threads = [threading.Thread(target=worker, args=(p,))
+                   for p in range(len(self.coords))]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=30)
+        return results, errors
+
+
+def group_names(decision, entries):
+    return [[entries[i].name for i in g.indices] for g in decision.groups]
+
+
+class TestFastRounds:
+    def test_steady_state_takes_fast_path(self):
+        tele.REGISTRY.reset()
+        w = World()
+        e = [meta("a"), meta("b")]
+        results, errors = w.round({0: e, 1: e})
+        assert errors == [None, None]
+        # Round 0 was full (cold cache), every later round fast.
+        assert not any(r.cached for r in results)
+        for _ in range(2):
+            results, errors = w.round({0: e, 1: e})
+            assert errors == [None, None]
+            assert all(r.cached for r in results)
+        for c in w.coords:
+            assert c.stats["fast_rounds"] == 2
+        counters = tele.REGISTRY.flat_counters()
+        assert counters["engine.negotiation.cache_hits"] > \
+            counters["engine.negotiation.cache_misses"]
+        assert "engine.negotiation.cache_invalidations" not in counters
+        saved = tele.REGISTRY.gauge(
+            "engine.negotiation.cache_bytes_saved").snapshot()
+        assert saved > 0
+
+    def test_fast_groups_match_full_round_groups(self):
+        # The memoized fast-path composition must equal what decide()
+        # produced on the identical full round — same fusion, same order.
+        e = [meta("b"), meta("a"), meta("c", dtype="float64")]
+        w = World()
+        (full0, full1), errs = w.round({0: e, 1: e})
+        assert errs == [None, None] and not full0.cached
+        (fast0, fast1), errs = w.round({0: e, 1: e})
+        assert errs == [None, None] and fast0.cached and fast1.cached
+        assert group_names(fast0, e) == group_names(full0, e)
+        assert group_names(fast1, e) == group_names(full1, e)
+        ref = decide({0: e, 1: e}, e, 1 << 26)
+        assert [g.indices for g in fast0.groups] == \
+            [g.indices for g in ref]
+
+    def test_partial_announce_intersects(self):
+        # Rank 1 has not (re)submitted 'b' yet: both ranks all-hit, the
+        # round stays FAST, and readiness is the bit intersection — only
+        # 'a' executes, 'b' stays pending with no stale decision.
+        w = World()
+        both = [meta("a"), meta("b")]
+        only_a = [meta("a")]
+        w.round({0: both, 1: both})  # warm (full)
+        (r0, r1), errs = w.round({0: both, 1: only_a})
+        assert errs == [None, None]
+        assert r0.cached and r1.cached
+        assert group_names(r0, both) == [["a"]]
+        assert group_names(r1, only_a) == [["a"]]
+
+    def test_changed_tensor_set_forces_full_round(self):
+        w = World()
+        e1 = [meta("a"), meta("b")]
+        w.round({0: e1, 1: e1})
+        assert w.round({0: e1, 1: e1})[0][0].cached
+        e2 = [meta("a"), meta("c")]  # 'c' is new: a miss on every rank
+        (r0, r1), errs = w.round({0: e2, 1: e2})
+        assert errs == [None, None]
+        assert not r0.cached and not r1.cached
+        assert group_names(r0, e2) == [["a", "c"]]
+        # The new set is cached now — next round is fast again.
+        (r0, r1), errs = w.round({0: e2, 1: e2})
+        assert r0.cached and r1.cached
+
+    def test_shape_change_is_miss_then_recached(self):
+        w = World()
+        e1 = [meta("x", shape=(4,))]
+        w.round({0: e1, 1: e1})
+        assert w.round({0: e1, 1: e1})[0][0].cached
+        e2 = [meta("x", shape=(8,))]
+        (r0, _), errs = w.round({0: e2, 1: e2})
+        assert errs == [None, None] and not r0.cached
+        assert group_names(r0, e2) == [["x"]]
+        (r0, _), errs = w.round({0: e2, 1: e2})
+        assert r0.cached
+
+    def test_eviction_forces_full_round_fallback(self):
+        tele.REGISTRY.reset()
+        w = World(capacity=2)
+        e = [meta("a"), meta("b"), meta("c")]
+        (r0, _), errs = w.round({0: e, 1: e})
+        assert errs == [None, None] and not r0.cached
+        # Three agreed tensors into a capacity-2 cache: one was evicted
+        # (epoch advanced, lockstep on both ranks) — so the steady set
+        # can never go fully fast, but every round stays CORRECT.
+        for c in w.coords:
+            assert len(c.cache) == 2
+            assert c.cache.evictions >= 1
+        epochs = {c.cache.epoch for c in w.coords}
+        assert len(epochs) == 1  # lockstep eviction
+        (r0, r1), errs = w.round({0: e, 1: e})
+        assert errs == [None, None]
+        assert not r0.cached  # the evicted tensor missed -> full round
+        assert group_names(r0, e) == [["a", "b", "c"]]
+        counters = tele.REGISTRY.flat_counters()
+        assert counters["engine.negotiation.cache_invalidations"] >= 2
+
+    def test_adversarial_one_rank_evicts_midrun(self):
+        """Coherence under divergence: one rank drops a cache entry on
+        its own (never happens in lockstep operation — this is the
+        adversarial case). The next round must observe the epoch
+        mismatch on EVERY rank, schedule NOTHING (a stale hit is
+        structurally impossible), clear caches in lockstep, and
+        renegotiate fully."""
+        tele.REGISTRY.reset()
+        w = World()
+        e = [meta("a"), meta("b")]
+        w.round({0: e, 1: e})
+        assert w.round({0: e, 1: e})[0][0].cached  # steady state
+        w.coords[1].cache.evict("a")  # the adversarial divergence
+        (r0, r1), errs = w.round({0: e, 1: e})
+        assert errs == [None, None]
+        # Nothing scheduled anywhere — entries stay pending.
+        assert r0.groups == [] and r1.groups == []
+        assert not r0.cached and not r1.cached
+        # Lockstep reset: both caches empty at the SAME fresh epoch.
+        assert len(w.coords[0].cache) == 0
+        assert len(w.coords[1].cache) == 0
+        assert w.coords[0].cache.epoch == w.coords[1].cache.epoch
+        counters = tele.REGISTRY.flat_counters()
+        assert counters["engine.negotiation.cache_invalidations"] >= 2
+        # The next round renegotiates with full tables and recovers.
+        (r0, r1), errs = w.round({0: e, 1: e})
+        assert errs == [None, None]
+        assert not r0.cached
+        assert group_names(r0, e) == [["a", "b"]]
+        assert group_names(r1, e) == [["a", "b"]]
+        # ... and the one after is fast again.
+        assert w.round({0: e, 1: e})[0][0].cached
+
+    def test_idle_rounds_ride_the_fast_path(self):
+        w = World()
+        e = [meta("a")]
+        w.round({0: e, 1: e})
+        (r0, _), errs = w.round({0: [], 1: []})
+        assert errs == [None, None]
+        assert r0.cached and r0.groups == []
+        assert r0.idle_backoff_s > 0
+
+    def test_capacity_zero_disables_cache(self):
+        w = World(capacity=0)
+        e = [meta("a")]
+        for c in w.coords:
+            assert c.cache is None
+        w.round({0: e, 1: e})
+        (r0, _), errs = w.round({0: e, 1: e})
+        assert errs == [None, None] and not r0.cached
+
+    def test_aggregate_mode_disables_cache(self, monkeypatch):
+        monkeypatch.setenv("HVD_NEGOTIATION_AGGREGATE", "1")
+        c = Coordinator(LocalKV({}), 2, 0, 0.005, 0, timeout_s=1.0,
+                        cache_capacity=1024)
+        assert c.aggregate and c.cache is None
+
+    def test_mixed_capacity_fails_fast(self):
+        # HVD_CACHE_CAPACITY must be identical on every process. Every
+        # cache-carrying message names its capacity, so ANY mix fails
+        # fast on the FIRST round, on every rank, by name — zero vs
+        # nonzero, and two different nonzero values (whose lone-rank
+        # evictions would otherwise cycle the world through endless
+        # epoch resets).
+        e = [meta("a")]
+        w = World()
+        w.coords[1].cache = None  # rank 1 "configured" cache-off
+        _, errors = w.round({0: e, 1: e})
+        assert all(isinstance(err, KVError) for err in errors), errors
+        assert all("HVD_CACHE_CAPACITY mismatch" in str(err)
+                   for err in errors), errors
+
+        w2 = World(namespace="hvd/neg/cache-test2")
+        w2.coords[1].cache = ResponseCache(512)  # nonzero, but different
+        _, errors = w2.round({0: e, 1: e})
+        assert all(isinstance(err, KVError) for err in errors), errors
+        assert "512" in str(errors[0]) and "1024" in str(errors[0])
+
+    def test_params_propagate_on_fast_rounds(self):
+        # The autotuner's values ride EVERY round, fast ones included
+        # (reference: ParameterManager::SyncParams).
+        w = World()
+        e = [meta("a")]
+        w.round({0: e, 1: e})
+        w.coords[0].cycle_time_s = 0.042
+        w.coords[0].fusion_threshold = 12345
+        (r0, r1), errs = w.round({0: e, 1: e})
+        assert errs == [None, None] and r0.cached
+        assert r1.cycle_time_s == 0.042
+        assert r1.fusion_threshold == 12345
+        assert w.coords[1].cycle_time_s == 0.042
+
+    def test_round_keys_garbage_collected(self):
+        # Long trainings must not grow the KV store: every consumed
+        # round key is reclaimed (fast rounds included) — only the
+        # latest round's keys may linger.
+        w = World()
+        e = [meta("a"), meta("b")]
+        for _ in range(6):
+            _, errs = w.round({0: e, 1: e})
+            assert errs == [None, None]
+        round_keys = [k for k in w.store
+                      if isinstance(k, str) and "/r" in k]
+        assert all("/r5/" in k for k in round_keys), sorted(w.store)

@@ -116,6 +116,33 @@ def test_critical_path_and_stats_over_capture():
     assert s["ranks"][1]["clock"]["rank"] == 1
 
 
+def test_negotiate_cached_vs_full_attribution():
+    """The `cached` arg the engines stamp on NEGOTIATE span ends (the
+    response-cache fast path, ISSUE 4) is attributed by both
+    critical-path and skew: the fixture has two full rounds (rank0's
+    first, rank1's only complete one) and one cached round."""
+    from horovod_tpu.utils import trace
+
+    d = trace.critical_path_data(DATA)
+    neg = d["negotiate"]
+    assert neg["cached"]["count"] == 1
+    assert neg["cached"]["us"] == 250500 - 200100
+    assert neg["cached"]["median_us"] == 50400
+    assert neg["full"]["count"] == 2
+    # rank0: 102000-1100, rank1: 101500-100600.
+    assert neg["full"]["us"] == 100900 + 900
+    assert neg["full"]["median_us"] == 100900
+    assert neg["unknown"]["count"] == 0
+    report = trace.critical_path_report(DATA)
+    assert "negotiate rounds (response cache)" in report
+    assert "cached n=1" in report and "full n=2" in report
+
+    sk = trace.skew_data(DATA)
+    assert sk["negotiate_rounds"][0] == {"cached": 1, "full": 1}
+    assert sk["negotiate_rounds"][1] == {"cached": 0, "full": 1}
+    assert "[negotiate spans: 1 cached / 1 full]" in trace.skew_report(DATA)
+
+
 def test_trace_cli_subcommands(tmp_path, capsys):
     from horovod_tpu.utils import trace
 
