@@ -94,6 +94,14 @@ def main():
                    help="extra XLA compiler option(s) for the step "
                         "executable (repeatable), e.g. "
                         "--xla-option xla_tpu_scoped_vmem_limit_kib=65536")
+    p.add_argument("--check", action="store_true",
+                   help="perf regression gate (utils/perfwatch): compare "
+                        "this run against the newest same-metric "
+                        "BENCH_r*.json history record with noise-aware "
+                        "bounds from the recorded iteration spread; the "
+                        "JSON line gains a \"gate\" object and the exit "
+                        "code is nonzero on an img/s drop or "
+                        "hbm_gb_per_step creep")
     p.add_argument("--dry", action="store_true",
                    help="parse args and print the one-JSON-line contract "
                         "with null values, without importing jax or "
@@ -114,7 +122,8 @@ def main():
             "value": None, "unit": "images/sec/chip", "vs_baseline": None,
             "step_time_ms": None, "gflops_per_step": None, "mfu": None,
             "hbm_gb_per_step": None, "hbm_source": None,
-            "membw_util": None, "dry": True,
+            "membw_util": None, "spread_pct": None, "gate": None,
+            "dry": True,
         }))
         return
 
@@ -343,6 +352,15 @@ def main():
             run_batches(ncalls_iter)
         new_files = [f for f in profiler.trace_files(args.profile)
                      if f not in before]
+        if not new_files:
+            # A capture that lands nothing is a broken measurement, not
+            # a degraded one: every derived HBM figure would silently
+            # read as "no traffic". Fail loudly (profiler.capture raises
+            # the same way).
+            print(f"# ERROR: --profile {args.profile} produced no "
+                  "*.xplane.pb (is another trace active? is the "
+                  "profiler plugin available?)", file=sys.stderr)
+            raise SystemExit(3)
         print(f"# profile: {len(new_files)} new xplane file(s) in "
               f"{args.profile}", file=sys.stderr)
         try:
@@ -426,6 +444,11 @@ def main():
                             if hbm_bytes_step is not None else None),
         "hbm_source": hbm_source,
         "membw_util": round(membw, 3) if membw is not None else None,
+        # Iteration spread as a percentage of the median — the noise
+        # bound the perfwatch gate derives its pass/fail margin from.
+        "spread_pct": round((max(rates) - min(rates)) / per_chip * 100, 2)
+        if per_chip else None,
+        "gate": None,  # filled by --check below; present-but-null else
     }
     # Unified telemetry (core/telemetry.py): eager-collective counts, the
     # startup broadcast, engine activity if any — read AFTER the timed
@@ -461,11 +484,40 @@ def main():
                 result["trace"] = tl_env  # single-file spelling
         except Exception as e:  # pragma: no cover - never fail the bench
             print(f"# trace merge unavailable: {e}", file=sys.stderr)
+    gate_failed = False
+    if args.check:
+        # Regression gate (ROADMAP item 2: img/s and HBM traffic must
+        # not silently creep back). perfwatch is stdlib-only; the
+        # history lives next to this script (BENCH_r*.json). Guarded:
+        # whatever the gate does, the one-JSON-line contract holds — a
+        # gating error is reported as status "error" on stderr, never a
+        # traceback that eats the measured run.
+        try:
+            from horovod_tpu.utils import perfwatch as _pw
+
+            repo = _os.path.dirname(_os.path.abspath(__file__))
+            # The noise bound comes from result["spread_pct"] — ONE
+            # definition of the iteration spread for both the JSON line
+            # and the gate.
+            cur = _pw.record_from_bench(result)
+            gate = _pw.gate(cur, _pw.pick_reference(
+                _pw.load_history(repo), cur))
+            result["gate"] = gate
+            gate_failed = gate["status"] == "fail"
+            print("# " + _pw.gate_line(gate), file=sys.stderr)
+        except Exception as e:  # pragma: no cover - defensive
+            result["gate"] = {"status": "error", "note": str(e)[:300]}
+            print(f"# perfwatch: gate errored: {e}", file=sys.stderr)
     print(json.dumps(result))
     print(f"# {nchips} chip(s), spread {min(rates):.0f}-{max(rates):.0f} "
           f"img/sec over {args.num_iters} iters, "
           f"platform={jax.devices()[0].platform} "
           f"({jax.devices()[0].device_kind})", file=sys.stderr)
+    if gate_failed:
+        # The one JSON line above already carries the verdict; the
+        # nonzero exit is what CI keys on (docs/benchmarks.md
+        # "Regression gate").
+        raise SystemExit(4)
 
 
 if __name__ == "__main__":

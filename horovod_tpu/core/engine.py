@@ -827,6 +827,15 @@ class Engine:
             # Post-mortem: the stalled world's last N events + telemetry,
             # dumped while the dispatch thread may itself be hung.
             self._dump_flight(f"stalled tensors: {names}")
+            # The performance sentinel folds the stall into /healthz and
+            # into the next watchdog verdict's attribution.
+            try:
+                from horovod_tpu.core import sentinel as _sentinel
+
+                _sentinel.note_stall(f"stalled tensors: {names}",
+                                     self.timeline.rank)
+            except Exception:
+                pass
 
     def shutdown(self):
         # Publish the shutdown tombstone first: peers blocked mid-round on

@@ -407,10 +407,18 @@ class NativeEngine:
                 elif (now - stuck_since > self._stall_warning_s
                         and now - last_dump > self._stall_warning_s):
                     last_dump = now
-                    self._dump_flight(
-                        f"stalled: {int(st.queue_depth)} tensor(s) in "
-                        f"flight with no completions for "
-                        f"{int(now - stuck_since)}s")
+                    reason = (f"stalled: {int(st.queue_depth)} tensor(s) "
+                              f"in flight with no completions for "
+                              f"{int(now - stuck_since)}s")
+                    self._dump_flight(reason)
+                    # Sentinel parity with the python twin: the stall
+                    # becomes /healthz state + verdict attribution.
+                    try:
+                        from horovod_tpu.core import sentinel as _sentinel
+
+                        _sentinel.note_stall(reason, self._rank)
+                    except Exception:
+                        pass
             else:
                 stuck_since = None
             last_progress = progress

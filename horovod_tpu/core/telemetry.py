@@ -188,6 +188,7 @@ class StragglerTracker:
         self.tensors = 0
         self.wait_us: Dict[int, int] = {}
         self.by_class: Dict[str, Dict[int, int]] = {}
+        self._total_us = 0  # running sum of wait_us values (O(1) reads)
 
     def observe(self, name: str, announce_times: Dict[int, float]):
         if len(announce_times) < 2:
@@ -201,6 +202,13 @@ class StragglerTracker:
                 us = int((t - t0) * 1e6)
                 self.wait_us[pid] = self.wait_us.get(pid, 0) + us
                 per_cls[pid] = per_cls.get(pid, 0) + us
+                self._total_us += us
+
+    def total_wait_us(self) -> int:
+        """Cumulative imposed wait across all processes — O(1), no map
+        copies (the sentinel reads this on every observed step)."""
+        with self._lock:
+            return self._total_us
 
     def worst(self) -> Optional[Tuple[int, int]]:
         """(process, cumulative µs) of the rank that imposed the most
@@ -249,6 +257,7 @@ class StragglerTracker:
             self.tensors = 0
             self.wait_us.clear()
             self.by_class.clear()
+            self._total_us = 0
 
 
 class Registry:
@@ -473,6 +482,7 @@ def compact() -> dict:
 
 _exporter_lock = threading.Lock()
 _exporter_started = False
+_http_started = False
 
 
 def prometheus() -> str:
@@ -513,10 +523,38 @@ def _exporter_loop(path: str, interval_s: float):
         flush_to_file(path)
 
 
+def _maybe_start_http():
+    """Start the HVD_TELEMETRY_PORT localhost endpoint once, lazily
+    (same activation rule as the file exporter): /metrics serves this
+    exposition, /healthz the sentinel's watchdog state — see
+    core/telemetry_http.py."""
+    global _http_started
+    if _http_started:
+        return
+    port = os.environ.get("HVD_TELEMETRY_PORT")
+    if not port:
+        return
+    with _exporter_lock:
+        if _http_started:
+            return
+        _http_started = True
+    try:
+        pnum = int(port)
+        if pnum <= 0:
+            return  # "0" means disabled, NOT an ephemeral port
+        from horovod_tpu.core import telemetry_http
+
+        telemetry_http.maybe_start(pnum)
+    except Exception:
+        pass  # a malformed port / bind failure must not break metrics
+
+
 def _maybe_start_exporter():
     """Start the HVD_TELEMETRY_FILE flusher once, lazily (first telemetry
-    touch) — no thread at import, nothing at all when the env is unset."""
+    touch) — no thread at import, nothing at all when the env is unset.
+    The HTTP endpoint rides the same activation points."""
     global _exporter_started
+    _maybe_start_http()
     if _exporter_started:
         return
     path = os.environ.get("HVD_TELEMETRY_FILE")

@@ -1,0 +1,113 @@
+"""Per-rank localhost telemetry endpoint (``HVD_TELEMETRY_PORT``).
+
+The file exporter (``HVD_TELEMETRY_FILE``) is pull-by-filesystem; this
+is pull-by-HTTP — the shape every metrics stack already scrapes. One
+daemon thread per process serves, on ``127.0.0.1`` only (observability
+must not open the host to the network):
+
+- ``GET /metrics`` — the registry's Prometheus text exposition (exactly
+  the bytes ``HVD_TELEMETRY_FILE`` would hold, same parser in
+  ``utils/stats``);
+- ``GET /healthz`` — the sentinel's health JSON (watchdog verdicts +
+  last-step age, ``core/sentinel.py``), HTTP 200 when ``ok``/``init``,
+  503 when ``warn`` (load balancers and ``curl -f`` get the right
+  signal for free).
+
+Activation mirrors the file exporter: lazy, on the first telemetry
+touch, only when ``HVD_TELEMETRY_PORT`` is set and nonzero. The
+launcher's ``--telemetry-port-base B`` gives child ``i`` port ``B+i``.
+A busy port logs one warning and stays off — a second process on the
+same host must not crash because the first took the port.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+LOG = logging.getLogger("horovod_tpu.telemetry_http")
+
+_lock = threading.Lock()
+_server: Optional[ThreadingHTTPServer] = None
+_thread: Optional[threading.Thread] = None
+
+
+class _Handler(BaseHTTPRequestHandler):
+    # The default handler logs every request to stderr — a scraper at
+    # 1 Hz would drown the training logs.
+    def log_message(self, fmt, *args):  # noqa: D102 - stdlib override
+        pass
+
+    def _send(self, code: int, body: bytes, ctype: str):
+        self.send_response(code)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_GET(self):  # noqa: N802 - stdlib naming
+        path = self.path.split("?", 1)[0].rstrip("/") or "/metrics"
+        try:
+            if path == "/metrics":
+                from horovod_tpu.core import telemetry
+
+                self._send(200, telemetry.prometheus().encode(),
+                           "text/plain; version=0.0.4")
+            elif path == "/healthz":
+                from horovod_tpu.core import sentinel
+
+                h = sentinel.health()
+                self._send(200 if h["status"] in ("ok", "init") else 503,
+                           (json.dumps(h) + "\n").encode(),
+                           "application/json")
+            else:
+                self._send(404, b"not found: try /metrics or /healthz\n",
+                           "text/plain")
+        except Exception as exc:  # serving must never kill the thread
+            try:
+                self._send(500, f"error: {exc}\n".encode(), "text/plain")
+            except OSError:
+                pass  # client went away mid-reply
+
+
+def maybe_start(port: int) -> Optional[int]:
+    """Start the endpoint once; returns the bound port (``port=0`` lets
+    the OS pick — tests use this), the already-running port on a second
+    call, or None when binding failed (warned once, never raises)."""
+    global _server, _thread
+    with _lock:
+        if _server is not None:
+            return _server.server_address[1]
+        try:
+            srv = ThreadingHTTPServer(("127.0.0.1", int(port)), _Handler)
+        except OSError as exc:
+            LOG.warning("HVD_TELEMETRY_PORT=%s: cannot bind (%s); "
+                        "telemetry endpoint disabled", port, exc)
+            return None
+        srv.daemon_threads = True
+        _server = srv
+        _thread = threading.Thread(target=srv.serve_forever,
+                                   name="hvd-telemetry-http", daemon=True)
+        _thread.start()
+        LOG.info("telemetry endpoint on http://127.0.0.1:%d "
+                 "(/metrics, /healthz)", srv.server_address[1])
+        return srv.server_address[1]
+
+
+def stop():
+    """Shut the endpoint down (tests only — production lets the daemon
+    thread die with the process)."""
+    global _server, _thread
+    with _lock:
+        srv, _server, _thread = _server, None, None
+    if srv is not None:
+        srv.shutdown()
+        srv.server_close()
+
+
+def current_port() -> Optional[int]:
+    with _lock:
+        return _server.server_address[1] if _server else None

@@ -303,6 +303,40 @@ def flight_recorder_dir() -> str:
             or tempfile.gettempdir())
 
 
+def flight_keep() -> int:
+    """Retention cap: how many dump files to keep per rank in the flight
+    dir (``HVD_FLIGHT_KEEP``, default 8). A long run with repeated
+    stalls/anomalies must not fill the disk with post-mortems."""
+    try:
+        return max(1, int(os.environ.get("HVD_FLIGHT_KEEP", "8")))
+    except ValueError:
+        return 8
+
+
+def _prune_flight_dumps(directory: str, rank: int, keep: int):
+    """Drop the oldest of THIS PROCESS's dumps beyond ``keep``
+    (newest-by-mtime survive). Keyed on (rank, pid), not rank alone:
+    two unrelated runs sharing the default temp dir are both rank 0,
+    and one run's dump churn must never destroy the other's
+    post-mortems. Best-effort: pruning must never take the dumper
+    down."""
+    import glob
+
+    try:
+        files = glob.glob(os.path.join(
+            directory, f"hvd_flight.rank{rank}.{os.getpid()}.*.json"))
+        if len(files) <= keep:
+            return
+        files.sort(key=lambda f: (os.path.getmtime(f), f))
+        for stale in files[:-keep]:
+            try:
+                os.unlink(stale)
+            except OSError:
+                pass
+    except OSError:
+        pass
+
+
 def dump_flight_recorder(events: List[dict], reason: str,
                          rank: Optional[int] = None,
                          path: Optional[str] = None) -> Optional[str]:
@@ -327,14 +361,24 @@ def dump_flight_recorder(events: List[dict], reason: str,
         payload["report"] = tele.report()
     except Exception:
         pass  # telemetry is additive; the events are the dump's core
+    prune_dir = None
     if path is None:
-        path = os.path.join(flight_recorder_dir(),
-                            f"hvd_flight.rank{rank}.{os.getpid()}.json")
+        # Unique per dump (wall-µs suffix) so a run's post-mortem HISTORY
+        # survives — the retention cap below keeps it bounded. The older
+        # {rank}.{pid} two-part spelling is still matched by every
+        # consumer (they glob rank{N}.*).
+        prune_dir = flight_recorder_dir()
+        path = os.path.join(
+            prune_dir,
+            f"hvd_flight.rank{rank}.{os.getpid()}."
+            f"{payload['wall_us']}.json")
     tmp = f"{path}.tmp"
     try:
         with open(tmp, "w") as fh:
             json.dump(payload, fh)
         os.replace(tmp, path)
+        if prune_dir is not None:
+            _prune_flight_dumps(prune_dir, rank, flight_keep())
         return path
     except OSError:
         try:
@@ -344,14 +388,46 @@ def dump_flight_recorder(events: List[dict], reason: str,
         return None
 
 
+_dump_rate_lock = threading.Lock()
+_last_dump_at: dict = {}  # (rank, reason head) -> monotonic seconds
+
+
+def _dump_min_interval_s() -> float:
+    try:
+        return float(os.environ.get("HVD_FLIGHT_MIN_INTERVAL", "1.0"))
+    except ValueError:
+        return 1.0
+
+
 def dump_and_warn(events: List[dict], reason: str, rank: Optional[int],
                   logger) -> Optional[str]:
     """The engines' shared dump wrapper (their post-mortem semantics
     must stay twins): write the flight dump, warn with the path, never
-    raise. Returns the path or None."""
+    raise. Returns the path or None.
+
+    Rate-limited per (rank, reason): a poisoned negotiation re-raises
+    the SAME failure every ~5 ms engine cycle — dumping each one is a
+    200 Hz dump storm that churns the retention cap out from under a
+    concurrent reader. The first dump of each distinct reason always
+    lands; repeats within ``HVD_FLIGHT_MIN_INTERVAL`` seconds (default
+    1.0; 0 disables the limit) are dropped."""
     try:
+        min_s = _dump_min_interval_s()
+        key = (rank, str(reason).splitlines()[0][:80])
+        now = time.monotonic()
+        with _dump_rate_lock:
+            last = _last_dump_at.get(key)
+            if last is not None and min_s > 0 and now - last < min_s:
+                return None
         path = dump_flight_recorder(events, reason, rank=rank)
         if path:
+            # Stamp only on SUCCESS: a transiently unwritable flight dir
+            # must not suppress the retries — "the first dump of each
+            # distinct reason always lands" includes landing late.
+            with _dump_rate_lock:
+                while len(_last_dump_at) >= 256:  # bounded memory
+                    _last_dump_at.pop(next(iter(_last_dump_at)))
+                _last_dump_at[key] = now
             logger.warning("flight recorder dumped to %s (%s)", path,
                            str(reason).splitlines()[0][:200])
         return path

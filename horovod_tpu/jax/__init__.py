@@ -56,6 +56,7 @@ from horovod_tpu.jax.sharded import (  # noqa: F401
 )
 
 from horovod_tpu.common.compat import shard_map as _shard_map
+from horovod_tpu.core import sentinel as _sentinel
 from horovod_tpu.core import telemetry as _tele
 
 try:
@@ -374,8 +375,9 @@ class _InstrumentedJit:
     Everything else (``lower``, ``trace``, AOT compilation, ...) delegates
     to the wrapped ``jax.jit`` object, so the perf-critical AOT path
     (``fn.lower(...).compile()`` — bench.py) bypasses instrumentation
-    entirely. Overhead: two clock reads + a deque append per dispatch,
-    ~1 µs against a ≥50 µs dispatch."""
+    entirely. Overhead: two clock reads + a few deque appends/compares
+    per dispatch (ring + the sentinel watchdog), ~1-2 µs against a
+    ≥50 µs dispatch."""
 
     __slots__ = ("_jitted",)
 
@@ -385,9 +387,15 @@ class _InstrumentedJit:
     def __call__(self, *args, **kwargs):
         t0 = _time.perf_counter()
         out = self._jitted(*args, **kwargs)
+        dt = _time.perf_counter() - t0
         _tele.REGISTRY.counter("jax.dispatches").inc()
-        _tele.REGISTRY.ring("jax.dispatch_s").push(
-            _time.perf_counter() - t0)
+        _tele.REGISTRY.ring("jax.dispatch_s").push(dt)
+        # Performance sentinel: the per-call dispatch boundary is the
+        # compiled path's watchdog signal (a recompile shows up as one
+        # giant dispatch). The AOT path (lower().compile()) bypasses
+        # this wrapper entirely — bench.py's hot window stays
+        # uninstrumented.
+        _sentinel.observe_step(dt, origin="jax.dispatch")
         return out
 
     def __getattr__(self, item):

@@ -36,6 +36,7 @@ from horovod_tpu.jax import (
     sharded_state_specs as _sharded_state_specs,
 )
 from horovod_tpu.jax import allreduce as _allreduce
+from horovod_tpu.core import sentinel as _sentinel
 from horovod_tpu.core import telemetry as _tele
 from horovod_tpu.keras import callbacks  # noqa: F401
 from horovod_tpu.ops.collectives import HVD_AXIS
@@ -349,9 +350,14 @@ class Trainer:
                 # program (execution is async — the ring records the host
                 # cost of handing work to the runtime; wall step time
                 # shows up in the inter-dispatch cadence).
+                t_step = time.perf_counter() - t_step
                 _tele.REGISTRY.counter("trainer.steps").inc()
-                _tele.REGISTRY.ring("trainer.step_s").push(
-                    time.perf_counter() - t_step)
+                _tele.REGISTRY.ring("trainer.step_s").push(t_step)
+                # Performance sentinel: the wall step time feeds the
+                # trainer watchdog (anomaly -> flight dump + bounded
+                # capture + attributed verdict) and drives periodic
+                # auto-capture (HVD_PROFILE_DIR) — see core/sentinel.py.
+                _sentinel.observe_step(t_step, origin="trainer")
                 # Prefetch: the step above dispatched asynchronously;
                 # pulling the next batch NOW overlaps its host->device
                 # transfers with the running step (the role tf.data

@@ -52,6 +52,13 @@ def main(argv=None):
                          "timeline.rank{N}.json into DIR (sets "
                          "HVD_TIMELINE), and the launcher merges them "
                          "into one Perfetto trace at exit")
+    ap.add_argument("--telemetry-port-base", type=int, metavar="PORT",
+                    default=None,
+                    help="live telemetry: process i serves /metrics and "
+                         "/healthz on 127.0.0.1:PORT+i (sets "
+                         "HVD_TELEMETRY_PORT; query with "
+                         "python -m horovod_tpu.utils.stats "
+                         "http://127.0.0.1:PORT)")
     ap.add_argument("command", nargs=argparse.REMAINDER,
                     help="command to run, e.g. python train.py --epochs 1")
     args = ap.parse_args(argv)
@@ -105,6 +112,8 @@ def main(argv=None):
         env["HVD_PROCESS_ID"] = str(i)
         if timeline:
             env["HVD_TIMELINE"] = timeline
+        if args.telemetry_port_base is not None:
+            env["HVD_TELEMETRY_PORT"] = str(args.telemetry_port_base + i)
         if args.cpu:
             # HVD_PLATFORM is applied via jax.config inside hvd.init()
             # (plain JAX_PLATFORMS can be preempted by plugins).

@@ -1,0 +1,339 @@
+"""Perf regression gate + trend CLI over the bench / perf.jsonl history.
+
+ROADMAP item 2 asks for a regression gate so HBM traffic (and the img/s
+headline) "can't silently creep back" now that the bench is
+bandwidth-bound. This module is that gate, **stdlib-only** by contract:
+``bench.py --check`` must be able to run it without jax or the
+framework (the ``--dry`` CI guard proves argparse paths never pay those
+imports), and CI boxes without a TPU must be able to gate a recorded
+line.
+
+Inputs it understands (:func:`load_record` / :func:`load_records`):
+
+- a driver history file (``BENCH_r05.json``: ``{"n", "tail",
+  "parsed": {...}}`` — the iteration spread is parsed out of the tail's
+  ``spread LO-HI img/sec`` line and becomes the noise bound);
+- a raw bench JSON line (what ``python bench.py`` prints — one object
+  with ``metric``/``value``/``hbm_gb_per_step``/...);
+- a ``perf.jsonl`` health log (one record per auto-capture, written by
+  :mod:`horovod_tpu.core.sentinel`) — every line loads, the last one
+  gates;
+- ``BASELINE.json`` (metadata only today — carried for the trend
+  header, never a numeric reference while ``published`` is empty).
+
+Gate arithmetic (:func:`gate`): the current record is compared against
+the newest same-metric history record. The allowed img/s drop is
+noise-aware — ``max(spread_cur, spread_ref, MIN_NOISE) × NOISE_MULT``
+(r05's recorded spread is ~1.1%, the 2% floor + 1.5× multiplier admits
+run-to-run wobble and rejects a real regression: −10% fails, a rerun of
+r05 passes). HBM creep fails when ``hbm_gb_per_step`` exceeds the
+reference by more than ``HBM_TOL`` (5% — the measured figure was stable
+to the hundredth of a GB across r04/r05). Null fields skip their check
+(a CPU run with no measured HBM must not fail the throughput gate).
+
+CLI::
+
+    python -m horovod_tpu.utils.perfwatch                  # trend table
+    python -m horovod_tpu.utils.perfwatch RECORD --check   # gate RECORD
+    python -m horovod_tpu.utils.perfwatch --history DIR --json
+
+Exit codes: 0 pass/trend, 1 usage/IO error, 2 gate FAILED.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import re
+import sys
+from typing import List, Optional
+
+#: Noise floor for the img/s drop bound when no spread is recorded
+#: (2% — wider than any recorded same-config spread so far).
+MIN_NOISE = 0.02
+#: Safety multiplier on the noise bound (spread is a range observed in
+#: ONE run; two runs can land on opposite edges).
+NOISE_MULT = 1.5
+#: Allowed hbm_gb_per_step creep over the reference.
+HBM_TOL = 0.05
+
+_SPREAD_RE = re.compile(
+    r"spread\s+(\d+(?:\.\d+)?)-(\d+(?:\.\d+)?)\s+img/sec")
+
+#: The normalized record fields every loader emits (missing -> None).
+FIELDS = ("metric", "value", "step_time_ms", "gflops_per_step", "mfu",
+          "hbm_gb_per_step", "membw_util")
+
+
+def _normalize(parsed: dict, label: str,
+               spread_frac: Optional[float] = None) -> dict:
+    rec = {k: parsed.get(k) for k in FIELDS}
+    rec["label"] = label
+    if spread_frac is None and parsed.get("spread_pct") is not None:
+        spread_frac = float(parsed["spread_pct"]) / 100.0
+    rec["spread_frac"] = spread_frac
+    return rec
+
+
+def spread_frac_from_tail(tail: str, value) -> Optional[float]:
+    """(hi - lo) / value from a driver tail's ``spread LO-HI img/sec``
+    line — the recorded iteration spread the noise bound derives from."""
+    if not tail or not value:
+        return None
+    m = _SPREAD_RE.search(tail)
+    if not m:
+        return None
+    lo, hi = float(m.group(1)), float(m.group(2))
+    if hi < lo or value <= 0:
+        return None
+    return (hi - lo) / float(value)
+
+
+def record_from_bench(result: dict, label: str = "current") -> dict:
+    """Normalize a bench.py result dict (the one JSON line) in-process —
+    what ``bench.py --check`` hands the gate. The noise bound derives
+    from the line's own ``spread_pct`` field (one definition of the
+    iteration spread for the JSON line and the gate alike)."""
+    return _normalize(result, label)
+
+
+def load_records(path: str) -> List[dict]:
+    """Every record a file holds, normalized and in file order. Raises
+    ``ValueError`` on unrecognized content, ``OSError`` on IO."""
+    with open(path) as fh:
+        text = fh.read()
+    base = os.path.basename(path)
+    label = re.sub(r"\.jsonl?$", "", base)
+    # perf.jsonl: one JSON object per line.
+    if path.endswith(".jsonl"):
+        out = []
+        for i, line in enumerate(text.splitlines()):
+            line = line.strip()
+            if not line:
+                continue
+            rec = json.loads(line)
+            out.append(_normalize(rec, f"{label}#{i}"))
+        return out
+    data = json.loads(text)
+    if not isinstance(data, dict):
+        raise ValueError(f"{path}: expected a JSON object")
+    if "reference_repo" in data or "configs" in data:  # BASELINE.json
+        return []  # metadata only — nothing numeric to gate against
+    if "parsed" in data:  # driver history wrapper (BENCH_r*.json)
+        n = data.get("n")
+        lab = f"r{int(n):02d}" if isinstance(n, int) else label
+        parsed = data.get("parsed") or {}
+        return [_normalize(
+            parsed, lab,
+            spread_frac=spread_frac_from_tail(data.get("tail", ""),
+                                              parsed.get("value")))]
+    if "metric" in data:  # a raw bench JSON line saved to a file
+        return [_normalize(data, label)]
+    raise ValueError(f"{path}: not a bench record, driver history file, "
+                     "or perf.jsonl")
+
+
+def load_record(path: str) -> Optional[dict]:
+    """The gate-able record of a file: its last record (perf.jsonl
+    appends newest-last), or None for metadata-only files."""
+    recs = load_records(path)
+    return recs[-1] if recs else None
+
+
+def load_history(directory: str) -> List[dict]:
+    """All ``BENCH_r*.json`` records in ``directory``, oldest first."""
+    out = []
+    for path in sorted(glob.glob(os.path.join(directory,
+                                              "BENCH_r*.json"))):
+        try:
+            out.extend(load_records(path))
+        except (OSError, ValueError, json.JSONDecodeError):
+            # An unrecognized or unreadable sibling (a directory that
+            # happens to match the glob, a permissions mishap) must not
+            # kill the gate — the remaining history still gates.
+            continue
+    return out
+
+
+def pick_reference(history: List[dict], current: dict) -> Optional[dict]:
+    """The newest comparable history record — regressions are judged
+    against where the repo last WAS, not its all-time best (an
+    optimization that later regressed should fail against the record
+    that landed it, which this picks). Comparable means the metric
+    names AGREE — including the unnamed case: a perf.jsonl capture
+    (no ``metric`` field) must never gate against the named bench
+    history (an arbitrary training loop vs the ResNet line), only
+    against other unnamed capture records."""
+    cm = current.get("metric")
+    for rec in reversed(history):
+        rm = rec.get("metric")
+        if (cm or rm) and cm != rm:
+            continue
+        if rec.get("value") is None and rec.get("hbm_gb_per_step") is None:
+            continue  # nothing to compare against
+        return rec
+    return None
+
+
+def gate(current: dict, reference: Optional[dict], *,
+         min_noise: float = MIN_NOISE, noise_mult: float = NOISE_MULT,
+         hbm_tol: float = HBM_TOL) -> dict:
+    """Noise-aware comparison of ``current`` against ``reference``.
+
+    Returns ``{"status": "pass"|"fail"|"skip", "reference", "checks"}``
+    where each check is ``{"field", "current", "reference", "bound",
+    "ok"}``. ``skip``: no reference, or nothing comparable."""
+    if reference is None:
+        return {"status": "skip", "reference": None, "checks": [],
+                "note": "no comparable history record"}
+    checks = []
+    # Throughput floor (higher is better).
+    cv, rv = current.get("value"), reference.get("value")
+    if cv is not None and rv is not None:
+        noise = max(current.get("spread_frac") or 0.0,
+                    reference.get("spread_frac") or 0.0,
+                    min_noise) * noise_mult
+        bound = rv * (1.0 - noise)
+        checks.append({"field": "value", "current": cv, "reference": rv,
+                       "bound": round(bound, 2), "ok": cv >= bound})
+    # HBM-traffic ceiling (lower is better) — the creep gate.
+    ch, rh = current.get("hbm_gb_per_step"), reference.get(
+        "hbm_gb_per_step")
+    if ch is not None and rh is not None:
+        bound = rh * (1.0 + hbm_tol)
+        checks.append({"field": "hbm_gb_per_step", "current": ch,
+                       "reference": rh, "bound": round(bound, 3),
+                       "ok": ch <= bound})
+    if not checks:
+        return {"status": "skip", "reference": reference.get("label"),
+                "checks": [], "note": "no comparable fields"}
+    status = "pass" if all(c["ok"] for c in checks) else "fail"
+    return {"status": status, "reference": reference.get("label"),
+            "checks": checks}
+
+
+def gate_line(result: dict) -> str:
+    """One human line for a gate result (stderr companion of the JSON)."""
+    if result["status"] == "skip":
+        return f"perfwatch: gate skipped ({result.get('note', '')})"
+    parts = []
+    for c in result["checks"]:
+        op = ">=" if c["field"] == "value" else "<="
+        parts.append(f"{c['field']} {c['current']} {op} {c['bound']} "
+                     f"[{'ok' if c['ok'] else 'FAIL'}]")
+    return (f"perfwatch: {result['status'].upper()} vs "
+            f"{result['reference']}: " + ", ".join(parts))
+
+
+# ---------------------------------------------------------------------------
+# Trend table
+# ---------------------------------------------------------------------------
+
+_COLS = (("value", "img/s", "{:.0f}"), ("step_time_ms", "step ms",
+                                        "{:.2f}"),
+         ("mfu", "mfu", "{:.3f}"), ("hbm_gb_per_step", "hbm GB",
+                                    "{:.2f}"),
+         ("membw_util", "membw", "{:.3f}"))
+
+
+def trend_table(records: List[dict]) -> str:
+    """Human trend over a record list: one row per record, Δ% on the
+    headline vs the previous non-null row."""
+    if not records:
+        return "perfwatch: no records"
+    rows = [["record"] + [h for _, h, _ in _COLS] + ["Δ%"]]
+    prev = None
+    for rec in records:
+        row = [rec.get("label") or "?"]
+        for key, _, fmt in _COLS:
+            v = rec.get(key)
+            row.append(fmt.format(v) if isinstance(v, (int, float))
+                       else "-")
+        delta = "-"
+        v = rec.get("value")
+        if isinstance(v, (int, float)):
+            if prev:
+                delta = f"{(v / prev - 1) * 100:+.1f}"
+            prev = v
+        row.append(delta)
+        rows.append(row)
+    widths = [max(len(r[i]) for r in rows) for i in range(len(rows[0]))]
+    return "\n".join(
+        "  ".join(c.rjust(w) if i else c.ljust(w)
+                  for i, (c, w) in enumerate(zip(r, widths)))
+        for r in rows)
+
+
+# ---------------------------------------------------------------------------
+# CLI
+# ---------------------------------------------------------------------------
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m horovod_tpu.utils.perfwatch",
+        description="Perf trend + regression gate over bench history "
+                    "(BENCH_r*.json) and perf.jsonl health logs.")
+    ap.add_argument("record", nargs="?", default=None,
+                    help="record to inspect/gate: a bench JSON line "
+                         "file, a BENCH_r*.json, or a perf.jsonl (last "
+                         "record gates)")
+    ap.add_argument("--history", metavar="DIR", default=".",
+                    help="directory holding BENCH_r*.json (default: .)")
+    ap.add_argument("--check", action="store_true",
+                    help="gate RECORD against the history; exit 2 on "
+                         "regression (img/s drop beyond the noise "
+                         "bound, or hbm_gb_per_step creep)")
+    ap.add_argument("--json", action="store_true",
+                    help="machine-readable output")
+    args = ap.parse_args(argv)
+
+    history = load_history(args.history)
+    if args.record is None:
+        if args.check:
+            ap.error("--check needs a RECORD to gate")
+        records = history
+        if args.json:
+            print(json.dumps(records))
+        else:
+            print(trend_table(records))
+        return 0
+
+    try:
+        records = load_records(args.record)
+    except (OSError, ValueError, json.JSONDecodeError) as exc:
+        print(f"perfwatch: cannot load {args.record}: {exc}",
+              file=sys.stderr)
+        return 1
+    if not records:
+        print(f"perfwatch: {args.record} holds no gate-able record",
+              file=sys.stderr)
+        return 1
+    current = records[-1]
+    # A multi-record file (perf.jsonl) carries its own history: the
+    # newest capture gates/trends against the EARLIER captures of the
+    # same log (pick_reference additionally refuses to cross between
+    # named bench metrics and unnamed capture records).
+    if len(records) > 1:
+        history = history + records[:-1]
+
+    if not args.check:
+        rows = history + [current]
+        if args.json:
+            print(json.dumps(rows))
+        else:
+            print(trend_table(rows))
+        return 0
+
+    result = gate(current, pick_reference(history, current))
+    if args.json:
+        print(json.dumps(result))
+    else:
+        print(gate_line(result))
+    return 2 if result["status"] == "fail" else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -28,6 +28,13 @@ import os
 from typing import Callable, Optional
 
 
+class CaptureError(RuntimeError):
+    """A profiler capture completed but produced no ``*.xplane.pb`` —
+    raised loudly instead of letting callers iterate a silently empty
+    ``trace_files()`` list (a missing trace read as "zero traffic" is
+    worse than a crashed capture)."""
+
+
 @contextlib.contextmanager
 def profile(logdir: str):
     """Context manager capturing an XLA profiler trace into ``logdir``."""
@@ -44,10 +51,17 @@ def capture(fn: Callable, *args, logdir: str, iters: int = 3,
     logdir. ``barrier`` (default: numpy-fetch the last output's first
     leaf) forces execution to finish inside the trace window —
     ``block_until_ready`` is not a reliable barrier on the tunneled axon
-    platform (see bench.py)."""
+    platform (see bench.py).
+
+    Raises :class:`CaptureError` when the capture lands no new
+    ``*.xplane.pb`` under ``logdir`` (profiler plugin missing, a
+    concurrent trace already active, or the runtime wrote nothing):
+    every downstream consumer (xplane attribution, perf.jsonl records)
+    would otherwise silently report an empty profile."""
     import jax
     import numpy as np
 
+    before = set(trace_files(logdir)) if os.path.isdir(logdir) else set()
     out = None
     with profile(logdir):
         for _ in range(max(1, iters)):
@@ -64,6 +78,13 @@ def capture(fn: Callable, *args, logdir: str, iters: int = 3,
                 if hasattr(first, "ravel"):
                     first = first.ravel()[:1]
                 np.asarray(first)
+    new = [f for f in trace_files(logdir) if f not in before]
+    if not new:
+        raise CaptureError(
+            f"profiler capture produced no *.xplane.pb under {logdir!r} "
+            "(is another trace already active? is the profiler plugin "
+            "available on this platform?) — refusing to return an empty "
+            "capture")
     return logdir
 
 

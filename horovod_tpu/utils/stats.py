@@ -7,14 +7,22 @@
 - a Prometheus-style text file written by ``HVD_TELEMETRY_FILE`` (see
   :mod:`horovod_tpu.core.telemetry`) — parsed and pretty-printed
   (``--watch N`` re-reads every N seconds, the poor-man's dashboard);
+- an ``http://host:port`` (or full ``.../metrics``) URL served by
+  ``HVD_TELEMETRY_PORT`` (:mod:`horovod_tpu.core.telemetry_http`) —
+  fetched and rendered exactly like the file (``--watch`` re-fetches);
 - an XLA profiler capture directory (``bench.py --profile DIR``) — the
   machine-readable HBM attribution (:func:`horovod_tpu.utils.xplane.
   hbm_json`, the same data ``xplane --hbm --json`` emits), so bench
   tooling never re-parses the human table;
 - ``live`` — snapshot of the *current process's* registry (only useful
   from code/REPL in the process doing the work; cross-process use goes
-  through the exposition file).
-"""
+  through the exposition file or the HTTP endpoint).
+
+``--json`` emits ONE envelope shape regardless of source — ``{"source",
+"target", "samples": [{"name", "labels", "value"}, ...]}`` — so a
+dashboard script written against a file keeps working pointed at a live
+``http://`` rank or a capture dir (xplane figures flatten into
+``xplane_*`` samples with the op class as a label)."""
 
 from __future__ import annotations
 
@@ -99,20 +107,76 @@ def _is_xplane_dir(target: str) -> bool:
         return False
 
 
+def _is_http(target: str) -> bool:
+    return target.startswith(("http://", "https://"))
+
+
+def fetch_http(target: str) -> str:
+    """GET the exposition text from an ``HVD_TELEMETRY_PORT`` endpoint.
+    A bare ``http://host:port`` targets ``/metrics``; a full path
+    (``/metrics``, ``/healthz``) is used verbatim. Error statuses with a
+    body are returned, not raised: ``/healthz`` deliberately answers 503
+    while a warn-state verdict is live — exactly the moment the payload
+    matters most."""
+    import urllib.error
+    import urllib.request
+    from urllib.parse import urlparse
+
+    url = target
+    if urlparse(target).path in ("", "/"):
+        url = target.rstrip("/") + "/metrics"
+    try:
+        with urllib.request.urlopen(url, timeout=5) as resp:
+            return resp.read().decode("utf-8", "replace")
+    except urllib.error.HTTPError as exc:
+        body = exc.read().decode("utf-8", "replace")
+        if body:
+            return body
+        raise
+
+
+def xplane_samples(data: dict) -> List[Tuple[str, Dict[str, str], float]]:
+    """Flatten an :func:`~horovod_tpu.utils.xplane.hbm_json` dict into
+    exposition-shaped samples (``xplane_*`` names, the op class as a
+    label) so ``--json`` is shape-identical with the other sources."""
+    out: List[Tuple[str, Dict[str, str], float]] = []
+    for key, val in data.items():
+        if isinstance(val, (int, float)) and not isinstance(val, bool):
+            out.append((f"xplane_{key}", {}, float(val)))
+    for cls, fields in sorted((data.get("classes") or {}).items()):
+        for f in ("ms", "bytes"):
+            if isinstance(fields.get(f), (int, float)):
+                out.append((f"xplane_class_{f}", {"class": cls},
+                            float(fields[f])))
+    return out
+
+
+def _envelope(source: str, target: str,
+              samples: List[Tuple[str, Dict[str, str], float]]) -> dict:
+    return {"source": source, "target": target,
+            "samples": [{"name": n, "labels": l, "value": v}
+                        for n, l, v in samples]}
+
+
 def main(argv=None):
     import argparse
 
     ap = argparse.ArgumentParser(
         prog="python -m horovod_tpu.utils.stats",
         description="Query horovod_tpu telemetry: an HVD_TELEMETRY_FILE "
-                    "exposition file, an xplane capture dir, or 'live'.")
+                    "exposition file, an http://host:port endpoint "
+                    "(HVD_TELEMETRY_PORT), an xplane capture dir, or "
+                    "'live'.")
     ap.add_argument("target",
-                    help="exposition file | xplane capture dir | 'live'")
+                    help="exposition file | http://host:port | xplane "
+                         "capture dir | 'live'")
     ap.add_argument("--json", action="store_true",
-                    help="machine-readable output")
+                    help="machine-readable output (one envelope shape "
+                         "for every source)")
     ap.add_argument("--watch", type=float, metavar="SECONDS", default=None,
                     help="redraw the report every N seconds (exposition "
-                         "file or 'live'); Ctrl-C exits cleanly")
+                         "file, http target or 'live'); Ctrl-C exits "
+                         "cleanly")
     ap.add_argument("--steps", type=int, default=1,
                     help="steps in an xplane capture window (per-step "
                          "attribution)")
@@ -123,16 +187,43 @@ def main(argv=None):
             from horovod_tpu.core import telemetry
 
             if args.json:
-                print(json.dumps(telemetry.telemetry(), default=str))
+                print(json.dumps(_envelope(
+                    "live", "live",
+                    parse_prometheus(telemetry.prometheus()))))
             else:
                 print(telemetry.report())
+            return 0
+        if _is_http(args.target):
+            try:
+                text = fetch_http(args.target)
+            except Exception as exc:
+                print(f"cannot fetch {args.target}: {exc}")
+                return 1
+            samples = parse_prometheus(text)
+            if args.json:
+                if not samples and text.lstrip().startswith("{"):
+                    # A /healthz target already answers machine-readable
+                    # JSON (the sentinel health document, not metric
+                    # samples) — pass it through instead of burying it
+                    # in an empty-samples envelope.
+                    print(text.strip())
+                else:
+                    print(json.dumps(_envelope("http", args.target,
+                                               samples)))
+            elif samples:
+                print(render(samples))
+            else:
+                # A /healthz target returns JSON, not exposition text —
+                # show it as-is rather than "no samples".
+                print(text.rstrip("\n"))
             return 0
         if _is_xplane_dir(args.target):
             from horovod_tpu.utils import xplane
 
             if args.json:
-                print(json.dumps(xplane.hbm_json(args.target,
-                                                 steps=args.steps)))
+                data = xplane.hbm_json(args.target, steps=args.steps)
+                print(json.dumps(_envelope("xplane", args.target,
+                                           xplane_samples(data))))
             else:
                 print(xplane.hbm_report(args.target, steps=args.steps))
             return 0
@@ -144,17 +235,15 @@ def main(argv=None):
             return 1
         samples = parse_prometheus(text)
         if args.json:
-            print(json.dumps([
-                {"name": n, "labels": l, "value": v}
-                for n, l, v in samples]))
+            print(json.dumps(_envelope("file", args.target, samples)))
         else:
             print(render(samples))
         return 0
 
-    # --watch: the poor-man's dashboard, now for 'live' too (stalls can
-    # be watched as they develop from inside the driving process).
-    # Ctrl-C is the documented way out — exit cleanly, not with a
-    # KeyboardInterrupt stack trace.
+    # --watch: the poor-man's dashboard — file, http and 'live' targets
+    # (stalls can be watched as they develop, from outside the process
+    # via the HTTP endpoint). Ctrl-C is the documented way out — exit
+    # cleanly, not with a KeyboardInterrupt stack trace.
     try:
         while True:
             rc = render_once()

@@ -9,6 +9,14 @@ still applies because the backend itself is not initialized until first use.
 
 import os
 
+# The performance sentinel (core/sentinel.py) defaults ON in production;
+# in the suite, hundreds of heterogeneous jit programs share one process
+# and every first-call compile would read as a dispatch anomaly — dumps
+# and warnings all over the output. Tests that exercise the watchdog
+# re-enable it explicitly (tests/test_perfwatch.py resets the
+# singleton). setdefault: an operator's explicit env still wins.
+os.environ.setdefault("HVD_WATCHDOG", "0")
+
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (

@@ -407,13 +407,22 @@ def main():
         else:
             raise SystemExit("dead peer did not surface")
         deadline = time.monotonic() + 15.0
-        dumps = []
-        while time.monotonic() < deadline and not dumps:
-            dumps = _glob.glob(
-                os.path.join(fdir, "hvd_flight.rank0.*.json"))
-            time.sleep(0.1)
-        assert dumps, f"no flight dump in {fdir}"
-        dump = _json.load(open(dumps[0]))
+        dump = None
+        while time.monotonic() < deadline and dump is None:
+            # Newest-first, retry on a racing retention prune: dumps
+            # are unique-per-write now and the cap keeps only the
+            # newest K, so a globbed path may vanish before open().
+            for cand in sorted(_glob.glob(
+                    os.path.join(fdir, "hvd_flight.rank0.*.json")),
+                    reverse=True):
+                try:
+                    dump = _json.load(open(cand))
+                    break
+                except (OSError, ValueError):
+                    continue
+            if dump is None:
+                time.sleep(0.1)
+        assert dump is not None, f"no loadable flight dump in {fdir}"
         assert "process 1" in dump["reason"], dump["reason"]
         names = {ev.get("name") for ev in dump["events"]}
         assert "NEGOTIATE_ALLREDUCE" in names and "QUEUE" in names, names

@@ -65,11 +65,37 @@ def test_bench_dry_one_json_line_contract(poisoned_env):
     rec = json.loads(lines[0])
     for key in ("metric", "value", "unit", "vs_baseline", "step_time_ms",
                 "gflops_per_step", "mfu", "hbm_gb_per_step", "hbm_source",
-                "membw_util", "dry"):
+                "membw_util", "spread_pct", "gate", "dry"):
         assert key in rec, (key, rec)
     assert rec["metric"] == "resnet50_train_images_per_sec_per_chip_bs32"
     assert rec["unit"] == "images/sec/chip"
     assert rec["dry"] is True
+
+
+def test_bench_dry_check_keeps_contract_and_gate_fields_null(poisoned_env):
+    """`--dry --check` (ISSUE 6 satellite): still import-free, still one
+    JSON line, the regression-gate fields present-but-null (there is
+    nothing to gate without a run), exit 0."""
+    proc = subprocess.run([sys.executable, BENCH, "--dry", "--check"],
+                          capture_output=True, text=True, timeout=60,
+                          env=poisoned_env, cwd=REPO)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    assert "must not import jax" not in proc.stderr
+    lines = [ln for ln in proc.stdout.splitlines() if ln.strip()]
+    assert len(lines) == 1, proc.stdout
+    rec = json.loads(lines[0])
+    assert rec["gate"] is None
+    assert rec["spread_pct"] is None
+    assert rec["dry"] is True
+
+
+def test_bench_check_flag_documented():
+    proc = subprocess.run([sys.executable, BENCH, "--help"],
+                          capture_output=True, text=True, timeout=60,
+                          cwd=REPO)
+    assert proc.returncode == 0
+    assert "--check" in proc.stdout
+    assert "--profile" in proc.stdout
 
 
 def test_allreduce_benchmark_has_json_flag():

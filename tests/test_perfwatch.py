@@ -1,0 +1,622 @@
+"""The performance sentinel (core/sentinel.py) and the perf regression
+gate (utils/perfwatch.py): gate arithmetic pinned against the checked-in
+BENCH_r*.json history, watchdog anomaly semantics (fire-once, cooldown,
+attribution), flight-dump retention, the /metrics + /healthz endpoint,
+and the unified stats --json envelope."""
+
+import contextlib
+import io
+import json
+import os
+import re
+import time
+
+import numpy as np
+import pytest
+
+from horovod_tpu.core import sentinel as sen
+from horovod_tpu.core import telemetry as tele
+from horovod_tpu.utils import perfwatch as pw
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture()
+def fresh_sentinel(monkeypatch):
+    """A sentinel rebuilt from THIS test's env (the suite default is
+    HVD_WATCHDOG=0, see conftest) and torn down after, so one test's
+    watchdog state never leaks into the next."""
+
+    def make(**env):
+        for k, v in env.items():
+            if v is None:
+                monkeypatch.delenv(k, raising=False)
+            else:
+                monkeypatch.setenv(k, str(v))
+        sen.reset_sentinel()
+        return sen.get_sentinel()
+
+    yield make
+    sen.reset_sentinel()
+    tele.STRAGGLERS.reset()
+
+
+# ---------------------------------------------------------------------------
+# perfwatch: loading + gate arithmetic over the checked-in history
+# ---------------------------------------------------------------------------
+
+def test_perfwatch_is_stdlib_only():
+    """bench.py --check depends on this module staying import-light (the
+    --dry guard proves argparse paths never pay jax; the gate itself
+    must stay runnable on a CI box with no framework)."""
+    src = open(os.path.join(REPO, "horovod_tpu", "utils",
+                            "perfwatch.py")).read()
+    assert not re.search(r"^\s*(import|from)\s+(jax|numpy|tensorflow|"
+                         r"torch|horovod_tpu)\b", src, re.M), \
+        "perfwatch.py must stay stdlib-only"
+
+
+def test_load_history_fixtures():
+    hist = pw.load_history(REPO)
+    labels = [r["label"] for r in hist]
+    assert labels[:5] == ["r01", "r02", "r03", "r04", "r05"]
+    r05 = hist[labels.index("r05")]
+    assert r05["value"] == 2938.4
+    assert r05["hbm_gb_per_step"] == 7.81
+    # The recorded iteration spread (2919-2951 over median 2938.4).
+    assert r05["spread_frac"] == pytest.approx((2951 - 2919) / 2938.4)
+    # BASELINE.json is metadata-only today: no numeric record.
+    assert pw.load_record(os.path.join(REPO, "BASELINE.json")) is None
+
+
+def test_gate_passes_on_r05_against_history():
+    hist = pw.load_history(REPO)
+    cur = pw.load_record(os.path.join(REPO, "BENCH_r05.json"))
+    ref = pw.pick_reference(hist, cur)
+    assert ref["label"] == "r05"  # newest same-metric record
+    result = pw.gate(cur, ref)
+    assert result["status"] == "pass", result
+    fields = {c["field"] for c in result["checks"]}
+    assert fields == {"value", "hbm_gb_per_step"}
+    # And an honest improvement (r05 vs r04) passes too.
+    r04 = next(r for r in hist if r["label"] == "r04")
+    assert pw.gate(cur, r04)["status"] == "pass"
+
+
+def test_gate_fails_on_doctored_img_per_sec_drop():
+    hist = pw.load_history(REPO)
+    cur = pw.load_record(os.path.join(REPO, "BENCH_r05.json"))
+    cur["value"] = round(cur["value"] * 0.90, 2)  # -10%
+    result = pw.gate(cur, pw.pick_reference(hist, cur))
+    assert result["status"] == "fail"
+    bad = [c for c in result["checks"] if not c["ok"]]
+    assert [c["field"] for c in bad] == ["value"]
+    # The bound is noise-aware: spread (~1.1%) below the 2% floor, so
+    # the floor rules -> reference * (1 - 0.02 * 1.5).
+    assert bad[0]["bound"] == pytest.approx(
+        2938.4 * (1 - pw.MIN_NOISE * pw.NOISE_MULT), abs=0.01)
+
+
+def test_gate_fails_on_hbm_traffic_creep():
+    hist = pw.load_history(REPO)
+    cur = pw.load_record(os.path.join(REPO, "BENCH_r05.json"))
+    cur["hbm_gb_per_step"] = round(cur["hbm_gb_per_step"] * 1.10, 3)
+    result = pw.gate(cur, pw.pick_reference(hist, cur))
+    assert result["status"] == "fail"
+    bad = [c for c in result["checks"] if not c["ok"]]
+    assert [c["field"] for c in bad] == ["hbm_gb_per_step"]
+    assert bad[0]["bound"] == pytest.approx(7.81 * (1 + pw.HBM_TOL),
+                                            abs=1e-3)
+
+
+def test_gate_skips_cleanly():
+    # No history at all.
+    assert pw.gate({"value": 1.0}, None)["status"] == "skip"
+    # Metric mismatch: a vgg run must not gate against the resnet line.
+    hist = pw.load_history(REPO)
+    other = {"metric": "vgg16_train_images_per_sec_per_chip_bs32",
+             "value": 100.0}
+    assert pw.pick_reference(hist, other) is None
+    # Null fields skip their check, not the whole gate: a CPU record
+    # with no measured HBM still gates on throughput.
+    cur = pw.load_record(os.path.join(REPO, "BENCH_r05.json"))
+    cur["hbm_gb_per_step"] = None
+    result = pw.gate(cur, pw.pick_reference(hist, cur))
+    assert result["status"] == "pass"
+    assert [c["field"] for c in result["checks"]] == ["value"]
+
+
+def test_perfwatch_cli_trend_and_check(tmp_path, capsys):
+    # Trend table over the checked-in history.
+    assert pw.main(["--history", REPO]) == 0
+    out = capsys.readouterr().out
+    assert "r05" in out and "2938" in out
+    # A passing record file gates green...
+    good = tmp_path / "good.json"
+    good.write_text(json.dumps(
+        {"metric": "resnet50_train_images_per_sec_per_chip_bs32",
+         "value": 2940.0, "hbm_gb_per_step": 7.8, "spread_pct": 1.1}))
+    assert pw.main([str(good), "--history", REPO, "--check"]) == 0
+    # ...a doctored one exits 2 with the failing field named.
+    bad = tmp_path / "bad.json"
+    bad.write_text(json.dumps(
+        {"metric": "resnet50_train_images_per_sec_per_chip_bs32",
+         "value": 2644.0, "hbm_gb_per_step": 8.6}))
+    capsys.readouterr()
+    assert pw.main([str(bad), "--history", REPO, "--check"]) == 2
+    out = capsys.readouterr().out
+    assert "FAIL" in out and "hbm_gb_per_step" in out
+    # perf.jsonl loads line-per-record; the last record gates.
+    pj = tmp_path / "perf.jsonl"
+    pj.write_text(
+        json.dumps({"kind": "periodic", "hbm_gb_per_step": 7.5}) + "\n" +
+        json.dumps({"kind": "periodic", "hbm_gb_per_step": 9.9}) + "\n")
+    recs = pw.load_records(str(pj))
+    assert len(recs) == 2
+    assert pw.load_record(str(pj))["hbm_gb_per_step"] == 9.9
+    # Unnamed capture records gate against the log's EARLIER captures —
+    # never against the named bench history (pick_reference refuses the
+    # cross): 9.9 GB vs the log's own 7.5 GB is a creep -> exit 2.
+    assert pw.pick_reference(pw.load_history(REPO), recs[-1]) is None
+    assert pw.main([str(pj), "--history", REPO, "--check"]) == 2
+
+
+# ---------------------------------------------------------------------------
+# Watchdog semantics
+# ---------------------------------------------------------------------------
+
+def test_watchdog_warmup_fire_once_and_cooldown(fresh_sentinel, tmp_path,
+                                                monkeypatch):
+    monkeypatch.setenv("HVD_FLIGHT_DIR", str(tmp_path))
+    s = fresh_sentinel(HVD_WATCHDOG=1, HVD_WATCHDOG_MIN_STEPS=8,
+                       HVD_WATCHDOG_COOLDOWN=5, HVD_PROFILE_DIR=None)
+    # Warmup: nothing fires below min_steps, whatever the excursion.
+    for _ in range(7):
+        assert s.observe_step(0.010, origin="t") is None
+    # Steady baseline, then one 20x step.
+    for _ in range(10):
+        assert s.observe_step(0.010, origin="t") is None
+    v = s.observe_step(0.200, origin="t")
+    assert v is not None and v["origin"] == "t"
+    assert v["step_s"] == pytest.approx(0.2)
+    assert v["threshold_s"] < 0.2
+    assert v["verdict"] == "unattributed"
+    assert v["dump"] and os.path.exists(v["dump"])
+    dump = json.load(open(v["dump"]))
+    assert dump["reason"].startswith("watchdog:")
+    assert any(ev["name"] == "WATCHDOG_VERDICT" for ev in dump["events"])
+    # Cooldown: repeated excursions are suppressed, not re-fired.
+    for _ in range(5):
+        assert s.observe_step(0.200, origin="t") is None
+    wd = s.watchdog("t")
+    assert wd.anomalies == 1 and wd.suppressed >= 1
+    dumps = [f for f in os.listdir(tmp_path)
+             if f.startswith("hvd_flight")]
+    assert len(dumps) == 1, dumps
+    # Health reflects the verdict.
+    h = s.health()
+    assert h["status"] == "warn"
+    assert h["verdict"]["verdict"] == "unattributed"
+    assert h["watchdogs"]["t"]["anomalies"] == 1
+
+
+def test_watchdog_recompile_attribution(fresh_sentinel, tmp_path,
+                                        monkeypatch):
+    monkeypatch.setenv("HVD_FLIGHT_DIR", str(tmp_path))
+    s = fresh_sentinel(HVD_WATCHDOG=1, HVD_WATCHDOG_MIN_STEPS=4,
+                       HVD_PROFILE_DIR=None)
+    for _ in range(10):
+        s.observe_step(0.010, origin="d")
+    # A compile event lands DURING the anomalous step.
+    with sen._compile_lock:
+        sen._compile_count += 1
+    v = s.observe_step(0.300, origin="d")
+    assert v is not None and v["verdict"] == "recompile"
+    assert v["compiles"] == 1
+
+
+def test_watchdog_straggler_attribution(fresh_sentinel, tmp_path,
+                                        monkeypatch):
+    monkeypatch.setenv("HVD_FLIGHT_DIR", str(tmp_path))
+    s = fresh_sentinel(HVD_WATCHDOG=1, HVD_WATCHDOG_MIN_STEPS=4,
+                       HVD_PROFILE_DIR=None)
+    for _ in range(10):
+        s.observe_step(0.010, origin="t")
+    # The negotiation tables charged process 1 during the slow step —
+    # the verdict cross-references the telemetry straggler report.
+    tele.STRAGGLERS.observe("grad/7", {0: 100.0, 1: 100.5})
+    v = s.observe_step(0.300, origin="t")
+    assert v is not None and v["verdict"] == "straggler"
+    assert v["straggler"]["process"] == 1
+    assert v["straggler"]["wait_us"] == pytest.approx(5e5, rel=0.01)
+
+
+def test_watchdog_stall_attribution(fresh_sentinel, tmp_path, monkeypatch):
+    monkeypatch.setenv("HVD_FLIGHT_DIR", str(tmp_path))
+    s = fresh_sentinel(HVD_WATCHDOG=1, HVD_WATCHDOG_MIN_STEPS=4,
+                       HVD_PROFILE_DIR=None)
+    for _ in range(10):
+        s.observe_step(0.010, origin="t")
+    sen.note_stall("stalled tensors: grad/3 (61s)")
+    v = s.observe_step(0.300, origin="t")
+    assert v is not None and v["verdict"] == "engine_stall"
+    assert "grad/3" in v["stall"]
+    assert s.health()["stall"]["reason"].startswith("stalled tensors")
+
+
+def test_one_step_observed_via_two_origins_counts_once(fresh_sentinel,
+                                                       tmp_path,
+                                                       monkeypatch):
+    """A keras Trainer step is seen twice — the wrapped jit reports its
+    dispatch, then the Trainer reports wall time. Capture stepping must
+    follow ONE origin (trainer preferred), and one slow step must not
+    dump through both watchdogs."""
+    monkeypatch.setenv("HVD_FLIGHT_DIR", str(tmp_path))
+    s = fresh_sentinel(HVD_WATCHDOG=1, HVD_WATCHDOG_MIN_STEPS=4,
+                       HVD_PROFILE_DIR=None)
+    for _ in range(10):  # interleaved, like a real Trainer step
+        s.observe_step(0.008, origin="jax.dispatch")
+        s.observe_step(0.010, origin="trainer")
+    # The capture state machine advanced once per REAL step (plus the
+    # one pre-upgrade dispatch observation of the very first step).
+    assert s.capture._step <= 11
+    assert s._capture_origin == "trainer"
+    # One slow step, seen through both lenses: exactly one firing.
+    v1 = s.observe_step(0.400, origin="jax.dispatch")
+    v2 = s.observe_step(0.402, origin="trainer")
+    fired = [v for v in (v1, v2) if v is not None]
+    assert len(fired) == 1, (v1, v2)
+    dumps = [f for f in os.listdir(tmp_path)
+             if f.startswith("hvd_flight")]
+    assert len(dumps) == 1, dumps
+    total = (s.watchdogs["jax.dispatch"].anomalies
+             + s.watchdogs["trainer"].anomalies)
+    assert total == 1
+
+
+def test_telemetry_port_zero_means_disabled(monkeypatch):
+    from horovod_tpu.core import telemetry, telemetry_http
+
+    telemetry_http.stop()
+    monkeypatch.setattr(telemetry, "_http_started", False)
+    monkeypatch.setenv("HVD_TELEMETRY_PORT", "0")
+    telemetry._maybe_start_http()
+    assert telemetry_http.current_port() is None
+    # And a malformed value is ignored, not fatal.
+    monkeypatch.setattr(telemetry, "_http_started", False)
+    monkeypatch.setenv("HVD_TELEMETRY_PORT", "not-a-port")
+    telemetry._maybe_start_http()
+    assert telemetry_http.current_port() is None
+
+
+def test_watchdog_disabled_still_tracks_health(fresh_sentinel):
+    s = fresh_sentinel(HVD_WATCHDOG=0)
+    assert s.observe_step(10.0, origin="t") is None
+    h = s.health()
+    assert h["enabled"] is False
+    assert h["last_step_age_s"] is not None
+
+
+def test_health_warns_on_stale_loop(fresh_sentinel):
+    """A rank hung inside a compiled-path collective stops observing
+    steps entirely — /healthz must degrade on staleness, not just on
+    verdicts/stalls."""
+    s = fresh_sentinel(HVD_WATCHDOG=1, HVD_WATCHDOG_MIN_STEPS=4)
+    for _ in range(6):
+        s.observe_step(0.010, origin="t")
+    assert s.health()["status"] == "ok"
+    s.last_step_wall = time.time() - 120  # 2 min of silence
+    h = s.health()
+    assert h["status"] == "warn" and h["stale"] is True
+    assert h["stale_after_s"] >= 60.0
+
+
+# ---------------------------------------------------------------------------
+# Tier-1 integration: injected slow step on the 8-device mesh
+# ---------------------------------------------------------------------------
+
+def test_trainer_slow_step_dumps_and_attributes_once(hvd, tmp_path,
+                                                     monkeypatch,
+                                                     fresh_sentinel):
+    """ISSUE 6 acceptance: one artificially slow training step on the
+    8-device CPU mesh yields exactly one flight dump + one attributed
+    watchdog verdict — no re-trigger storm."""
+    import optax
+
+    import horovod_tpu.keras as hvd_keras
+    from horovod_tpu.models import MnistMLP
+
+    rng = np.random.RandomState(0)
+    x = rng.randn(256, 8, 8, 1).astype(np.float32)
+    y = (rng.rand(256) * 10).astype(np.int32) % 10
+
+    # Build + compile with the suite-default (disabled) sentinel: the
+    # first-call compile must not pollute the baseline window.
+    t = hvd_keras.Trainer(MnistMLP(hidden=16), optax.sgd(0.1))
+    t.fit(x, y, batch_size=2, epochs=1, shuffle=False)
+
+    monkeypatch.setenv("HVD_FLIGHT_DIR", str(tmp_path))
+    # Wide margins (30× EWMA / 10× p99): ordinary one-core-host jitter
+    # (GC pauses, a sibling process) must not fire before the injected
+    # step — a spurious firing would open the cooldown and suppress the
+    # real anomaly (observed flake: a 14 ms jitter step beat a 2×p99
+    # threshold of ~10 ms).
+    s = fresh_sentinel(HVD_WATCHDOG=1, HVD_WATCHDOG_MIN_STEPS=8,
+                       HVD_WATCHDOG_FACTOR=30, HVD_WATCHDOG_P99_MULT=10,
+                       HVD_WATCHDOG_COOLDOWN=1000, HVD_PROFILE_DIR=None)
+
+    # Bypass the _InstrumentedJit wrapper (call the inner jitted object)
+    # so ONLY the trainer origin observes this fit: the dispatch origin's
+    # µs-scale baseline would make it the jitter-flake magnet.
+    real = getattr(t._train_step, "_jitted", t._train_step)
+    calls = {"n": 0}
+
+    def injected(*args, **kwargs):
+        calls["n"] += 1
+        if calls["n"] == 12:  # past the 8-step warmup
+            time.sleep(1.5)
+        return real(*args, **kwargs)
+
+    t._train_step = injected
+    t.fit(x, y, batch_size=2, epochs=1, shuffle=False)  # 16 steps
+
+    wd = s.watchdog("trainer")
+    assert wd.steps == 16
+    assert wd.anomalies == 1, wd.summary()
+    v = s.last_verdict
+    assert v is not None and v["origin"] == "trainer"
+    assert v["step_s"] > 1.0
+    assert v["verdict"] in ("unattributed", "recompile", "straggler",
+                            "engine_stall")
+    dumps = [f for f in os.listdir(tmp_path)
+             if f.startswith("hvd_flight")]
+    assert len(dumps) == 1, dumps
+    dump = json.load(open(tmp_path / dumps[0]))
+    assert "watchdog: trainer step" in dump["reason"]
+    assert s.health()["status"] == "warn"
+
+
+# ---------------------------------------------------------------------------
+# Auto-capture: bounded capture -> perf.jsonl record
+# ---------------------------------------------------------------------------
+
+def test_autocapture_periodic_appends_perf_jsonl(hvd, tmp_path,
+                                                 fresh_sentinel):
+    import jax
+    import jax.numpy as jnp
+
+    s = fresh_sentinel(HVD_WATCHDOG=0, HVD_PROFILE_DIR=str(tmp_path),
+                       HVD_PROFILE_EVERY=6, HVD_PROFILE_STEPS=2)
+    f = jax.jit(lambda a: a @ a)
+    a = jnp.ones((32, 32))
+    for _ in range(9):
+        t0 = time.perf_counter()
+        f(a).block_until_ready()
+        s.observe_step(time.perf_counter() - t0, origin="cap")
+    pj = os.path.join(str(tmp_path), "perf.jsonl")
+    deadline = time.monotonic() + 60
+    rec = None
+    while time.monotonic() < deadline and rec is None:
+        if os.path.exists(pj):
+            lines = open(pj).read().splitlines()
+            if lines:
+                rec = json.loads(lines[-1])
+                break
+        time.sleep(0.2)
+    assert rec is not None, "no perf.jsonl record appeared"
+    assert rec["kind"] == "periodic" and rec["steps"] == 2
+    assert rec["step_time_ms"] is not None
+    assert os.path.isdir(rec["capture_dir"])
+    # The perf.jsonl schema is exactly what perfwatch loads.
+    assert pw.load_record(pj)["step_time_ms"] == rec["step_time_ms"]
+
+
+# ---------------------------------------------------------------------------
+# Flight-dump retention cap
+# ---------------------------------------------------------------------------
+
+def test_flight_dump_retention_cap(tmp_path, monkeypatch):
+    from horovod_tpu.core import timeline as tl
+
+    monkeypatch.setenv("HVD_FLIGHT_DIR", str(tmp_path))
+    monkeypatch.setenv("HVD_FLIGHT_KEEP", "3")
+    paths = []
+    for i in range(7):
+        p = tl.dump_flight_recorder([{"name": "X", "ph": "i", "ts": i}],
+                                    f"r{i}")
+        assert p is not None
+        paths.append(p)
+        time.sleep(0.002)  # distinct mtimes/wall_us across dumps
+    kept = sorted(f for f in os.listdir(tmp_path)
+                  if f.startswith("hvd_flight"))
+    assert len(kept) == 3, kept
+    # The newest dumps survive; the older ones are gone.
+    for new in paths[-3:]:
+        assert os.path.exists(new), kept
+    for old in paths[:4]:
+        assert not os.path.exists(old), kept
+    # An explicit path (the engines' tests pass one) is never pruned.
+    explicit = tmp_path / "explicit.json"
+    tl.dump_flight_recorder([], "explicit", path=str(explicit))
+    assert explicit.exists()
+
+
+def test_flight_dump_same_reason_rate_limited(tmp_path, monkeypatch):
+    """A poisoned negotiation re-raises the same failure every ~5 ms
+    cycle: dump_and_warn must land the first dump and drop same-reason
+    repeats inside HVD_FLIGHT_MIN_INTERVAL (distinct reasons still
+    land immediately)."""
+    import logging
+
+    from horovod_tpu.core import timeline as tl
+
+    monkeypatch.setenv("HVD_FLIGHT_DIR", str(tmp_path))
+    monkeypatch.setenv("HVD_FLIGHT_MIN_INTERVAL", "30")
+    log = logging.getLogger("test.flight")
+    first = tl.dump_and_warn([], "negotiation failed: peer died", 0, log)
+    assert first is not None and os.path.exists(first)
+    for _ in range(5):
+        assert tl.dump_and_warn([], "negotiation failed: peer died",
+                                0, log) is None
+    other = tl.dump_and_warn([], "stalled tensors: grad/1", 0, log)
+    assert other is not None and other != first
+    files = [f for f in os.listdir(tmp_path) if f.startswith("hvd_flight")]
+    assert len(files) == 2, files
+
+
+def test_flight_keep_env_parsing(monkeypatch):
+    from horovod_tpu.core import timeline as tl
+
+    monkeypatch.delenv("HVD_FLIGHT_KEEP", raising=False)
+    assert tl.flight_keep() == 8
+    monkeypatch.setenv("HVD_FLIGHT_KEEP", "not-a-number")
+    assert tl.flight_keep() == 8
+    monkeypatch.setenv("HVD_FLIGHT_KEEP", "0")
+    assert tl.flight_keep() == 1  # at least the newest dump survives
+
+
+# ---------------------------------------------------------------------------
+# Profiler: empty captures fail loudly
+# ---------------------------------------------------------------------------
+
+def test_profiler_capture_raises_on_empty_capture(tmp_path, monkeypatch):
+    from horovod_tpu.utils import profiler
+
+    # A "profiler" that records nothing (the plugin-missing /
+    # concurrent-trace failure mode).
+    monkeypatch.setattr(profiler, "profile",
+                        lambda d: contextlib.nullcontext())
+    with pytest.raises(profiler.CaptureError, match="no \\*.xplane.pb"):
+        profiler.capture(lambda v: v, 1.0, logdir=str(tmp_path), iters=1)
+
+
+# ---------------------------------------------------------------------------
+# /metrics + /healthz endpoint and the unified stats --json envelope
+# ---------------------------------------------------------------------------
+
+@pytest.fixture()
+def http_endpoint(fresh_sentinel):
+    from horovod_tpu.core import telemetry_http
+
+    fresh_sentinel(HVD_WATCHDOG=0)
+    telemetry_http.stop()
+    port = telemetry_http.maybe_start(0)  # ephemeral port
+    assert port
+    yield f"http://127.0.0.1:{port}"
+    telemetry_http.stop()
+
+
+def test_http_endpoint_serves_metrics_and_healthz(http_endpoint):
+    import urllib.request
+
+    tele.REGISTRY.counter("sentinel.test_counter").inc(3)
+    text = urllib.request.urlopen(
+        http_endpoint + "/metrics", timeout=5).read().decode()
+    assert "hvd_sentinel_test_counter 3" in text
+    resp = urllib.request.urlopen(http_endpoint + "/healthz", timeout=5)
+    h = json.loads(resp.read())
+    assert resp.status == 200  # no steps yet -> "init", still healthy
+    assert h["status"] in ("init", "ok")
+    assert "watchdogs" in h and "pid" in h
+    # Unknown paths 404 with a hint.
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        urllib.request.urlopen(http_endpoint + "/nope", timeout=5)
+    assert ei.value.code == 404
+
+
+def test_healthz_degrades_to_503_on_warn(http_endpoint, fresh_sentinel,
+                                         tmp_path, monkeypatch):
+    import urllib.request
+
+    monkeypatch.setenv("HVD_FLIGHT_DIR", str(tmp_path))
+    s = fresh_sentinel(HVD_WATCHDOG=1, HVD_WATCHDOG_MIN_STEPS=4,
+                       HVD_PROFILE_DIR=None)
+    for _ in range(8):
+        s.observe_step(0.01, origin="t")
+    s.observe_step(0.5, origin="t")  # anomaly -> warn
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        urllib.request.urlopen(http_endpoint + "/healthz", timeout=5)
+    assert ei.value.code == 503
+    assert json.loads(ei.value.read())["status"] == "warn"
+    # The stats CLI still shows the payload on 503 — the warn state is
+    # exactly when the operator queries /healthz.
+    from horovod_tpu.utils import stats
+
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        assert stats.main([http_endpoint + "/healthz"]) == 0
+    assert json.loads(buf.getvalue())["status"] == "warn"
+    # --json passes the health document through instead of burying it
+    # in an empty-samples envelope.
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        assert stats.main([http_endpoint + "/healthz", "--json"]) == 0
+    h = json.loads(buf.getvalue())
+    assert h["status"] == "warn" and "watchdogs" in h
+
+
+def _stats_json(argv):
+    from horovod_tpu.utils import stats
+
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        assert stats.main(argv) == 0
+    return json.loads(buf.getvalue())
+
+
+def test_stats_json_shape_identical_across_sources(http_endpoint,
+                                                   tmp_path):
+    """ISSUE 6 satellite: one envelope shape — {source, target, samples}
+    with {name, labels, value} samples — whatever the source."""
+    tele.REGISTRY.counter("sentinel.shape_probe").inc()
+    # file source
+    path = str(tmp_path / "expo.prom")
+    from horovod_tpu.core import telemetry
+
+    open(path, "w").write(telemetry.prometheus())
+    envs = {
+        "file": _stats_json([path, "--json"]),
+        "live": _stats_json(["live", "--json"]),
+        "http": _stats_json([http_endpoint, "--json"]),
+    }
+    for src, env in envs.items():
+        assert set(env) == {"source", "target", "samples"}, src
+        assert env["source"] == src
+        assert env["samples"], src
+        assert all(set(s) == {"name", "labels", "value"}
+                   for s in env["samples"]), src
+    probe = "hvd_sentinel_shape_probe"
+    for src, env in envs.items():
+        assert any(s["name"] == probe for s in env["samples"]), src
+    # file and http carry byte-identical sample lists (same exposition
+    # text modulo the instant it was read) — compare the probe value.
+    get = lambda env: [s["value"] for s in env["samples"]  # noqa: E731
+                       if s["name"] == probe][0]
+    assert get(envs["file"]) <= get(envs["http"])
+
+
+def test_stats_watch_works_against_http(http_endpoint, monkeypatch,
+                                        capsys):
+    from horovod_tpu.utils import stats
+
+    sleeps = []
+
+    def fake_sleep(seconds):
+        sleeps.append(seconds)
+        if len(sleeps) >= 2:
+            raise KeyboardInterrupt
+
+    monkeypatch.setattr(stats.time, "sleep", fake_sleep)
+    assert stats.main([http_endpoint, "--watch", "0.25"]) == 0
+    out = capsys.readouterr().out
+    assert sleeps == [0.25, 0.25]
+    assert out.count("hvd_") >= 2  # redrawn at least twice
+
+
+def test_launcher_exposes_telemetry_port_flag():
+    import horovod_tpu.run as launcher
+
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf), pytest.raises(SystemExit):
+        launcher.main(["--help"])
+    assert "--telemetry-port-base" in buf.getvalue()

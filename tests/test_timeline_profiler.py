@@ -323,7 +323,15 @@ def test_xplane_hbm_accounting_on_synthetic_capture(tmp_path):
     buf = io.StringIO()
     with redirect_stdout(buf):
         assert stats.main([logdir, "--json"]) == 0
-    assert _json.loads(buf.getvalue())["dma_bytes"] == 256 * 4
+    env = _json.loads(buf.getvalue())
+    # The unified envelope shape (ISSUE 6 satellite): same schema as the
+    # file/live/http sources, xplane figures flattened into samples.
+    assert set(env) == {"source", "target", "samples"}
+    assert env["source"] == "xplane"
+    by_name = {(s["name"], s["labels"].get("class")): s["value"]
+               for s in env["samples"]}
+    assert by_name[("xplane_dma_bytes", None)] == 256 * 4
+    assert by_name[("xplane_class_bytes", "collective")] == 2 * 128 * 4
 
     # Shape parsing corner cases.
     assert xp._first_shape_bytes("%x = pred[3]{0} y(pred[3] %a)") == 3
