@@ -77,6 +77,16 @@ def main():
                         "multi-chip world; at N=1 it degrades to whole-"
                         "tree packing (a measured NEGATIVE — see "
                         "docs/benchmarks.md 'HBM diet')")
+    p.add_argument("--state-dtype", default="f32", choices=["f32", "bf16"],
+                   help="resident-state precision policy (HBM diet round "
+                        "2): 'bf16' keeps parameters and optimizer state "
+                        "in bf16 HBM with the update math in f32; with "
+                        "--sharded-update, f32 master weights ride the "
+                        "sharded optimizer state as each chip's 1/N "
+                        "shard (arxiv 2004.13336 §4) — full-width f32 "
+                        "state never touches HBM. Without sharding there "
+                        "are no masters (docs/troubleshooting.md on "
+                        "bf16 drift)")
     p.add_argument("--remat-blocks", nargs="?", const="act_drop",
                    default=None, choices=["act_drop", "conv_saves"],
                    help="ResNet traffic-removal remat: 'act_drop' "
@@ -123,6 +133,7 @@ def main():
             "step_time_ms": None, "gflops_per_step": None, "mfu": None,
             "hbm_gb_per_step": None, "hbm_source": None,
             "membw_util": None, "spread_pct": None, "gate": None,
+            "state_dtype": None,
             "dry": True,
         }))
         return
@@ -151,9 +162,14 @@ def main():
     # per-dtype flat buffers (horovod_tpu/jax/fused.py) — profiling shows
     # per-tensor updates + their HBM<->VMEM copies costing ~2.5 ms of an
     # 11.4 ms step at bs32.
+    # state_dtype (HBM diet round 2): resident params + optimizer state
+    # in bf16 HBM, update math in f32; with --sharded-update the f32
+    # masters ride the sharded state as 1/N shards.
+    state_dtype = None if args.state_dtype == "f32" else args.state_dtype
     opt = hvd_jax.DistributedOptimizer(
         optax.sgd(0.01, momentum=0.9), compression=compression,
-        fused_update=True, sharded_update=args.sharded_update)
+        fused_update=True, sharded_update=args.sharded_update,
+        state_dtype=state_dtype)
 
     rng = jax.random.PRNGKey(0)
     # bf16 host feed: the model computes in bf16; feeding bf16 halves the
@@ -168,6 +184,11 @@ def main():
     labels_host = np.random.randint(0, model.num_classes,
                                     size=(args.batch_size,))
     params, batch_stats = variables["params"], variables.get("batch_stats", {})
+    # Resident params at the policy width (identity under f32; the
+    # masters — when sharded — derive from these in opt.init, so cast
+    # FIRST). BN statistics stay f32: running moments accumulate badly
+    # in bf16.
+    params = hvd_jax.cast_resident_params(params, state_dtype)
     opt_state = opt.init(params)
     # Startup sync, as every reference example does before training
     # (reference: BroadcastGlobalVariablesHook).
@@ -449,6 +470,7 @@ def main():
         "spread_pct": round((max(rates) - min(rates)) / per_chip * 100, 2)
         if per_chip else None,
         "gate": None,  # filled by --check below; present-but-null else
+        "state_dtype": args.state_dtype,
     }
     # Unified telemetry (core/telemetry.py): eager-collective counts, the
     # startup broadcast, engine activity if any — read AFTER the timed

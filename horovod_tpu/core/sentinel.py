@@ -411,6 +411,7 @@ class AutoCapture:
             "capture_dir": active["dir"],
             "step_time_ms": None,
             "hbm_gb_per_step": None,
+            "hbm_gb_by_dtype": None,
             "membw_util": None,
             "mfu": None,
             "gflops_per_step": None,
@@ -434,6 +435,13 @@ class AutoCapture:
                                    steps=max(1, len(times)))
             hbm_bytes = data["true_hbm_bytes_per_step"]
             record["hbm_gb_per_step"] = round(hbm_bytes / 1e9, 3)
+            by_dtype = data.get("bytes_by_dtype_per_step") or None
+            if by_dtype:
+                # bf16-vs-f32 byte split (HBM diet round 2): schedule-
+                # derived, so it audits the state_dtype policy — f32
+                # bytes creeping back show up per capture in perf.jsonl.
+                record["hbm_gb_by_dtype"] = {
+                    dt: round(b / 1e9, 3) for dt, b in by_dtype.items()}
             import jax
 
             from horovod_tpu.utils import hardware as hw

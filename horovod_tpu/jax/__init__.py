@@ -49,8 +49,15 @@ from horovod_tpu.ops.collectives import (  # noqa: F401
     grouped_allreduce,
 )
 from horovod_tpu.jax.compression import Compression, Compressor  # noqa: F401
-from horovod_tpu.jax.fused import fuse  # noqa: F401
+from horovod_tpu.jax.fused import (  # noqa: F401
+    canonical_state_dtype,
+    cast_resident_params,
+    fuse,
+    state_storage,
+)
 from horovod_tpu.jax.sharded import (  # noqa: F401
+    has_master_shards,
+    resident_from_masters,
     shard_update,
     sharded_state_specs,
 )
@@ -207,6 +214,23 @@ _ZERO_TREES: dict = {}
 _ZERO_TREES_MAX = 8
 
 
+def _zeros_like_in(dtype):
+    """``zeros_like`` honoring a ``state_dtype`` policy: float leaves
+    get ``dtype`` zeros instead of their own width, so the gradient
+    accumulator cannot silently park a full-width f32 buffer in HBM
+    (``acc_init``; f32 grads can't promote it — ``acc_update`` casts
+    the sum back)."""
+    if dtype is None:
+        return jnp.zeros_like
+
+    def one(leaf):
+        if jnp.issubdtype(jnp.result_type(leaf), jnp.floating):
+            return jnp.zeros(jnp.shape(leaf), dtype)
+        return jnp.zeros_like(leaf)
+
+    return one
+
+
 def _cached_zero_tree(tree):
     leaves, treedef = _jax.tree_util.tree_flatten(tree)
     if any(isinstance(l, _jax.core.Tracer) for l in leaves):
@@ -215,9 +239,10 @@ def _cached_zero_tree(tree):
         # concrete tree here would bake a param-sized CONSTANT into the
         # executable instead.
         return _jax.tree.map(jnp.zeros_like, tree)
-    key = (treedef, tuple((jnp.shape(l), str(jnp.result_type(l)),
-                           str(getattr(l, "sharding", None)))
-                          for l in leaves))
+    key = (treedef,
+           tuple((jnp.shape(l), str(jnp.result_type(l)),
+                  str(getattr(l, "sharding", None)))
+                 for l in leaves))
     z = _ZERO_TREES.get(key)
     if z is None:
         while len(_ZERO_TREES) >= _ZERO_TREES_MAX:
@@ -234,6 +259,7 @@ def DistributedOptimizer(
     backward_passes_per_step: int = 1,
     fused_update: bool = False,
     sharded_update: bool = False,
+    state_dtype=None,
 ):
     """Wrap an optax transform so gradients are allreduced (fused, with
     compression) before the update (reference: horovod/tensorflow/
@@ -257,7 +283,18 @@ def DistributedOptimizer(
     :func:`sharded_state_specs`. Subsumes ``fused_update`` (the whole
     tree is packed); valid for per-coordinate transforms ONLY (a
     shard-local ``clip_by_global_norm`` would be wrong — see
-    sharded.py)."""
+    sharded.py).
+
+    ``state_dtype='bf16'`` (HBM diet round 2, arxiv 2004.13336 §4 +
+    1909.09756) keeps the resident state in the reduced dtype: with
+    ``sharded_update`` the params/opt-state live in bf16 HBM and f32
+    master weights exist only as each chip's 1/N shard
+    (:func:`horovod_tpu.jax.shard_update`); on the fused/plain paths the
+    optimizer state is *stored* reduced and *computed* f32
+    (:func:`horovod_tpu.jax.state_storage` — no masters: see
+    docs/troubleshooting.md on drift). Cast your resident params to the
+    policy dtype before ``init`` (the Trainer and bench wiring do)."""
+    _sdt = canonical_state_dtype(state_dtype)
     if sharded_update:
         if backward_passes_per_step > 1:
             # The accumulation wrapper's state ({'inner', 'acc', 'count'})
@@ -272,11 +309,16 @@ def DistributedOptimizer(
         # Reduction happens inside the wrapper (reduce-scatter on the
         # packed buffers), so there is no separate allreduce here.
         optimizer = shard_update(optimizer, average=average,
-                                 compression=compression)
+                                 compression=compression,
+                                 state_dtype=_sdt)
         update = optimizer.update
     else:
         if fused_update:
-            optimizer = fuse(optimizer)
+            optimizer = fuse(optimizer, state_dtype=_sdt)
+        elif _sdt is not None:
+            # Unfused path: no packing, but the state storage policy
+            # still applies (m/v stored reduced, computed f32).
+            optimizer = state_storage(optimizer, _sdt)
 
         def update(grads, state, params=None, **kwargs):
             grads = allreduce_pytree(
@@ -299,12 +341,18 @@ def DistributedOptimizer(
     def acc_init(params):
         return {
             "inner": optimizer.init(params),
-            "acc": _jax.tree.map(jnp.zeros_like, params),
+            # Accumulators honor state_dtype (a skipped microbatch must
+            # not park a full-width f32 gradient tree in HBM).
+            "acc": _jax.tree.map(_zeros_like_in(_sdt), params),
             "count": jnp.zeros((), jnp.int32),
         }
 
     def acc_update(grads, state, params=None, **kwargs):
-        acc = _jax.tree.map(lambda a, g: a + g, state["acc"], grads)
+        # Cast the sum back to the accumulator dtype: a wider grad leaf
+        # (f32 grads under a bf16 policy) would otherwise promote the
+        # accumulator and change the state structure mid-training.
+        acc = _jax.tree.map(lambda a, g: (a + g).astype(a.dtype),
+                            state["acc"], grads)
         count = state["count"] + 1
 
         def apply_fn(operand):
@@ -319,7 +367,21 @@ def DistributedOptimizer(
 
         def skip_fn(operand):
             acc_, inner_ = operand
-            return _cached_zero_tree(grads), {
+            # The skip branch's zeros must type-match the apply branch's
+            # updates. Under the policy those follow the PARAM width when
+            # params ride along (state_storage casts them there) and the
+            # ACCUMULATOR width otherwise (the mean state_storage's
+            # grad-width rule sees IS the policy-dtype accumulator — raw
+            # f32 grads would mismatch). Deriving from params (not
+            # forcing the policy dtype) keeps the bf16 diet for
+            # compliant callers — residents ARE the policy width — while
+            # an uncast-f32-params caller still gets a working step
+            # instead of a cryptic lax.cond branch-type error.
+            if _sdt is not None:
+                ref = params if params is not None else acc_
+            else:
+                ref = grads
+            return _cached_zero_tree(ref), {
                 "inner": inner_,
                 "acc": acc_,
                 "count": count,

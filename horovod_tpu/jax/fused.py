@@ -38,6 +38,127 @@ import optax
 
 DEFAULT_THRESHOLD_ELEMS = 4096
 
+# Spellings accepted for the state_dtype policy knob. None / f32 mean
+# "off" (full-width f32 state, the pre-r7 behavior).
+_STATE_DTYPE_OFF = (None, "f32", "float32")
+_STATE_DTYPE_NAMES = {"bf16": jnp.bfloat16, "bfloat16": jnp.bfloat16,
+                      "f16": jnp.float16, "float16": jnp.float16}
+
+
+def canonical_state_dtype(state_dtype):
+    """Normalize a ``state_dtype`` policy spelling to a jnp dtype, or
+    None when the policy is off. Accepts ``None``/``'f32'`` (off),
+    ``'bf16'``/``'bfloat16'`` (the TPU-native reduced-precision layout,
+    arxiv 1909.09756), ``'f16'``, or a floating jnp/numpy dtype."""
+    if state_dtype in _STATE_DTYPE_OFF:
+        return None
+    if isinstance(state_dtype, str):
+        try:
+            return _STATE_DTYPE_NAMES[state_dtype]
+        except KeyError:
+            raise ValueError(
+                f"state_dtype={state_dtype!r}: expected one of "
+                f"{sorted(_STATE_DTYPE_NAMES)} or 'f32'/None") from None
+    dt = jnp.dtype(state_dtype)
+    if dt == jnp.dtype(jnp.float32):
+        # jnp.float32/np.float32 mean "off", symmetric with the 'f32'
+        # string spelling above.
+        return None
+    if not jnp.issubdtype(dt, jnp.floating) or dt.itemsize >= 4:
+        raise ValueError(f"state_dtype={state_dtype!r} is not a "
+                         "reduced-precision float dtype")
+    return dt
+
+
+def cast_resident_params(params, state_dtype):
+    """Cast a parameter tree's float leaves to the resident ``state_dtype``
+    policy width (non-float leaves untouched; identity when the policy is
+    off). Call BEFORE ``optimizer.init`` — the f32 master shards (with
+    ``sharded_update``) derive from the residents at init. The Trainer and
+    bench wiring route through here; exported so third-party training
+    loops apply the same rule. NOTE: batch-norm statistics live outside
+    the param tree (keep them f32 — running moments accumulate badly in
+    bf16)."""
+    dtype = canonical_state_dtype(state_dtype)
+    if dtype is None:
+        return params
+    return jax.tree_util.tree_map(
+        lambda l: (l.astype(dtype)
+                   if jnp.issubdtype(jnp.result_type(l), jnp.floating)
+                   else l),
+        params)
+
+
+def _is_stored_leaf(leaf) -> bool:
+    """True for the state leaves the storage policy applies to: non-scalar
+    float buffers (m/v/trace and the packed param-shaped buffers). Scalar
+    bookkeeping (adam's count, schedule steps) stays exact."""
+    return (hasattr(leaf, "dtype") and jnp.ndim(leaf) >= 1
+            and jnp.issubdtype(jnp.result_type(leaf), jnp.floating))
+
+
+def store_state(state, dtype):
+    """Downcast every non-scalar f32 state leaf to the storage ``dtype``
+    — what lives in HBM between steps."""
+    if dtype is None:
+        return state
+    return jax.tree_util.tree_map(
+        lambda l: (l.astype(dtype)
+                   if _is_stored_leaf(l) and l.dtype == jnp.float32 else l),
+        state)
+
+
+def load_state(state, dtype):
+    """Upcast the storage-``dtype`` leaves back to f32 for the update
+    math (the converts fuse into the consuming op — no extra HBM pass)."""
+    if dtype is None:
+        return state
+    return jax.tree_util.tree_map(
+        lambda l: (l.astype(jnp.float32)
+                   if _is_stored_leaf(l) and l.dtype == dtype else l),
+        state)
+
+
+def state_storage(optimizer: optax.GradientTransformation,
+                  state_dtype) -> optax.GradientTransformationExtraArgs:
+    """Wrap an elementwise optax transform so its state *storage* is
+    ``state_dtype`` while its update *math* stays f32: every non-scalar
+    float state buffer (momentum, Adam m/v) is downcast after init/update
+    and upcast before the inner update runs. The MLPerf TPU recipes'
+    bf16-resident layout (arxiv 1909.09756) applied to optimizer state —
+    HBM read+write of the state halves, the arithmetic does not change
+    dtype. Identity when ``state_dtype`` is None/'f32'.
+
+    NOTE: without a master copy the *parameter* apply still rounds to the
+    param dtype — pair with :func:`horovod_tpu.jax.shard_update`'s
+    ``state_dtype`` for f32 master shards (docs/troubleshooting.md
+    "bf16-state convergence drift")."""
+    dtype = canonical_state_dtype(state_dtype)
+    if dtype is None:
+        return optax.with_extra_args_support(optimizer)
+    optimizer = optax.with_extra_args_support(optimizer)
+
+    def init(params):
+        return store_state(optimizer.init(params), dtype)
+
+    def update(grads, state, params=None, **extra_args):
+        upd, new_state = optimizer.update(grads, load_state(state, dtype),
+                                          params, **extra_args)
+        # The f32 math would otherwise hand back a full-width f32 update
+        # tree; emit updates at the param width (what optax.apply_updates
+        # rounds to anyway) — or at the GRAD width when params are
+        # omitted (standard optax convention; an f32-loaded momentum
+        # trace would otherwise promote them) — so no full-width f32
+        # buffer rides between update and apply, and so a lax.cond
+        # accumulation-skip branch's zeros (param- or grad-width by the
+        # same rule) type-match the apply branch.
+        ref = params if params is not None else grads
+        upd = jax.tree_util.tree_map(
+            lambda u, r: u.astype(jnp.result_type(r)), upd, ref)
+        return upd, store_state(new_state, dtype)
+
+    return optax.GradientTransformationExtraArgs(init, update)
+
 
 class _FusedLayout(NamedTuple):
     """Static description of how leaves pack into per-dtype buffers."""
@@ -115,7 +236,7 @@ def _unpack(packed, layout: _FusedLayout):
 
 def fuse(optimizer: optax.GradientTransformation,
          threshold_elems: int = DEFAULT_THRESHOLD_ELEMS,
-         ) -> optax.GradientTransformationExtraArgs:
+         state_dtype=None) -> optax.GradientTransformationExtraArgs:
     """Wrap an elementwise optax transform so tensors smaller than
     ``threshold_elems`` update through per-dtype fused buffers (see module
     docstring); larger tensors keep their per-tensor path, preserving
@@ -125,8 +246,12 @@ def fuse(optimizer: optax.GradientTransformation,
     packed structure (small-tensor momenta fuse too). ``update`` accepts
     ``params``; ``**extra_args`` are forwarded UNCHANGED (transforms whose
     extra args mirror the parameter tree need the unfused path).
+
+    ``state_dtype`` applies :func:`state_storage` to the inner transform:
+    the packed (and passthrough) state buffers live in the reduced dtype
+    between steps while the update math stays f32.
     """
-    optimizer = optax.with_extra_args_support(optimizer)
+    optimizer = state_storage(optimizer, state_dtype)
     # init()'s layout is keyed by PARAM dtypes; update() must reuse it even
     # when called without params (standard optax convention) — a layout
     # recomputed from grads would group by GRAD dtype and mismatch the

@@ -58,7 +58,14 @@ from jax.sharding import PartitionSpec as P
 from horovod_tpu.common import topology as _topo
 from horovod_tpu.common.topology import HVD_AXIS
 from horovod_tpu.jax.compression import Compression
-from horovod_tpu.jax.fused import _layout_of, _pack, _unpack
+from horovod_tpu.jax.fused import (
+    _layout_of,
+    _pack,
+    _unpack,
+    canonical_state_dtype,
+    load_state,
+    store_state,
+)
 from horovod_tpu.ops import collectives as _C
 
 # Pack EVERY leaf: the reduce-scatter needs one contiguous buffer per
@@ -75,6 +82,7 @@ def shard_update(
     optimizer: optax.GradientTransformation,
     average: bool = True,
     compression=Compression.none,
+    state_dtype=None,
 ) -> optax.GradientTransformationExtraArgs:
     """Wrap ``optimizer`` so the gradient reduction AND the update are
     sharded across the world (module docstring). The returned transform
@@ -85,7 +93,32 @@ def shard_update(
     step (:func:`sharded_state_specs` builds the spec tree) so each chip
     holds one 1/N block. ``average=False`` keeps the reduced sum, exactly
     like :func:`horovod_tpu.jax.allreduce`.
+
+    ``state_dtype='bf16'`` (HBM diet round 2) adds the mixed-precision
+    resident layout of arxiv 2004.13336 §4 / the MLPerf TPU recipes
+    (arxiv 1909.09756): the caller keeps *resident* parameters in bf16
+    (cast them before ``init``; the Trainer/bench wiring does), and the
+    state becomes ``{"master": per-dtype f32 buffers, "inner": storage-
+    dtype optax state}``. Both ride the ``sharded_state_specs`` path, so
+    the f32 master weights exist ONLY as each chip's 1/N shard. Inside
+    the compiled step the epilogue is fused: gradients reduce-scatter at
+    their resident (bf16) width, ONLY the 1/N shard upcasts to f32, the
+    inner update and the master apply run in f32 on the shard, and the
+    resident-parameter delta all-gathers back at bf16 — no full-width
+    f32 gradient, parameter or state buffer ever materializes. The f32
+    master trajectory is bit-identical to replicated-f32 training for
+    per-coordinate exact updates (SGD with dyadic sums); the resident
+    params track ``bf16(master)`` within 1 ulp, re-anchored every step
+    (the delta is computed against the actual resident shard, so the
+    rounding does not accumulate). ``update`` REQUIRES ``params`` under
+    this policy (the delta re-anchoring needs the resident values), and
+    accepts a reserved ``lr_scale=<scalar>`` extra arg that scales the
+    inner update before the master apply — the hook for LR
+    warmup/schedule mechanisms, which cannot scale the returned
+    resident delta post-hoc (the masters have already advanced; the
+    next step's re-anchor would undo a caller-side scale).
     """
+    sdt = canonical_state_dtype(state_dtype)
     optimizer = optax.with_extra_args_support(optimizer)
     # Layout cache keyed like fuse(): init()'s param-dtype layout must
     # serve update() calls that omit params (grads share treedef/shapes).
@@ -115,11 +148,55 @@ def shard_update(
     def init(params):
         world = _world()
         layout = _remember(params)
-        return optimizer.init(
-            {"buf": _pack_padded(params, layout, world), "big": []})
+        pbufs = _pack_padded(params, layout, world)
+        if sdt is None:
+            return optimizer.init({"buf": pbufs, "big": []})
+        # Mixed layout: the f32 master copy of every resident buffer
+        # (the ONLY f32 copy — it shards to 1/N per chip under
+        # sharded_state_specs), plus the inner state init'd over the
+        # masters (m/v derive from f32) then downcast to storage dtype.
+        master = {k: v.astype(jnp.float32) for k, v in pbufs.items()}
+        inner = optimizer.init({"buf": master, "big": []})
+        return {"master": master, "inner": store_state(inner, sdt)}
+
+    def _master_step(g32, state, resbufs, extra_args):
+        """Fused mixed-precision epilogue on one block (the 1/N shard in
+        SPMD, the full buffers eagerly): f32 inner update against the f32
+        masters, master apply in f32, resident delta emitted at the
+        resident width, re-anchored on the actual resident values so the
+        bf16 rounding never accumulates.
+
+        ``lr_scale`` (reserved extra arg): post-update scale applied to
+        the inner update BEFORE the master apply. Under this policy the
+        masters advance inside ``update`` and the return value is only a
+        re-anchored resident delta, so a caller-side ``updates * scale``
+        (the keras Trainer's LR warmup/schedule mechanism) cannot touch
+        the trajectory — the scale must ride into the epilogue."""
+        lr_scale = extra_args.pop("lr_scale", None)
+        master = state["master"]
+        inner = load_state(state["inner"], sdt)
+        ushard, new_inner = optimizer.update(
+            {"buf": g32, "big": []}, inner, {"buf": master, "big": []},
+            **extra_args)
+        if lr_scale is not None:
+            # Skipped entirely when absent: a *1.0 would still be exact,
+            # but the bitwise-equivalence pins deserve an untouched path.
+            ushard = {"buf": {k: v * lr_scale
+                              for k, v in ushard["buf"].items()},
+                      "big": ushard["big"]}
+        new_master = {k: master[k] + ushard["buf"][k] for k in master}
+        ures = {k: (new_master[k] - resbufs[k].astype(jnp.float32))
+                .astype(resbufs[k].dtype) for k in new_master}
+        return ures, {"master": new_master,
+                      "inner": store_state(new_inner, sdt)}
 
     def update(grads, state, params=None, **extra_args):
         world = _world()
+        if sdt is not None and params is None:
+            raise ValueError(
+                "shard_update(state_dtype=...) needs params on every "
+                "update call: the resident-parameter delta re-anchors "
+                "on the actual resident values")
         if params is not None:
             layout = _remember(params)
         else:
@@ -138,6 +215,11 @@ def shard_update(
             # round trip). What remains is whole-tree packing — fuse()
             # semantics, a measured NEGATIVE on one chip (module
             # docstring); kept so the flag is runnable anywhere.
+            if sdt is not None:
+                g32 = {k: v.astype(jnp.float32) for k, v in gbufs.items()}
+                ures, new_state = _master_step(g32, state, pbufs,
+                                               extra_args)
+                return _unpack_padded(ures, layout), new_state
             ufull, new_state = optimizer.update(
                 {"buf": gbufs, "big": []}, state,
                 None if pbufs is None else {"buf": pbufs, "big": []},
@@ -153,6 +235,14 @@ def shard_update(
                 shard = lax.psum_scatter(wire, ax, scatter_dimension=0,
                                          tiled=True)
                 shard = compression.decompress(shard, ctx)
+                if sdt is not None:
+                    # Fused epilogue: the collective runs at the resident
+                    # (reduced) width; ONLY the 1/N shard upcasts to f32
+                    # — averaging included — so no full-width f32
+                    # gradient buffer exists between the reduce-scatter
+                    # and the update.
+                    shard = shard.astype(jnp.float32)
+                    return shard / n_axis if average else shard
                 if average:
                     shard = (shard / n_axis).astype(flat.dtype)
                 return shard
@@ -163,6 +253,14 @@ def shard_update(
                     v, (idx * (v.shape[0] // n_axis),),
                     (v.shape[0] // n_axis,))
                 for k, v in pbufs.items()}
+            if sdt is not None:
+                # params are guaranteed under the policy, so pshard is
+                # never None here.
+                ures, new_state = _master_step(gshard, state, pshard,
+                                               extra_args)
+                ubufs = {k: lax.all_gather(v, ax, axis=0, tiled=True)
+                         for k, v in ures.items()}
+                return _unpack_padded(ubufs, layout), new_state
             ushard, new_state = optimizer.update(
                 {"buf": gshard, "big": []}, state,
                 None if pshard is None else {"buf": pshard, "big": []},
@@ -179,11 +277,17 @@ def shard_update(
             wire, ctx = compression.compress(flat)
             out = _C.allreduce(wire, average=False)
             out = compression.decompress(out, ctx)
+            if sdt is not None:
+                out = out.astype(jnp.float32)
+                return out / world if average else out
             if average:
                 out = (out / world).astype(flat.dtype)
             return out
 
         gfull = {k: reduce_full(v) for k, v in gbufs.items()}
+        if sdt is not None:
+            ures, new_state = _master_step(gfull, state, pbufs, extra_args)
+            return _unpack_padded(ures, layout), new_state
         ufull, new_state = optimizer.update(
             {"buf": gfull, "big": []}, state,
             None if pbufs is None else {"buf": pbufs, "big": []},
@@ -191,6 +295,33 @@ def shard_update(
         return _unpack_padded(ufull["buf"], layout), new_state
 
     return optax.GradientTransformationExtraArgs(init, update)
+
+
+def has_master_shards(opt_state) -> bool:
+    """True when ``opt_state`` is a :func:`shard_update`
+    ``state_dtype=...`` mixed-layout state (f32 master buffers +
+    storage-dtype inner state)."""
+    return (isinstance(opt_state, dict)
+            and set(opt_state) == {"master", "inner"}
+            and isinstance(opt_state["master"], dict))
+
+
+def resident_from_masters(opt_state, params_like):
+    """Rebuild the resident parameter tree BITWISE from the f32 master
+    buffers of a ``state_dtype`` mixed-layout state: each master buffer
+    is cast to its group's resident dtype (the group key IS the resident
+    dtype name by :func:`~horovod_tpu.jax.fused._layout_of` construction)
+    and unpacked over ``params_like``'s structure. This is the checkpoint
+    restore path: persisting the masters and rebuilding residents from
+    them guarantees ``resident == cast(master)`` exactly after a restore,
+    so a save→restore→step trajectory matches the uninterrupted one."""
+    if not has_master_shards(opt_state):
+        raise ValueError("opt_state carries no master shards (was the "
+                         "optimizer built with state_dtype=...?)")
+    layout = _layout_of(params_like, _PACK_ALL)
+    bufs = {k: jnp.asarray(v).astype(k)
+            for k, v in opt_state["master"].items()}
+    return _unpack({"buf": bufs, "big": []}, layout)
 
 
 def sharded_state_specs(opt_state, axis: str = HVD_AXIS):

@@ -32,8 +32,11 @@ from horovod_tpu.jax import (
     Compression,  # noqa: F401
     allreduce_pytree,
     broadcast_pytree,
+    canonical_state_dtype as _canonical_state_dtype,
+    cast_resident_params as _cast_resident_params,
     jit as _hvd_jit,
     sharded_state_specs as _sharded_state_specs,
+    state_storage as _state_storage,
 )
 from horovod_tpu.jax import allreduce as _allreduce
 from horovod_tpu.core import sentinel as _sentinel
@@ -138,19 +141,33 @@ class Trainer:
         rng: int = 0,
         fused_update: bool = False,
         sharded_update: bool = False,
+        state_dtype=None,
     ):
         """``fused_update``/``sharded_update`` forward to
         :func:`horovod_tpu.jax.DistributedOptimizer` — ``sharded_update``
         runs the optimizer on a 1/N shard of params/state per chip
         (reduce-scatter + all-gather; per-coordinate transforms only) and
-        lays the optimizer state out ``P('hvd')`` in the compiled step."""
+        lays the optimizer state out ``P('hvd')`` in the compiled step.
+
+        ``state_dtype='bf16'`` (HBM diet round 2): resident parameters
+        are cast to bf16 at :meth:`build` (batch-norm statistics stay
+        f32), the optimizer state is stored reduced, and — with
+        ``sharded_update`` — f32 master weights ride the sharded state
+        as each chip's 1/N shard; :meth:`load` rebuilds the bf16
+        residents bitwise from the persisted masters."""
         self.model = model
         self._sharded_update = bool(sharded_update and distributed)
+        self._state_dtype = _canonical_state_dtype(state_dtype)
         if distributed:
             optimizer = DistributedOptimizer(optimizer,
                                              compression=compression,
                                              fused_update=fused_update,
-                                             sharded_update=sharded_update)
+                                             sharded_update=sharded_update,
+                                             state_dtype=state_dtype)
+        elif self._state_dtype is not None:
+            # Non-distributed trainer: the storage policy still applies
+            # (no masters — see docs/troubleshooting.md on drift).
+            optimizer = _state_storage(optimizer, self._state_dtype)
         self.optimizer = optimizer
         self.loss_fn = loss_fn
         self.metrics = tuple(metrics)
@@ -174,6 +191,11 @@ class Trainer:
         variables = self.model.init(
             {"params": key, "dropout": key}, jnp.asarray(x_sample), False)
         self.params = variables["params"]
+        # Resident params at the policy width (identity when off); the
+        # f32 masters (sharded_update) derive from these in
+        # optimizer.init, so cast BEFORE init. BN statistics are outside
+        # the param tree and stay f32.
+        self.params = _cast_resident_params(self.params, self._state_dtype)
         self.batch_stats = dict(variables.get("batch_stats", {}))
         self.opt_state = self.optimizer.init(self.params)
 
@@ -255,6 +277,15 @@ class Trainer:
         # without donation every param-sized buffer pays a copy-on-update
         # each step. Callbacks run AFTER the rebind and therefore always
         # see live buffers.
+        # Master-shard layout (state_dtype + sharded_update): the f32
+        # masters advance INSIDE opt.update and the returned tree is only
+        # a re-anchored resident delta, so the post-hoc `updates *
+        # lr_scale` below would be overwritten by the next step's
+        # re-anchor — the scale must ride into the epilogue instead
+        # (shard_update's reserved `lr_scale` extra arg).
+        scale_inside = (self._state_dtype is not None
+                        and self._sharded_update)
+
         @_hvd_jit(in_specs=(P(), P(), ospec, P(HVD_AXIS), P(HVD_AXIS), P(),
                             P()),
                   out_specs=(P(), P(), ospec, P()),
@@ -264,8 +295,13 @@ class Trainer:
             (loss, (logits, new_bs)), grads = jax.value_and_grad(
                 forward, has_aux=True)(params, batch_stats, x, y, True,
                                        dropout_key)
-            updates, opt_state = opt.update(grads, opt_state, params)
-            updates = jax.tree_util.tree_map(lambda u: u * lr_scale, updates)
+            if scale_inside:
+                updates, opt_state = opt.update(grads, opt_state, params,
+                                                lr_scale=lr_scale)
+            else:
+                updates, opt_state = opt.update(grads, opt_state, params)
+                updates = jax.tree_util.tree_map(lambda u: u * lr_scale,
+                                                 updates)
             params = optax.apply_updates(params, updates)
             return params, new_bs, opt_state, metrics_of(loss, logits, y)
 
@@ -455,6 +491,10 @@ class Trainer:
             raise ValueError(
                 f"checkpoint {path!r} does not match this Trainer's "
                 f"model: {shown}{more}")
+        # Mixed layout: the f32 master shards are the persisted source
+        # of truth — rebuild the bf16 residents from them so resident ==
+        # cast(master) bitwise after the restore (no-op without masters).
+        restored = _ckpt.rebuild_resident_params(restored)
         self.params = restored["params"]
         self.batch_stats = restored["batch_stats"]
         self.opt_state = restored["opt_state"]

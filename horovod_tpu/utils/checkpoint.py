@@ -7,6 +7,14 @@ broadcast so ranks agree (reference: examples/pytorch_imagenet_resnet50.py:
 protocol here over flax msgpack serialization: ``save_checkpoint`` writes on
 process 0 only; ``load_checkpoint`` reads everywhere and broadcasts the
 result from root so a restored run starts bitwise-identical on every rank.
+
+Mixed-precision layouts (``state_dtype='bf16'``, HBM diet round 2) round-
+trip through the same path: the optimizer state carries the f32 master
+buffers, so serializing it persists full-precision weights alongside the
+bf16 residents; :func:`rebuild_resident_params` re-derives the residents
+from the restored masters so ``resident == cast(master)`` holds bitwise
+after a restore (a resident saved mid-drift would otherwise diverge from
+its master by an ulp and perturb the restored trajectory).
 """
 
 from __future__ import annotations
@@ -24,11 +32,38 @@ def _ckpt_path(directory: str, step: int, prefix: str) -> str:
     return os.path.join(directory, f"{prefix}{step}.msgpack")
 
 
+def _globalize(target: Any) -> Any:
+    """Materialize cross-process-sharded leaves as full host values.
+
+    A ``shard_update`` optimizer state lays its buffers out ``P('hvd')``;
+    in a multi-controller world process 0 holds only its own 1/N shards
+    and cannot fetch the rest directly. ``fetch`` allgathers those leaves
+    — a COLLECTIVE, so every process must pass through here (and does:
+    ``save_checkpoint`` globalizes before its root-only early return).
+    Addressable leaves (replicated arrays, host numpy, scalars) pass
+    through untouched; the addressability predicate is a property of the
+    global sharding, identical on every process, so the collective order
+    stays rank-consistent."""
+    import jax
+
+    from horovod_tpu.ops.collectives import fetch
+
+    def one(leaf):
+        if isinstance(leaf, jax.Array) and not leaf.is_fully_addressable:
+            return fetch(leaf)
+        return leaf
+
+    return jax.tree_util.tree_map(one, target)
+
+
 def save_checkpoint(directory: str, target: Any, step: int,
                     prefix: str = "checkpoint_") -> Optional[str]:
     """Serialize ``target`` (any flax-serializable pytree) on process 0.
-    Returns the path written, or None on non-root processes."""
+    Returns the path written, or None on non-root processes (which still
+    participate in the shard allgather of cross-process-sharded state —
+    call on EVERY process, as Trainer.save does)."""
     st = _topo._require_init()
+    target = _globalize(target)
     if st.process_index != 0:
         return None
     os.makedirs(directory, exist_ok=True)
@@ -55,6 +90,24 @@ def latest_checkpoint(directory: str,
             if best is None or step > best[0]:
                 best = (step, os.path.join(directory, name))
     return best[1] if best else None
+
+
+def rebuild_resident_params(state_dict: dict, params_key: str = "params",
+                            opt_key: str = "opt_state") -> dict:
+    """Re-derive the reduced-precision resident params of a restored
+    trainer ``state_dict`` from its f32 master buffers (shard_update's
+    ``state_dtype`` layout). No-op when the optimizer state carries no
+    masters, so restore paths can call it unconditionally."""
+    from horovod_tpu.jax.sharded import (has_master_shards,
+                                         resident_from_masters)
+
+    opt_state = state_dict.get(opt_key)
+    if not has_master_shards(opt_state):
+        return state_dict
+    out = dict(state_dict)
+    out[params_key] = resident_from_masters(opt_state,
+                                            state_dict[params_key])
+    return out
 
 
 def load_checkpoint(path: str, target: Any, broadcast: bool = True,

@@ -240,11 +240,14 @@ _COLS = (("value", "img/s", "{:.0f}"), ("step_time_ms", "step ms",
 
 def trend_table(records: List[dict]) -> str:
     """Human trend over a record list: one row per record, Δ% on the
-    headline vs the previous non-null row."""
+    headline AND on hbm_gb_per_step vs the previous non-null row (the
+    byte-diet axis: an img/s win bought by byte creep — or a byte cut
+    like state_dtype='bf16' — is visible in the same table)."""
     if not records:
         return "perfwatch: no records"
-    rows = [["record"] + [h for _, h, _ in _COLS] + ["Δ%"]]
+    rows = [["record"] + [h for _, h, _ in _COLS] + ["Δ%", "hbmΔ%"]]
     prev = None
+    prev_hbm = None
     for rec in records:
         row = [rec.get("label") or "?"]
         for key, _, fmt in _COLS:
@@ -258,6 +261,13 @@ def trend_table(records: List[dict]) -> str:
                 delta = f"{(v / prev - 1) * 100:+.1f}"
             prev = v
         row.append(delta)
+        hdelta = "-"
+        h = rec.get("hbm_gb_per_step")
+        if isinstance(h, (int, float)):
+            if prev_hbm:
+                hdelta = f"{(h / prev_hbm - 1) * 100:+.1f}"
+            prev_hbm = h
+        row.append(hdelta)
         rows.append(row)
     widths = [max(len(r[i]) for r in rows) for i in range(len(rows[0]))]
     return "\n".join(

@@ -143,11 +143,22 @@ def xplane_samples(data: dict) -> List[Tuple[str, Dict[str, str], float]]:
     for key, val in data.items():
         if isinstance(val, (int, float)) and not isinstance(val, bool):
             out.append((f"xplane_{key}", {}, float(val)))
+    # Per-dtype byte split (HBM diet round 2): the bf16-vs-f32 audit
+    # columns, dtype as a label like the op class.
+    for dt, val in sorted((data.get("bytes_by_dtype_per_step")
+                           or {}).items()):
+        if isinstance(val, (int, float)):
+            out.append(("xplane_bytes_per_step", {"dtype": dt},
+                        float(val)))
     for cls, fields in sorted((data.get("classes") or {}).items()):
         for f in ("ms", "bytes"):
             if isinstance(fields.get(f), (int, float)):
                 out.append((f"xplane_class_{f}", {"class": cls},
                             float(fields[f])))
+        for dt, val in sorted((fields.get("by_dtype") or {}).items()):
+            if isinstance(val, (int, float)):
+                out.append(("xplane_class_dtype_bytes",
+                            {"class": cls, "dtype": dt}, float(val)))
     return out
 
 

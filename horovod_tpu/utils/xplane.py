@@ -184,16 +184,26 @@ def _op_root(name: str) -> str:
     return m.group(1) if m else ""
 
 
-def _hbm_shape_bytes(text: str) -> int:
-    """Sum bytes of every shape literal in ``text`` whose layout does NOT
-    place it in a scoped memory space (``S(n)`` = VMEM/SMEM); unannotated
-    layouts are HBM (space 0)."""
-    total = 0
+def _hbm_shape_bytes_by_dtype(text: str) -> Dict[str, int]:
+    """Bytes of every shape literal in ``text`` whose layout does NOT
+    place it in a scoped memory space (``S(n)`` = VMEM/SMEM; unannotated
+    layouts are HBM, space 0), split by element dtype — the bf16-vs-f32
+    byte attribution the ``state_dtype`` policy (HBM diet round 2) is
+    judged by: a mixed-precision regression shows up as f32 bytes
+    creeping back into a class that should stream bf16."""
+    out: Dict[str, int] = {}
     for dt, dims, layout in _SHAPE_LAYOUT_RE.findall(text):
         if layout and "S(" in layout:
             continue
-        total += _shape_bytes(dt, dims)
-    return total
+        out[dt] = out.get(dt, 0) + _shape_bytes(dt, dims)
+    return out
+
+
+def _hbm_shape_bytes(text: str) -> int:
+    """Total over :func:`_hbm_shape_bytes_by_dtype` — one accounting
+    rule, so the per-dtype split can never desynchronize from the
+    totals."""
+    return sum(_hbm_shape_bytes_by_dtype(text).values())
 
 
 def hbm_bytes(logdir: str, spaces=None) -> Dict[str, float]:
@@ -309,36 +319,60 @@ def _op_class(name: str) -> str:
 def class_breakdown(logdir: str, steps: int = 1,
                     spaces=None) -> Dict[str, Dict[str, float]]:
     """Per-op-class sequencer time and schedule-derived HBM bytes over
-    the "XLA Ops" line: ``{class: {"ms": .., "bytes": ..}}`` (per step).
+    the "XLA Ops" line: ``{class: {"ms": .., "bytes": ..,
+    "by_dtype": {dtype: bytes}}}`` (per step).
 
     This is the attribution table for traffic regressions: a jump in
     "collective" bytes means the wire (or a size-1 world failing to
     elide its collectives), "optimizer" the update fusions the sharded
-    weight update divides by N, "conv/matmul" the math itself. Bytes are
+    weight update divides by N, "conv/matmul" the math itself; the
+    per-dtype split inside each class is the ``state_dtype`` policy's
+    audit trail (f32 bytes reappearing in "optimizer" or "collective"
+    means a full-width master/gradient buffer crept back). Bytes are
     name-level (each op's non-VMEM operand/result shapes — same
     accounting as :func:`hbm_bytes`), so copy/layout ops over-count
     their source buffers; "control" ops contribute time but no bytes.
     """
     out: Dict[str, Dict[str, float]] = collections.defaultdict(
-        lambda: {"ms": 0.0, "bytes": 0.0})
+        lambda: {"ms": 0.0, "bytes": 0.0,
+                 "by_dtype": collections.defaultdict(float)})
     if spaces is None:
         spaces = _load_spaces(logdir)
     for plane, line in _device_lines(spaces, "XLA Ops"):
         meta = {i: m.name for i, m in plane.event_metadata.items()}
-        info: Dict[int, Tuple[str, int]] = {}
+        info: Dict[int, Tuple[str, int, dict]] = {}
         for ev in line.events:
             mid = ev.metadata_id
             if mid not in info:
                 name = meta.get(mid, "")
                 cls = _op_class(name)
-                info[mid] = (cls,
-                             0 if cls == "control" else _hbm_shape_bytes(name))
-            cls, b = info[mid]
+                if cls == "control":
+                    info[mid] = (cls, 0, {})
+                else:
+                    bd = _hbm_shape_bytes_by_dtype(name)
+                    info[mid] = (cls, sum(bd.values()), bd)
+            cls, b, bd = info[mid]
             out[cls]["ms"] += ev.duration_ps / 1e9
             out[cls]["bytes"] += b
+            for dt, db in bd.items():
+                out[cls]["by_dtype"][dt] += db
     steps = max(steps, 1)
-    return {c: {"ms": v["ms"] / steps, "bytes": v["bytes"] / steps}
+    return {c: {"ms": v["ms"] / steps, "bytes": v["bytes"] / steps,
+                "by_dtype": {dt: db / steps
+                             for dt, db in sorted(v["by_dtype"].items())}}
             for c, v in out.items()}
+
+
+def _dtype_totals(classes: Dict[str, dict]) -> Dict[str, float]:
+    """Capture-wide per-dtype byte totals summed over a
+    :func:`class_breakdown` result — the one accounting rule behind both
+    ``hbm_json``'s ``bytes_by_dtype_per_step`` and the CLI table's
+    per-dtype columns, so the two can never disagree."""
+    totals: Dict[str, float] = collections.defaultdict(float)
+    for v in classes.values():
+        for dt, db in v["by_dtype"].items():
+            totals[dt] += db
+    return dict(totals)
 
 
 def fusion_direct_bytes(logdir: str, spaces=None) -> float:
@@ -365,9 +399,13 @@ def hbm_json(logdir: str, steps: int = 1, spaces=None) -> dict:
     dma = dma_bytes(logdir, spaces=spaces)
     direct = fusion_direct_bytes(logdir, spaces=spaces)
     classes = class_breakdown(logdir, steps=steps, spaces=spaces)
+    by_dtype = _dtype_totals(classes)
     return {
         "steps": steps,
         "classes": classes,
+        # Schedule-derived (name-level) bytes split by element dtype —
+        # the bf16-vs-f32 audit column for the state_dtype policy.
+        "bytes_by_dtype_per_step": dict(sorted(by_dtype.items())),
         "dma_bytes": dma["bytes"],
         "dma_events": dma["events"],
         "dma_busy_ms": dma["busy_ms"],
@@ -423,10 +461,20 @@ def hbm_report(logdir: str, steps: int = 1, spaces=None) -> str:
     # (incl. the while wrapper, whose span covers the whole loop)
     # carry time but no bytes.
     classes = class_breakdown(logdir, steps=steps, spaces=spaces)
+    # Per-dtype columns (bf16-vs-f32 split, HBM diet round 2): one
+    # column per dtype carrying bytes anywhere in the capture, heaviest
+    # first, so a full-width f32 buffer creeping back under a bf16
+    # state policy is visible per class.
+    dtotals = _dtype_totals(classes)
+    dts = [d for d, _ in sorted(dtotals.items(), key=lambda kv: -kv[1])]
     out.append("per-op-class (schedule-derived bytes, name-level):")
-    out.append(f"  {'class':20s} {'ms/step':>8s} {'GB/step':>8s}")
+    out.append(f"  {'class':20s} {'ms/step':>8s} {'GB/step':>8s}"
+               + "".join(f" {('GB ' + d):>8s}" for d in dts))
     for c, v in sorted(classes.items(), key=lambda kv: -kv[1]["bytes"]):
-        out.append(f"  {c:20s} {v['ms']:8.3f} {v['bytes'] / 1e9:8.2f}")
+        row = f"  {c:20s} {v['ms']:8.3f} {v['bytes'] / 1e9:8.2f}"
+        for d in dts:
+            row += f" {v['by_dtype'].get(d, 0.0) / 1e9:8.2f}"
+        out.append(row)
     return "\n".join(out)
 
 

@@ -65,7 +65,7 @@ def test_bench_dry_one_json_line_contract(poisoned_env):
     rec = json.loads(lines[0])
     for key in ("metric", "value", "unit", "vs_baseline", "step_time_ms",
                 "gflops_per_step", "mfu", "hbm_gb_per_step", "hbm_source",
-                "membw_util", "spread_pct", "gate", "dry"):
+                "membw_util", "spread_pct", "gate", "state_dtype", "dry"):
         assert key in rec, (key, rec)
     assert rec["metric"] == "resnet50_train_images_per_sec_per_chip_bs32"
     assert rec["unit"] == "images/sec/chip"
@@ -89,6 +89,30 @@ def test_bench_dry_check_keeps_contract_and_gate_fields_null(poisoned_env):
     assert rec["dry"] is True
 
 
+def test_bench_dry_state_dtype_keeps_contract(poisoned_env):
+    """`--state-dtype bf16 --dry` (HBM diet round 2): still import-free,
+    still one JSON line, the state_dtype field present-but-null (the
+    policy only means something on a real run)."""
+    proc = subprocess.run([sys.executable, BENCH, "--dry",
+                           "--state-dtype", "bf16"],
+                          capture_output=True, text=True, timeout=60,
+                          env=poisoned_env, cwd=REPO)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    assert "must not import jax" not in proc.stderr
+    lines = [ln for ln in proc.stdout.splitlines() if ln.strip()]
+    assert len(lines) == 1, proc.stdout
+    rec = json.loads(lines[0])
+    assert rec["state_dtype"] is None
+    assert rec["dry"] is True
+    # A bad spelling is an argparse error (exit 2), still import-free.
+    proc = subprocess.run([sys.executable, BENCH, "--dry",
+                           "--state-dtype", "int8"],
+                          capture_output=True, text=True, timeout=60,
+                          env=poisoned_env, cwd=REPO)
+    assert proc.returncode == 2
+    assert "must not import jax" not in proc.stderr
+
+
 def test_bench_check_flag_documented():
     proc = subprocess.run([sys.executable, BENCH, "--help"],
                           capture_output=True, text=True, timeout=60,
@@ -96,6 +120,7 @@ def test_bench_check_flag_documented():
     assert proc.returncode == 0
     assert "--check" in proc.stdout
     assert "--profile" in proc.stdout
+    assert "--state-dtype" in proc.stdout
 
 
 def test_allreduce_benchmark_has_json_flag():

@@ -206,6 +206,58 @@ def test_fused_update_matches_unfused(make_opt):
                                    np.asarray(b, np.float32), rtol=2e-6)
 
 
+@pytest.mark.parametrize("fused", [False, True], ids=["plain", "fused"])
+def test_state_storage_policy_on_unsharded_paths(fused):
+    """state_dtype='bf16' on the fused/plain (master-less) paths
+    (HBM diet round 2, satellite): the optimizer state is *stored* bf16
+    between steps — every non-scalar float buffer — while the update
+    math runs f32; updates come back at the PARAM width and the
+    trajectory tracks the f32 oracle within bf16 storage rounding."""
+    params_p = _mixed_tree()
+    params_u = _mixed_tree()
+    mk = lambda: optax.adam(1e-2)
+    policy = (hj.fuse(mk(), state_dtype="bf16") if fused
+              else hj.state_storage(mk(), "bf16"))
+    plain = mk()
+    sp, su = policy.init(params_p), plain.init(params_u)
+    # Storage layout: non-scalar float state (m/v, packed or not) lives
+    # in bf16; the count scalar stays exact.
+    bufs = [l for l in jax.tree.leaves(sp)
+            if hasattr(l, "dtype") and jnp.ndim(l) >= 1
+            and jnp.issubdtype(l.dtype, jnp.floating)]
+    assert bufs and all(b.dtype == jnp.bfloat16 for b in bufs), [
+        b.dtype for b in bufs]
+    for step in range(3):
+        grads = jax.tree.map(
+            lambda p: jnp.asarray(
+                np.random.RandomState(step).normal(size=p.shape), p.dtype),
+            params_u)
+        up, sp = policy.update(grads, sp, params_p)
+        uu, su = plain.update(grads, su, params_u)
+        for a, b in zip(jax.tree.leaves(up), jax.tree.leaves(params_p)):
+            assert a.dtype == b.dtype, "updates must arrive at param width"
+        params_p = optax.apply_updates(params_p, up)
+        params_u = optax.apply_updates(params_u, uu)
+    for a, b in zip(jax.tree.leaves(params_p), jax.tree.leaves(params_u)):
+        np.testing.assert_allclose(np.asarray(a, np.float32),
+                                   np.asarray(b, np.float32),
+                                   rtol=2e-2, atol=2e-2)
+
+
+def test_state_storage_identity_when_off():
+    """state_dtype=None/'f32' is the identity wrapper — same state
+    dtypes, same trajectory object-for-object semantics."""
+    opt = hj.state_storage(optax.adam(1e-2), None)
+    params = _mixed_tree()
+    s = opt.init(params)
+    bufs = [l for l in jax.tree.leaves(s)
+            if hasattr(l, "dtype") and jnp.ndim(l) >= 1]
+    # No downcast: m/v mirror the param dtypes (f32 stays f32).
+    assert any(b.dtype == jnp.float32 for b in bufs)
+    assert hj.canonical_state_dtype("f32") is None
+    assert hj.canonical_state_dtype("bf16") == jnp.bfloat16
+
+
 def test_distributed_optimizer_fused_update_spmd(hvd):
     """fused_update=True inside the compiled SPMD step gives the same
     trajectory as the default path (the profile-driven fast path for

@@ -131,6 +131,30 @@ def test_perfwatch_cli_trend_and_check(tmp_path, capsys):
     assert pw.main(["--history", REPO]) == 0
     out = capsys.readouterr().out
     assert "r05" in out and "2938" in out
+    # The byte-diet delta column (HBM diet round 2): hbm_gb_per_step
+    # movement is visible next to the headline Δ%.
+    assert "hbmΔ%" in out
+
+
+def test_trend_table_hbm_delta_column():
+    """The hbm delta tracks the previous non-null hbm record — a byte
+    cut shows negative, a creep positive, nulls pass through as '-'."""
+    recs = [
+        {"label": "r1", "value": 2900.0, "hbm_gb_per_step": 7.8},
+        {"label": "r2", "value": 2920.0, "hbm_gb_per_step": None},
+        {"label": "r3", "value": 2950.0, "hbm_gb_per_step": 5.85},
+    ]
+    table = pw.trend_table(recs)
+    rows = table.splitlines()
+    assert "hbmΔ%" in rows[0]
+    r2 = next(r for r in rows if r.startswith("r2"))
+    assert r2.rstrip().endswith("-")
+    r3 = next(r for r in rows if r.startswith("r3"))
+    # 5.85 vs 7.8 = -25.0%
+    assert "-25.0" in r3
+
+
+def test_perfwatch_cli_gate(tmp_path, capsys):
     # A passing record file gates green...
     good = tmp_path / "good.json"
     good.write_text(json.dumps(

@@ -52,12 +52,12 @@ def _dyadic_grads(rank_rows, shape_tree, step):
 
 
 def _run_trajectory(make_opt, sharded, hvd, steps=4, compression=None,
-                    fused=False, donate=True):
+                    fused=False, donate=True, params=None):
     """Drive opt.update inside the compiled SPMD step with DISTINCT
     per-rank gradients (fed as rank-stacked arrays) and return the
     resulting params after ``steps`` updates."""
     n = hvd.size()
-    params = _params()
+    params = _params() if params is None else params
     kwargs = {"compression": compression} if compression else {}
     opt = hj.DistributedOptimizer(make_opt(), sharded_update=sharded,
                                   fused_update=fused, **kwargs)
@@ -215,6 +215,398 @@ def test_accumulation_skip_returns_cached_zero_tree(hvd):
                                rtol=1e-6)
 
 
+# ---------------------------------------------------------------------------
+# state_dtype='bf16' — bf16 resident state with f32 master shards
+# (HBM diet round 2, arxiv 2004.13336 §4)
+# ---------------------------------------------------------------------------
+
+
+def _bf16_params():
+    """The non-divisible tree (flat 33 -> padded 40 on 8 devices), cast
+    to the bf16 resident layout."""
+    return jax.tree_util.tree_map(lambda l: l.astype(jnp.bfloat16),
+                                  _params())
+
+
+def _bf16_rounded_f32_params():
+    """The SAME starting point as :func:`_bf16_params` at f32 width —
+    what the f32 oracle must start from for a fair trajectory comparison
+    (the linspace leaf is not bf16-exact, so the initial cast already
+    rounds; the masters derive from the *rounded* residents)."""
+    return jax.tree_util.tree_map(lambda l: l.astype(jnp.float32),
+                                  _bf16_params())
+
+
+def _run_mixed_trajectory(make_opt, hvd, steps=4):
+    """Drive the state_dtype='bf16' fused-sharded step with the SAME
+    per-rank dyadic gradients as :func:`_run_trajectory` and return
+    (resident params, final opt state)."""
+    n = hvd.size()
+    params = _bf16_params()
+    opt = hj.DistributedOptimizer(make_opt(), sharded_update=True,
+                                  state_dtype="bf16")
+    state = opt.init(params)
+    ospec = hj.sharded_state_specs(state)
+
+    @hj.jit(in_specs=(P(), ospec, P("hvd", None)),
+            out_specs=(P(), ospec), donate_argnums=(0, 1))
+    def step(p, s, gstack):
+        leaves = jax.tree_util.tree_leaves(p)
+        offs, out = 0, []
+        for l in leaves:
+            out.append(gstack[0, offs: offs + l.size].reshape(l.shape))
+            offs += l.size
+        g = jax.tree_util.tree_unflatten(
+            jax.tree_util.tree_structure(p), out)
+        u, s2 = opt.update(g, s, p)
+        return optax.apply_updates(p, u), s2
+
+    p, s = params, state
+    for t in range(steps):
+        _, rows = _dyadic_grads(n, _params(), t)
+        p, s = step(p, s, jnp.asarray(np.concatenate(rows, axis=1)))
+    return p, s
+
+
+def _masters_flat(state):
+    """The f32 master buffer, unpadded (flat 33 of the padded 40)."""
+    assert hj.has_master_shards(state)
+    buf = np.asarray(state["master"]["bfloat16"], dtype=np.float32)
+    return buf[:33]
+
+
+def _oracle_flat(params):
+    """The replicated-f32 oracle params, flattened in layout order."""
+    return np.concatenate([np.asarray(l, dtype=np.float32).ravel()
+                           for l in jax.tree_util.tree_leaves(params)])
+
+
+def test_bf16_state_sgd_masters_match_replicated_f32_bitwise(hvd):
+    """Shard-exact per 2004.13336 §4: the dyadic gradients are exactly
+    representable in bf16 and their 8-way sums fit bf16's significand,
+    so the bf16 reduce-scatter wire loses nothing — the f32 master
+    trajectory must match replicated-f32 SGD BITWISE. (Momentum-less:
+    a momentum trace is *stored* bf16 under the policy, so any stateful
+    transform picks up the designed storage rounding — covered by the
+    tolerance-bounded Adam test and the 1-ulp resident test below.)"""
+    mk = lambda: optax.sgd(0.5)
+    _, s = _run_mixed_trajectory(mk, hvd)
+    pr = _run_trajectory(mk, False, hvd,
+                         params=_bf16_rounded_f32_params())
+    np.testing.assert_array_equal(_masters_flat(s), _oracle_flat(pr))
+
+
+def test_bf16_state_adam_tracks_replicated(hvd):
+    """Adam under the policy stores m/v in bf16 between steps (the
+    rounding bf16 introduces) — tolerance-bounded against replicated
+    f32 Adam, not bitwise."""
+    mk = lambda: optax.adam(1e-2)
+    _, s = _run_mixed_trajectory(mk, hvd)
+    pr = _run_trajectory(mk, False, hvd,
+                         params=_bf16_rounded_f32_params())
+    np.testing.assert_allclose(_masters_flat(s), _oracle_flat(pr),
+                               rtol=1e-2, atol=1e-2)
+
+
+def test_bf16_residents_track_masters_within_one_ulp(hvd):
+    """Residents stay bf16 and sit within 1 bf16 ulp of cast(master):
+    the delta re-anchors on the actual resident values every step, so
+    the rounding never accumulates."""
+    p, s = _run_mixed_trajectory(lambda: optax.sgd(0.5, momentum=0.5),
+                                 hvd)
+    flat_res = np.concatenate(
+        [np.asarray(l, dtype=np.float32).ravel()
+         for l in jax.tree_util.tree_leaves(p)])
+    for l in jax.tree_util.tree_leaves(p):
+        assert l.dtype == jnp.bfloat16
+    master = _masters_flat(s)
+    cast = np.asarray(jnp.asarray(master).astype(jnp.bfloat16)
+                      .astype(jnp.float32))
+    # one bf16 ulp at the master's magnitude (eps = 2^-8 per mantissa
+    # step; x2 headroom for the double rounding of apply_updates)
+    tol = np.maximum(np.abs(master), 1e-3) * 2.0 ** -7
+    np.testing.assert_array_less(np.abs(flat_res - cast), tol + 1e-6)
+
+
+def test_bf16_state_layout_dtypes_and_specs(hvd):
+    """The mixed state layout: f32 masters + storage-dtype inner, every
+    padded buffer riding P('hvd'), scalar bookkeeping replicated."""
+    params = _bf16_params()
+    opt = hj.DistributedOptimizer(optax.adam(1e-3), sharded_update=True,
+                                  state_dtype="bf16")
+    state = opt.init(params)
+    assert hj.has_master_shards(state)
+    for b in state["master"].values():
+        assert b.dtype == jnp.float32 and b.shape[0] % hvd.size() == 0
+    # Adam's m/v buffers are *stored* bf16; the count scalar stays exact.
+    inner_bufs = [l for l in jax.tree_util.tree_leaves(state["inner"])
+                  if jnp.ndim(l) >= 1]
+    assert inner_bufs and all(b.dtype == jnp.bfloat16 for b in inner_bufs)
+    specs = hj.sharded_state_specs(state)
+    leaves = jax.tree_util.tree_leaves(state)
+    spec_leaves = jax.tree_util.tree_leaves(
+        specs, is_leaf=lambda x: isinstance(x, P))
+    assert len(leaves) == len(spec_leaves)
+    for leaf, spec in zip(leaves, spec_leaves):
+        assert spec == (P("hvd") if jnp.ndim(leaf) >= 1 else P())
+
+
+def test_bf16_state_requires_params_on_update(hvd):
+    """The resident-delta re-anchoring needs the resident values — an
+    update call without params must refuse loudly."""
+    params = _bf16_params()
+    opt = hj.shard_update(optax.sgd(0.1), state_dtype="bf16")
+    state = opt.init(params)
+    with pytest.raises(ValueError, match="needs params"):
+        opt.update(params, state)
+
+
+def test_bf16_state_rejects_unknown_spelling(hvd):
+    with pytest.raises(ValueError, match="state_dtype"):
+        hj.DistributedOptimizer(optax.sgd(0.1), state_dtype="int8")
+
+
+def test_state_dtype_f32_spellings_mean_off(hvd):
+    """'f32'/'float32'/None AND the dtype objects jnp.float32/np.float32
+    all disable the policy — config code that resolves dtype names to
+    objects must not crash on the 'explicitly off' spelling."""
+    for off in (None, "f32", "float32", jnp.float32, np.float32,
+                jnp.dtype("float32")):
+        assert hj.canonical_state_dtype(off) is None
+    assert hj.canonical_state_dtype(jnp.bfloat16) == jnp.bfloat16
+
+
+def test_bf16_state_update_honors_lr_scale(hvd):
+    """The reserved ``lr_scale`` extra arg scales the MASTER trajectory
+    (keras LR warmup/schedule wiring): under the mixed layout the
+    masters advance inside ``update`` and the return value is only a
+    re-anchored resident delta, so a caller-side ``updates * scale``
+    cannot work — the scale must ride into the epilogue. Plain SGD from
+    zero masters makes the check exact (f32 `0 + u` is `u` bitwise):
+    masters must move by exactly scale * (lr * grad)."""
+    params = jax.tree_util.tree_map(jnp.zeros_like, _bf16_params())
+    grads = jax.tree_util.tree_map(
+        lambda l: jnp.full(l.shape, 0.5, l.dtype), params)
+    opt = hj.shard_update(optax.sgd(0.1), average=False,
+                          state_dtype="bf16")
+
+    state = opt.init(params)
+    _, s_full = opt.update(grads, state, params)
+    state = opt.init(params)
+    upd_half, s_half = opt.update(grads, state, params,
+                                  lr_scale=jnp.float32(0.5))
+    m0 = _masters_flat(opt.init(params))
+    d_full = _masters_flat(s_full) - m0
+    d_half = _masters_flat(s_half) - m0
+    np.testing.assert_array_equal(d_half, 0.5 * d_full)
+    assert np.any(d_full != 0.0)
+
+    # lr_scale=0 freezes the trajectory: masters unchanged, resident
+    # delta all-zero (residents already sit at bf16(master)).
+    state = opt.init(params)
+    upd0, s0 = opt.update(grads, state, params, lr_scale=jnp.float32(0.0))
+    np.testing.assert_array_equal(_masters_flat(s0), m0)
+    for l in jax.tree_util.tree_leaves(upd0):
+        np.testing.assert_array_equal(np.asarray(l, np.float32), 0.0)
+
+
+def test_bf16_state_hlo_no_full_width_f32(hvd):
+    """The HLO pin for the fused epilogue (HBM diet round 2): at the
+    program (StableHLO) level every reduce-scatter/all-gather runs at
+    bf16 — the gradient round-trip between the collective and the update
+    never widens to f32 at full width — and the compiled per-device
+    entry carries NO full-width f32 buffer: masters and inner state
+    arrive as the f32[5] 1/N shard of the padded f32[40], residents as
+    bf16. (Full-buffer f32 ops inside the compiled text are XLA:CPU's
+    bf16-collective legalization, absent on TPU — the pin is the program
+    and the entry signature, as docs/benchmarks.md records.)"""
+    params = _bf16_params()
+    opt = hj.DistributedOptimizer(optax.sgd(0.1, momentum=0.9),
+                                  sharded_update=True, state_dtype="bf16")
+    state = opt.init(params)
+    ospec = hj.sharded_state_specs(state)
+
+    @hj.jit(in_specs=(P(), ospec, P()), out_specs=(P(), ospec))
+    def step(p, s, g):
+        u, s2 = opt.update(g, s, p)
+        return optax.apply_updates(p, u), s2
+
+    lowered = step.lower(params, state, params)
+    txt = lowered.as_text()
+    import re as _re
+
+    # The op's type signature closes its (possibly multi-line) region:
+    # `}) : (tensor<40xbf16>) -> tensor<5xbf16>` for the reduce-scatter,
+    # single-line `... : (tensor<5xbf16>) -> tensor<40xbf16>` for the
+    # all-gather.
+    sigs = _re.findall(
+        r'stablehlo\.(reduce_scatter|all_gather)"'
+        r'.*?:\s*\((tensor<[^)]*>)\)\s*->\s*(tensor<[^>]+>)',
+        txt, _re.S)
+    assert sigs, "expected collectives in the 8-device program"
+    assert {op for op, _, _ in sigs} == {"reduce_scatter", "all_gather"}
+    for op, operand, result in sigs:
+        assert "bf16" in operand and "bf16" in result, (op, operand,
+                                                        result)
+        assert "f32" not in operand and "f32" not in result, (
+            op, operand, result)
+    ctext = lowered.compile().as_text()
+    entry = next(ln for ln in ctext.splitlines() if "ENTRY" in ln)
+    assert "f32[40]" not in entry, entry   # no full-width f32 in/out
+    assert "f32[5]" in entry, entry        # the 1/N master shard
+    assert "bf16" in entry, entry          # bf16 residents
+
+
+def test_accumulation_skip_zero_tree_honors_state_dtype(hvd):
+    """A skipped microbatch under the policy must hand back zeros at the
+    policy dtype — not a full-width f32 tree — even when the incoming
+    grads are wider f32; the accumulators stay at the policy dtype too."""
+    params = {"w": jnp.ones((5,), jnp.bfloat16),
+              "b": jnp.zeros((), jnp.bfloat16)}
+    opt = hj.DistributedOptimizer(optax.sgd(0.1),
+                                  backward_passes_per_step=3,
+                                  state_dtype="bf16")
+    state = opt.init(params)
+    for l in jax.tree_util.tree_leaves(state["acc"]):
+        assert l.dtype == jnp.bfloat16
+    g32 = {"w": jnp.ones((5,), jnp.float32),
+           "b": jnp.ones((), jnp.float32)}
+    u1, state = opt.update(g32, state, params)
+    for l in jax.tree_util.tree_leaves(u1):
+        assert l.dtype == jnp.bfloat16, "skip zeros must be policy dtype"
+        np.testing.assert_array_equal(np.asarray(l, np.float32),
+                                      np.zeros(l.shape))
+    for l in jax.tree_util.tree_leaves(state["acc"]):
+        assert l.dtype == jnp.bfloat16, "acc must not promote to f32"
+    u2, state = opt.update(g32, state, params)
+    u3, state = opt.update(g32, state, params)
+    # Boundary update arrives at the param width with the accumulated
+    # gradient applied (3 microbatches of ones, averaged by count).
+    assert all(l.dtype == jnp.bfloat16
+               for l in jax.tree_util.tree_leaves(u3))
+    np.testing.assert_allclose(
+        np.asarray(u3["w"], np.float32), -0.1 * np.ones(5), rtol=1e-2)
+
+
+def test_accumulation_skip_tolerates_uncast_f32_params(hvd):
+    """A caller that ignores the 'cast residents first' precondition
+    (f32 params under a bf16 policy) must still get a working jitted
+    accumulation step: the skip branch's zeros follow the PARAM width —
+    matching the apply branch's state_storage cast — so lax.cond's
+    branch types agree (a policy-dtype zero tree here raised `true_fun
+    and false_fun output must have identical types` naming neither
+    state_dtype nor the missing cast). hvd.jit (not plain jax.jit, whose
+    axis-less trace collectives refuse by design) so count is a tracer
+    and the lax.cond path — not the eager concrete-count branch — is
+    what's exercised."""
+    params = {"w": jnp.ones((5,), jnp.float32)}
+    opt = hj.DistributedOptimizer(optax.sgd(0.1),
+                                  backward_passes_per_step=2,
+                                  state_dtype="bf16")
+    state = opt.init(params)
+    g = {"w": jnp.ones((5,), jnp.float32)}
+
+    @hj.jit(in_specs=(P(), P(), P()), out_specs=(P(), P()))
+    def step(g, state, params):
+        return opt.update(g, state, params)
+
+    u1, state = step(g, state, params)      # skip microbatch
+    assert u1["w"].dtype == jnp.float32     # param width, both branches
+    np.testing.assert_array_equal(np.asarray(u1["w"]), np.zeros(5))
+    u2, state = step(g, state, params)      # boundary
+    assert u2["w"].dtype == jnp.float32
+    np.testing.assert_allclose(np.asarray(u2["w"]), -0.1 * np.ones(5),
+                               rtol=1e-2)
+
+
+@pytest.mark.parametrize("grad_dtype", ["bfloat16", "float32"])
+def test_accumulation_skip_without_params_under_policy(hvd, grad_dtype):
+    """The standard optax convention — ``update(grads, state)`` with NO
+    params — must keep working under the policy with accumulation: the
+    apply branch's updates follow the width of the MEAN the inner update
+    sees (the policy-dtype accumulator; state_storage's grad-width rule,
+    since the f32-loaded momentum trace would otherwise promote them to
+    f32) and the skip branch's zeros key off the accumulator too, so
+    lax.cond's branch types agree — for policy-width AND for wider f32
+    grads (which ``acc_update`` casts back to the accumulator width)."""
+    params = {"w": jnp.ones((8,), jnp.bfloat16)}
+    opt = hj.DistributedOptimizer(optax.sgd(0.01, momentum=0.9),
+                                  fused_update=True, state_dtype="bf16",
+                                  backward_passes_per_step=2)
+    state = opt.init(params)
+    g = {"w": jnp.full((8,), 0.5, grad_dtype)}
+
+    @hj.jit(in_specs=(P(), P()), out_specs=(P(), P()))
+    def step(g, state):
+        return opt.update(g, state)
+
+    u1, state = step(g, state)              # skip microbatch
+    assert u1["w"].dtype == jnp.bfloat16    # accumulator width, both branches
+    np.testing.assert_array_equal(np.asarray(u1["w"], np.float32),
+                                  np.zeros(8))
+    u2, state = step(g, state)              # boundary
+    assert u2["w"].dtype == jnp.bfloat16
+    np.testing.assert_allclose(np.asarray(u2["w"], np.float32),
+                               -0.01 * 0.5 * np.ones(8), rtol=1e-2)
+
+
+def test_save_restore_step_equivalence_bf16_masters(hvd):
+    """The checkpoint contract at the optimizer level: persisting the
+    mixed state and rebuilding residents from the masters
+    (resident == cast(master) bitwise), then stepping, yields the SAME
+    master trajectory as the uninterrupted run — shard-exact for SGD
+    with dyadic gradients; residents agree within the 1-ulp re-anchor
+    band."""
+    mk = lambda: optax.sgd(0.5, momentum=0.5)
+    n = hvd.size()
+
+    def drive(p, s, opt, steps, t0=0):
+        ospec = hj.sharded_state_specs(s)
+
+        @hj.jit(in_specs=(P(), ospec, P("hvd", None)),
+                out_specs=(P(), ospec))
+        def step(p, s, gstack):
+            leaves = jax.tree_util.tree_leaves(p)
+            offs, out = 0, []
+            for l in leaves:
+                out.append(gstack[0, offs: offs + l.size].reshape(l.shape))
+                offs += l.size
+            g = jax.tree_util.tree_unflatten(
+                jax.tree_util.tree_structure(p), out)
+            u, s2 = opt.update(g, s, p)
+            return optax.apply_updates(p, u), s2
+
+        for t in range(t0, t0 + steps):
+            _, rows = _dyadic_grads(n, _params(), t)
+            p, s = step(p, s, jnp.asarray(np.concatenate(rows, axis=1)))
+        return p, s
+
+    opt = hj.DistributedOptimizer(mk(), sharded_update=True,
+                                  state_dtype="bf16")
+    params = _bf16_params()
+    state = opt.init(params)
+    # Uninterrupted: 4 steps straight through.
+    pa, sa = drive(params, state, opt, 4)
+    # Interrupted: 2 steps, "save" (device_get), restore residents from
+    # masters, 2 more steps.
+    pb, sb = drive(params, state, opt, 2)
+    saved = jax.device_get(sb)
+    restored_p = hj.resident_from_masters(saved, pb)
+    # Restore invariant: residents rebuilt BITWISE as cast(master).
+    for r, l in zip(jax.tree_util.tree_leaves(restored_p),
+                    jax.tree_util.tree_leaves(pb)):
+        assert r.dtype == jnp.bfloat16 and r.shape == l.shape
+    pc, sc = drive(jax.tree_util.tree_map(jnp.asarray, restored_p),
+                   jax.tree_util.tree_map(jnp.asarray, saved),
+                   opt, 2, t0=2)
+    np.testing.assert_array_equal(_masters_flat(sc), _masters_flat(sa))
+    for ka, kc in zip(jax.tree_util.tree_leaves(pa),
+                      jax.tree_util.tree_leaves(pc)):
+        np.testing.assert_allclose(
+            np.asarray(ka, np.float32), np.asarray(kc, np.float32),
+            rtol=2.0 ** -6)
+
+
 def test_world_size_one_elides_collectives(hvd):
     """A 1-rank world compiles the DistributedOptimizer step with NO
     all-reduce and NO pack/unpack concatenate — the r5 one-chip bench
@@ -249,6 +641,22 @@ f = hj.jit(step, in_specs=(P(), P(), P()), out_specs=(P(), P()))
 txt = f.lower(params, s, params).compile().as_text()
 assert "all-reduce" not in txt, "size-1 allreduce must be elided"
 assert "concatenate" not in txt, "size-1 grouped pack must be elided"
+# state_dtype='bf16' at world size 1: the mixed master/inner layout
+# still builds, and every collective (reduce-scatter, all-gather,
+# all-reduce) elides the same way.
+opt2 = hj.DistributedOptimizer(optax.sgd(0.1, momentum=0.9),
+                               sharded_update=True, state_dtype="bf16")
+p2 = {"a": jnp.ones((64, 64), jnp.bfloat16),
+      "b": jnp.ones((7,), jnp.bfloat16)}
+s2 = opt2.init(p2)
+assert isinstance(s2, dict) and set(s2) == {"master", "inner"}, s2
+def step2(p, s, g):
+    u, s3 = opt2.update(g, s, p)
+    return optax.apply_updates(p, u), s3
+f2 = hj.jit(step2, in_specs=(P(), P(), P()), out_specs=(P(), P()))
+txt2 = f2.lower(p2, s2, p2).compile().as_text()
+for op in ("all-reduce", "reduce-scatter", "all-gather"):
+    assert op not in txt2, op + " must be elided at world size 1"
 print("ELIDED-OK")
 """
     env = dict(os.environ)

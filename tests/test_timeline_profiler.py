@@ -283,6 +283,13 @@ def test_xplane_hbm_accounting_on_synthetic_capture(tmp_path):
     assert "conv+BN fusion" in report and "while" not in report
     assert "true HBM traffic" in report
     assert "per-op-class" in report
+    # Per-dtype columns in the human table, heaviest dtype first (f32
+    # carries 2*128*4 + 2*256*4 = 3072 B vs bf16's 2*8*128*2 = 4096 B
+    # -> bf16 leads).
+    header = next(ln for ln in report.splitlines()
+                  if ln.strip().startswith("class"))
+    assert "GB bf16" in header and "GB f32" in header
+    assert header.index("GB bf16") < header.index("GB f32")
 
     # Per-op-class attribution (collective vs optimizer vs conv/matmul
     # bytes): the table that makes a traffic regression attributable.
@@ -296,15 +303,27 @@ def test_xplane_hbm_accounting_on_synthetic_capture(tmp_path):
     assert classes["control"]["bytes"] == 0
     assert classes["control"]["ms"] == pytest.approx(10.0)
     assert classes["elementwise fusion"]["bytes"] == 0
+    # Per-dtype split inside each class (HBM diet round 2): the
+    # bf16-vs-f32 audit — fusion.7 streams bf16 in+out, the collective
+    # and the optimizer fusion are all-f32 here.
+    assert classes["conv/matmul"]["by_dtype"] == {"bf16": 2 * (8 * 128 * 2)}
+    assert classes["collective"]["by_dtype"] == {"f32": 2 * 128 * 4}
+    assert classes["optimizer"]["by_dtype"] == {"f32": 2 * 256 * 4}
+    assert classes["control"]["by_dtype"] == {}
     # steps divides evenly into per-step figures.
     half = xp.class_breakdown(logdir, steps=2)
     assert half["collective"]["bytes"] == 128 * 4
+    assert half["collective"]["by_dtype"] == {"f32": 128 * 4}
 
     # Machine-readable attribution (ISSUE 2 satellite): --json carries
     # the same numbers as the human table, and the stats CLI consumes a
     # capture dir through the same helper instead of re-parsing text.
     data = xp.hbm_json(logdir, steps=1)
     assert data["classes"]["collective"]["bytes"] == 2 * 128 * 4
+    # Capture-wide dtype totals ride the JSON (and perf.jsonl via the
+    # sentinel fold): sum of the per-class splits.
+    assert data["bytes_by_dtype_per_step"] == {
+        "bf16": 2 * (8 * 128 * 2), "f32": 2 * 128 * 4 + 2 * 256 * 4}
     assert data["dma_bytes"] == 256 * 4
     assert data["true_hbm_bytes_per_step"] == \
         data["dma_bytes"] + data["fusion_direct_bytes"]
@@ -332,6 +351,13 @@ def test_xplane_hbm_accounting_on_synthetic_capture(tmp_path):
                for s in env["samples"]}
     assert by_name[("xplane_dma_bytes", None)] == 256 * 4
     assert by_name[("xplane_class_bytes", "collective")] == 2 * 128 * 4
+    # The dtype split flattens into labeled samples too (the stats CLI's
+    # bf16-vs-f32 view of a capture).
+    by_dt = {(s["name"], s["labels"].get("class"), s["labels"].get("dtype")):
+             s["value"] for s in env["samples"]}
+    assert by_dt[("xplane_bytes_per_step", None, "bf16")] == 2 * (8 * 128 * 2)
+    assert by_dt[("xplane_class_dtype_bytes", "collective", "f32")] == \
+        2 * 128 * 4
 
     # Shape parsing corner cases.
     assert xp._first_shape_bytes("%x = pred[3]{0} y(pred[3] %a)") == 3
