@@ -25,6 +25,7 @@ docs/benchmarks.md:33-38) -> 103.55 images/sec per device.
 
 import argparse
 import json
+import os
 import sys
 import time
 
@@ -133,10 +134,18 @@ def main():
             "step_time_ms": None, "gflops_per_step": None, "mfu": None,
             "hbm_gb_per_step": None, "hbm_source": None,
             "membw_util": None, "spread_pct": None, "gate": None,
-            "state_dtype": None,
+            "state_dtype": None, "numerics": None,
             "dry": True,
         }))
         return
+
+    # Numerics observatory (core/numerics.py): default the in-step
+    # gradient-health policy OFF for the bench — the headline hot loop
+    # must compile to the identical HLO as the recorded BENCH_r* history
+    # (the off-policy pin in tests/test_numerics.py). setdefault: an
+    # operator explicitly exporting HVD_NUMERICS=warn|halt gets an
+    # instrumented (and honestly slower) run.
+    os.environ.setdefault("HVD_NUMERICS", "off")
 
     import jax
     import jax.numpy as jnp
@@ -471,7 +480,19 @@ def main():
         if per_chip else None,
         "gate": None,  # filled by --check below; present-but-null else
         "state_dtype": args.state_dtype,
+        "numerics": None,  # filled post-window below; null under --dry
     }
+    # Numerics summary (core/numerics.py): policy + anything the run
+    # observed (eager-path health, verdicts, consistency). Collected
+    # AFTER the timed windows like telemetry; with the default bench
+    # policy (off) it reports {"policy": "off", ...nulls} — the honest
+    # "nothing was watched" record.
+    try:
+        from horovod_tpu.core import numerics as _numerics
+
+        result["numerics"] = _numerics.compact()
+    except Exception as e:  # pragma: no cover - never fail the bench
+        print(f"# numerics summary unavailable: {e}", file=sys.stderr)
     # Unified telemetry (core/telemetry.py): eager-collective counts, the
     # startup broadcast, engine activity if any — read AFTER the timed
     # windows so collecting it can never perturb the headline. The hot

@@ -50,3 +50,8 @@ from horovod_tpu.core.telemetry import (  # noqa: F401
     telemetry,
     report as telemetry_report,
 )
+from horovod_tpu.core.numerics import (  # noqa: F401
+    NonfiniteError,
+    check_consistency,
+    report as numerics_report,
+)

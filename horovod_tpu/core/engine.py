@@ -33,6 +33,7 @@ from typing import Callable, Dict, Optional
 
 import numpy as np
 
+from horovod_tpu.core import numerics as numx
 from horovod_tpu.core import telemetry as tele
 from horovod_tpu.core import timeline as tl
 
@@ -74,12 +75,13 @@ class _Entry:
 
 
 class _Handle:
-    __slots__ = ("event", "result", "error")
+    __slots__ = ("event", "result", "error", "name")
 
-    def __init__(self):
+    def __init__(self, name: str = ""):
         self.event = threading.Event()
         self.result = None
         self.error: Optional[Exception] = None
+        self.name = name  # numerics attribution at synchronize
 
 
 class JaxExecutor:
@@ -384,13 +386,17 @@ class Engine:
                     f"a collective named '{entry.name}' is already pending; "
                     "names must be unique among in-flight tensors"
                 )
-            h = _Handle()
+            h = _Handle(entry.name)
             entry.handle = self._next_handle
             self._next_handle += 1
             self._handles[entry.handle] = h
             self._pending_names[entry.name] = entry
             depth = len(self._pending_names)
         record_submit(entry.op, entry.tensor.nbytes, depth)
+        # Numerics (core/numerics.py): the local nonfinite count of the
+        # SNAPSHOT is the attribution side of the synchronize-time check
+        # — a poisoned reduced result names the submitting process.
+        numx.engine_note_submit(entry.name, entry.tensor)
         self.timeline.start(entry.name, tl.QUEUE)
         self._queue.put(entry)
         self._wake.set()
@@ -437,6 +443,10 @@ class Engine:
             self._handles.pop(handle, None)
         if h.error is not None:
             raise h.error
+        # Numerics: a nonfinite reduced result fires the attributed
+        # `nonfinite` verdict (and raises under HVD_NUMERICS=halt) —
+        # same hook, counters and verdict shape as the native engine's.
+        numx.engine_check_result(h.name, h.result)
         return h.result
 
     # -- background loop (reference: RunLoopOnce, operations.cc:1921-2172) ----

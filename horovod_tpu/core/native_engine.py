@@ -21,7 +21,8 @@ from typing import List, Optional
 
 import numpy as np
 
-from horovod_tpu.core import native, telemetry as tele, timeline as tl
+from horovod_tpu.core import native, numerics as numx, telemetry as tele, \
+    timeline as tl
 from horovod_tpu.core.engine import (
     STALL_WARNING_TIME_S,
     DuplicateNameError,
@@ -276,7 +277,8 @@ class NativeEngine:
         if self._coordinator is None:
             self._lib.hvd_engine_set_sort_by_name(
                 self._ptr, int(_multi_controller()))
-        self._meta: dict = {}  # handle -> np.dtype (for result decode)
+        self._meta: dict = {}  # handle -> (np.dtype, name): result
+        # decode + numerics attribution at synchronize
 
         # Autotuner: the C++ loop reports per-cycle traffic through TICK
         # callbacks; tuned values land back via hvd_engine_set_params.
@@ -461,7 +463,11 @@ class NativeEngine:
             raise ShutdownError(msg)
         record_submit(op, tensor.nbytes,
                       int(self._lib.hvd_engine_pending(self._ptr)))
-        self._meta[h] = tensor.dtype
+        # Numerics (core/numerics.py): local nonfinite at submit is the
+        # attribution side of the synchronize-time check — identical
+        # counters/verdicts to the python engine's hook.
+        numx.engine_note_submit(name, tensor)
+        self._meta[h] = (tensor.dtype, name)
         return int(h)
 
     def allreduce_async(self, name: str, tensor: np.ndarray, average: bool,
@@ -492,7 +498,8 @@ class NativeEngine:
             shape8, err)
         if rc < 0:
             raise EngineError(f"unknown handle {handle}")
-        dtype = self._meta.pop(handle, np.dtype(np.float32))
+        dtype, name = self._meta.pop(handle,
+                                     (np.dtype(np.float32), ""))
         if rc == 1:
             self._lib.hvd_engine_drop(self._ptr, handle)
             msg = err.value.decode()
@@ -505,7 +512,11 @@ class NativeEngine:
         if rc != 0:
             raise EngineError("result copy failed")
         shape = tuple(shape8[i] for i in range(ndim.value))
-        return out.view(dtype).reshape(shape)
+        result = out.view(dtype).reshape(shape)
+        # Numerics: same synchronize-time check the python engine runs —
+        # identical counter names, verdict shape and halt behavior.
+        numx.engine_check_result(name, result)
+        return result
 
     def set_params(self, cycle_time_s: Optional[float] = None,
                    fusion_threshold: Optional[int] = None):

@@ -415,6 +415,10 @@ class AutoCapture:
             "membw_util": None,
             "mfu": None,
             "gflops_per_step": None,
+            # Latest host-visible training loss (Trainer epoch
+            # boundaries): the perfwatch trend table's convergence
+            # column. None when no loop reported one.
+            "final_loss": self._sentinel.last_loss,
             "error": active.get("error"),
         }
         times = active["step_times"]
@@ -514,6 +518,7 @@ class Sentinel:
         self.last_verdict: Optional[dict] = None
         self.last_step_wall: Optional[float] = None
         self.last_stall: Optional[dict] = None
+        self.last_loss: Optional[float] = None
         self._lock = threading.Lock()
         # One real training step can be observed through SEVERAL origins
         # (the keras Trainer's wall time wraps a jitted call that itself
@@ -574,6 +579,50 @@ class Sentinel:
                            "reason": str(reason).splitlines()[0][:300],
                            "rank": rank}
         tele.REGISTRY.counter("sentinel.stalls").inc()
+
+    def note_loss(self, loss):
+        """Latest host-visible training loss (the Trainer reports it at
+        epoch boundaries, where it is already a host float): auto-capture
+        perf.jsonl records carry it as ``final_loss`` so the perfwatch
+        trend table can show convergence next to throughput."""
+        try:
+            self.last_loss = float(loss)
+        except (TypeError, ValueError):
+            pass
+
+    def note_numerics(self, kind: str, info: dict) -> dict:
+        """A numerics verdict (``nonfinite`` / ``diverged`` — see
+        core/numerics.py): same dump + health machinery as the watchdog
+        verdicts, independent of ``HVD_WATCHDOG`` (a disabled step
+        watchdog must not silence numerics events). The flight dump
+        rides the existing rate-limit (``HVD_FLIGHT_MIN_INTERVAL``) and
+        retention cap; ``last_verdict`` recency degrades ``/healthz`` to
+        warn/503 exactly like a watchdog firing."""
+        verdict = {"origin": info.get("origin", "numerics"),
+                   "verdict": kind,
+                   "wall_us": int(time.time() * 1e6)}
+        verdict.update({k: v for k, v in info.items() if k != "origin"})
+        tele.REGISTRY.counter(f"sentinel.verdict.{kind}").inc()
+        events = self._flight_events()
+        last_ts = events[-1].get("ts") if events else None
+        events.append({"name": "NUMERICS_VERDICT", "ph": "i",
+                       "ts": (int(last_ts) + 1
+                              if isinstance(last_ts, (int, float))
+                              else 0),
+                       "args": {k: v for k, v in verdict.items()
+                                if k != "dump"}})
+        detail = (f"tensor {info['tensor']!r}" if info.get("tensor")
+                  else f"step {info.get('step')}")
+        who = info.get("ranks") or info.get("processes")
+        verdict["dump"] = tl.dump_and_warn(
+            events,
+            f"numerics: {kind} at {detail}"
+            + (f", bucket(s) {sorted(info['buckets'])}"
+               if info.get("buckets") else "")
+            + (f", rank(s)/process(es) {who}" if who else ""),
+            None, LOG)
+        self.last_verdict = verdict
+        return verdict
 
     def set_flops_per_step(self, flops: Optional[float]):
         """Tell the sentinel the compiled step's FLOP cost so capture
@@ -725,12 +774,24 @@ class Sentinel:
         if ewmas:
             stale_after = max(stale_after, 20.0 * max(ewmas))
         stale = age is not None and age > stale_after
-        if age is None:
+        # Verdict recency is checked BEFORE the no-step-yet "init" arm:
+        # a numerics verdict can fire from the engine path before any
+        # training step is observed (core/numerics.py), and /healthz
+        # must degrade on it regardless.
+        if recent_verdict or recent_stall:
+            status = "warn"
+        elif age is None:
             status = "init"
-        elif recent_verdict or recent_stall or stale:
+        elif stale:
             status = "warn"
         else:
             status = "ok"
+        try:
+            from horovod_tpu.core import numerics as _num
+
+            numerics = _num.summary()
+        except Exception:  # pragma: no cover - defensive
+            numerics = None
         return {
             "status": status,
             "rank": tl._process_index(),
@@ -742,6 +803,7 @@ class Sentinel:
             "watchdogs": {o: w.summary() for o, w in wds},
             "verdict": self.last_verdict,
             "stall": self.last_stall,
+            "numerics": numerics,
             "capture": self.capture.summary(),
         }
 
@@ -780,6 +842,26 @@ def note_stall(reason: str, rank: Optional[int] = None):
         get_sentinel().note_stall(reason, rank)
     except Exception:  # pragma: no cover - defensive
         pass
+
+
+def note_loss(loss):
+    """Module-level hook the Trainer calls with the latest host-visible
+    loss (epoch boundaries). Never raises."""
+    try:
+        get_sentinel().note_loss(loss)
+    except Exception:  # pragma: no cover - defensive
+        pass
+
+
+def note_numerics(kind: str, info: dict) -> dict:
+    """Module-level hook the numerics observatory calls. Unlike the
+    other module hooks this RETURNS the verdict (the caller attributes
+    and may raise under the halt policy) but still never raises
+    itself."""
+    try:
+        return get_sentinel().note_numerics(kind, info)
+    except Exception:  # pragma: no cover - defensive
+        return {"verdict": kind, "dump": None}
 
 
 def health() -> dict:

@@ -63,8 +63,10 @@ from horovod_tpu.jax.sharded import (  # noqa: F401
 )
 
 from horovod_tpu.common.compat import shard_map as _shard_map
+from horovod_tpu.core import numerics as _num
 from horovod_tpu.core import sentinel as _sentinel
 from horovod_tpu.core import telemetry as _tele
+from horovod_tpu.jax import numerics as _jnum
 
 try:
     from jax.experimental import sparse as _jsparse
@@ -320,12 +322,46 @@ def DistributedOptimizer(
             # still applies (m/v stored reduced, computed f32).
             optimizer = state_storage(optimizer, _sdt)
 
+        # In-step gradient health (core/numerics.py) is computed on the
+        # REDUCED gradients this closure already holds — but not under
+        # the accumulation wrapper: its lax.cond would trap the stashed
+        # tracers inside a branch (the Trainer falls back to local-grad
+        # health there).
+        in_acc = backward_passes_per_step > 1
+
         def update(grads, state, params=None, **kwargs):
+            pol = "off" if in_acc else _num.policy()
+            local = grads
             grads = allreduce_pytree(
                 grads, average=average, compression=compression,
                 sparse_as_dense=sparse_as_dense,
             )
-            return optimizer.update(grads, state, params, **kwargs)
+            if pol == "off":
+                return optimizer.update(grads, state, params, **kwargs)
+            leaves = _jax.tree_util.tree_leaves(grads)
+            ax = (_C.rank_axes()
+                  if leaves and _C.in_spmd(leaves[0]) else None)
+            # Reduced grads are already global (identical on every
+            # rank): their stats need no psum. NaN/Inf from ANY rank
+            # survives the reduction, so the nonfinite counts see it;
+            # the per-rank vector (pre-reduction local counts,
+            # all_gathered) names the offender.
+            stats = _jnum.tree_stats(grads)
+            per_rank = (_jnum.per_rank_nonfinite(local, ax)
+                        if ax is not None else None)
+            upd, new_state = optimizer.update(grads, state, params,
+                                              **kwargs)
+            if pol == "halt":
+                finite = _jnum.all_finite(stats)
+                upd = _jnum.guard_updates(finite, upd)
+                new_state = _jnum.guard_state(finite, new_state, state)
+            health = _jnum.health_of(stats, per_rank)
+            if leaves and _C.in_spmd(leaves[0]):
+                _jnum.stash_traced(health)
+            else:
+                _num.note_step_health(
+                    _jax.device_get(health), origin="eager")
+            return upd, new_state
 
     if backward_passes_per_step <= 1:
         return optax.GradientTransformationExtraArgs(optimizer.init, update)

@@ -132,7 +132,11 @@ def state_storage(optimizer: optax.GradientTransformation,
     NOTE: without a master copy the *parameter* apply still rounds to the
     param dtype — pair with :func:`horovod_tpu.jax.shard_update`'s
     ``state_dtype`` for f32 master shards (docs/troubleshooting.md
-    "bf16-state convergence drift")."""
+    "bf16-state convergence drift"). The numerics observatory watches
+    this masterless regime live: under ``HVD_NUMERICS`` the keras Trainer
+    feeds the ``numerics.update_ratio`` gauge (||update||/||params||) —
+    a sustained ratio below ~1 resident ulp means updates are being
+    rounded away (core/numerics.py, docs/observability.md "Numerics")."""
     dtype = canonical_state_dtype(state_dtype)
     if dtype is None:
         return optax.with_extra_args_support(optimizer)

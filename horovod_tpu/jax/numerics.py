@@ -1,0 +1,206 @@
+"""Traced (in-step) gradient-health instrumentation for the compiled
+hot path.
+
+The host-side numerics observatory (:mod:`horovod_tpu.core.numerics`)
+wants three things the compiled step already holds for free: the global
+gradient norm, per-dtype-bucket norms + nonfinite counts, and a per-rank
+nonfinite attribution vector. Computing them *inside* the existing
+shard_map step piggybacks on buffers that are already HBM-resident (the
+packed per-dtype gradient buckets of :mod:`horovod_tpu.jax.sharded` /
+the reduced gradient tree of ``DistributedOptimizer``), so the extra HBM
+traffic is a handful of scalar reductions — near zero against a
+gradient-sized step. With ``HVD_NUMERICS=off`` none of this code runs
+and the lowered HLO is pinned identical to the uninstrumented step
+(tests/test_numerics.py) — the bench headline path never pays for it.
+
+Mechanism: the optimizer wrappers (``DistributedOptimizer``,
+``shard_update``) compute the stats mid-trace and :func:`stash_traced`
+them; the keras Trainer's traced step body :func:`collect_traced`-s them
+right after ``opt.update`` — same trace, so the tracers are live — and
+returns them as device-resident step outputs the host fetches on the
+``HVD_NUMERICS_EVERY`` cadence (every step under ``halt``).
+
+Halt guard: under ``HVD_NUMERICS=halt`` the wrappers select the update
+away when the reduced gradient carries any nonfinite value — the skip
+updates are **negative zero** (``p + (-0.0) == p`` bitwise for every
+float p, including ``+0.0``/``-0.0``, where a ``+0.0`` skip would flip
+``-0.0`` params) and the optimizer state is re-selected to its input
+leaves, so a poisoned step provably mutates nothing. The predicate is a
+cross-replica psum (identical on every rank), so both sides of the
+select trace uniformly.
+"""
+
+from __future__ import annotations
+
+import threading
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+# One pending health dict per thread: the wrapper stashes during
+# opt.update tracing, the Trainer collects later in the SAME trace.
+# Uncollected stashes (a user loop that never collects) are simply
+# overwritten by the next trace.
+_slot = threading.local()
+
+
+def stash_traced(health: dict):
+    _slot.value = health
+
+
+def collect_traced():
+    """Pop the health dict the optimizer wrapper stashed during this
+    trace (None when the wrapper did not run / policy off)."""
+    out = getattr(_slot, "value", None)
+    _slot.value = None
+    return out
+
+
+def _count_nonfinite(x):
+    """Number of non-finite elements, int32 (0 for non-float leaves —
+    integer buffers cannot hold NaN/Inf)."""
+    if not jnp.issubdtype(jnp.result_type(x), jnp.floating):
+        return jnp.zeros((), jnp.int32)
+    return jnp.sum(~jnp.isfinite(x)).astype(jnp.int32)
+
+
+def _sumsq(x):
+    if not jnp.issubdtype(jnp.result_type(x), jnp.floating):
+        return jnp.zeros((), jnp.float32)
+    xf = x.astype(jnp.float32)
+    return jnp.sum(xf * xf)
+
+
+def bucket_stats(bufs: dict, ax=None) -> dict:
+    """Per-bucket ``{"sumsq", "nonfinite"}`` over a dict of flat buffers
+    (the per-dtype gradient buckets). With a bound rank axis ``ax`` the
+    stats are psum'd — for 1/N shards that IS the whole-buffer figure."""
+    out = {}
+    for k, v in bufs.items():
+        ss, nf = _sumsq(v), _count_nonfinite(v)
+        if ax is not None:
+            ss = lax.psum(ss, ax)
+            nf = lax.psum(nf, ax)
+        out[k] = {"sumsq": ss, "nonfinite": nf}
+    return out
+
+
+def tree_buckets(tree) -> dict:
+    """Group a pytree's leaves into per-dtype-name buckets (the same
+    bucketing rule the fused/sharded packers use — one bucket per
+    dtype), each a list of leaves."""
+    out: dict = {}
+    for leaf in jax.tree_util.tree_leaves(tree):
+        out.setdefault(jnp.result_type(leaf).name, []).append(leaf)
+    return out
+
+
+def tree_stats(tree, ax=None) -> dict:
+    """:func:`bucket_stats` over a pytree, bucketed per dtype name."""
+    out = {}
+    for k, leaves in tree_buckets(tree).items():
+        ss = sum((_sumsq(l) for l in leaves), jnp.zeros((), jnp.float32))
+        nf = sum((_count_nonfinite(l) for l in leaves),
+                 jnp.zeros((), jnp.int32))
+        if ax is not None:
+            ss = lax.psum(ss, ax)
+            nf = lax.psum(nf, ax)
+        out[k] = {"sumsq": ss, "nonfinite": nf}
+    return out
+
+
+def per_rank_nonfinite(local_tree_or_bufs, ax):
+    """(world,) vector of each rank's LOCAL nonfinite count (summed over
+    buckets) — the attribution signal: computed on the pre-reduction
+    local gradients, all_gathered so every rank can name the offender."""
+    leaves = jax.tree_util.tree_leaves(local_tree_or_bufs)
+    total = sum((_count_nonfinite(l) for l in leaves),
+                jnp.zeros((), jnp.int32))
+    return lax.all_gather(total, ax, axis=0, tiled=False)
+
+
+def health_of(stats: dict, per_rank=None) -> dict:
+    """Assemble the step-health dict from per-bucket stats: global grad
+    norm, per-bucket norms and nonfinite counts, the all-finite halt
+    predicate, and (when available) the per-rank attribution vector."""
+    total_ss = sum((v["sumsq"] for v in stats.values()),
+                   jnp.zeros((), jnp.float32))
+    total_nf = sum((v["nonfinite"] for v in stats.values()),
+                   jnp.zeros((), jnp.int32))
+    health = {
+        "grad_norm": jnp.sqrt(total_ss),
+        "nonfinite": total_nf,
+        "buckets": {k: {"norm": jnp.sqrt(v["sumsq"]),
+                        "nonfinite": v["nonfinite"]}
+                    for k, v in stats.items()},
+    }
+    if per_rank is not None:
+        health["per_rank_nonfinite"] = per_rank
+    return health
+
+
+def all_finite(stats: dict):
+    total_nf = sum((v["nonfinite"] for v in stats.values()),
+                   jnp.zeros((), jnp.int32))
+    return total_nf == 0
+
+
+def _neg_zero_like(u):
+    if jnp.issubdtype(jnp.result_type(u), jnp.floating):
+        return jnp.full_like(u, -0.0)
+    return jnp.zeros_like(u)
+
+
+def guard_updates(finite, updates):
+    """Halt-policy select: the skip branch emits NEGATIVE zero so
+    ``optax.apply_updates``'s ``p + u`` is a bitwise no-op for every
+    float param (``-0.0 + -0.0 == -0.0``; a ``+0.0`` skip would flip
+    ``-0.0`` params to ``+0.0``)."""
+    return jax.tree_util.tree_map(
+        lambda u: jnp.where(finite, u, _neg_zero_like(u)), updates)
+
+
+def guard_state(finite, new_state, old_state):
+    """Halt-policy select on the optimizer state: a poisoned step must
+    not advance momentum/masters either (NaN m/v would poison every
+    later step even after the gradients recover)."""
+    return jax.tree_util.tree_map(
+        lambda n, o: jnp.where(finite, n, o), new_state, old_state)
+
+
+def norm(tree):
+    """Global L2 norm of a pytree's float leaves (f32 accumulate)."""
+    ss = sum((_sumsq(l) for l in jax.tree_util.tree_leaves(tree)),
+             jnp.zeros((), jnp.float32))
+    return jnp.sqrt(ss)
+
+
+def _lex_bits(x):
+    """Map a float array to monotonically ordered UNSIGNED ints (the
+    standard IEEE total-order trick): ULP distance becomes integer
+    subtraction. Unsigned on purpose — exact at every magnitude without
+    x64 (a signed-int64 spelling would silently truncate to int32 on
+    the default CPU config and wrap for NaN↔finite distances)."""
+    bits = x.dtype.itemsize * 8
+    if bits not in (16, 32):
+        raise ValueError(
+            f"max_ulp supports 16/32-bit floats (the resident/master "
+            f"dtypes), got {x.dtype}")
+    ui = lax.bitcast_convert_type(
+        x, {16: jnp.uint16, 32: jnp.uint32}[bits])
+    sign = jnp.asarray(1 << (bits - 1), ui.dtype)
+    return jnp.where(ui & sign != 0, ~ui, ui | sign)
+
+
+def max_ulp(a, b):
+    """Max ULP distance between two same-dtype float arrays (0 when
+    bitwise equal; NaN anywhere reads as a huge distance — it IS).
+    ``max(lex) - min(lex)`` per element keeps the subtraction inside the
+    unsigned range: exact everywhere, no abs-of-wrapped-difference."""
+    if a.dtype != b.dtype:
+        raise ValueError(f"dtype mismatch: {a.dtype} vs {b.dtype}")
+    la, lb = _lex_bits(a), _lex_bits(b)
+    if la.size == 0:
+        return jnp.zeros((), la.dtype)
+    return jnp.max(jnp.maximum(la, lb) - jnp.minimum(la, lb))

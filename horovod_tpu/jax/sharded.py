@@ -57,6 +57,8 @@ from jax.sharding import PartitionSpec as P
 
 from horovod_tpu.common import topology as _topo
 from horovod_tpu.common.topology import HVD_AXIS
+from horovod_tpu.core import numerics as _num
+from horovod_tpu.jax import numerics as _jnum
 from horovod_tpu.jax.compression import Compression
 from horovod_tpu.jax.fused import (
     _layout_of,
@@ -207,7 +209,22 @@ def shard_update(
                  else _pack_padded(params, layout, world))
 
         leaf0 = next(iter(gbufs.values()))
-        ax = _C.rank_axes() if _C.in_spmd(leaf0) else None
+        traced = _C.in_spmd(leaf0)
+        ax = _C.rank_axes() if traced else None
+        # In-step gradient health (core/numerics.py): computed on the
+        # per-dtype buffers already resident for the scatter — a few
+        # scalar reductions of extra HBM traffic. With the policy off
+        # this block lowers nothing (HLO pinned identical).
+        pol = _num.policy()
+
+        def _observe(stats, per_rank=None):
+            health = _jnum.health_of(stats, per_rank)
+            if traced:
+                _jnum.stash_traced(health)
+            else:
+                _num.note_step_health(jax.device_get(health),
+                                      origin="eager")
+
         if (ax is None and world == 1) or (
                 ax is not None and lax.psum(1, ax) == 1):
             # Degenerate 1-rank world: scatter and gather are identity
@@ -215,16 +232,25 @@ def shard_update(
             # round trip). What remains is whole-tree packing — fuse()
             # semantics, a measured NEGATIVE on one chip (module
             # docstring); kept so the flag is runnable anywhere.
+            stats = (_jnum.bucket_stats(gbufs) if pol != "off" else None)
             if sdt is not None:
                 g32 = {k: v.astype(jnp.float32) for k, v in gbufs.items()}
                 ures, new_state = _master_step(g32, state, pbufs,
                                                extra_args)
-                return _unpack_padded(ures, layout), new_state
-            ufull, new_state = optimizer.update(
-                {"buf": gbufs, "big": []}, state,
-                None if pbufs is None else {"buf": pbufs, "big": []},
-                **extra_args)
-            return _unpack_padded(ufull["buf"], layout), new_state
+            else:
+                ufull, new_state = optimizer.update(
+                    {"buf": gbufs, "big": []}, state,
+                    None if pbufs is None else {"buf": pbufs, "big": []},
+                    **extra_args)
+                ures = ufull["buf"]
+            if stats is not None:
+                if pol == "halt":
+                    finite = _jnum.all_finite(stats)
+                    ures = _jnum.guard_updates(finite, ures)
+                    new_state = _jnum.guard_state(finite, new_state,
+                                                  state)
+                _observe(stats)
+            return _unpack_padded(ures, layout), new_state
         if ax is not None:
             # --- compiled SPMD path: scatter, update 1/N, gather -------
             n_axis = lax.psum(1, ax)  # static axis size
@@ -248,6 +274,11 @@ def shard_update(
                 return shard
 
             gshard = {k: scatter(v) for k, v in gbufs.items()}
+            # Health on the REDUCED 1/N shards (psum'd = whole-buffer
+            # figures; NaN from any rank survives the reduction) plus
+            # the pre-scatter local counts for per-rank attribution.
+            stats = (_jnum.bucket_stats(gshard, ax=ax)
+                     if pol != "off" else None)
             pshard = None if pbufs is None else {
                 k: lax.dynamic_slice(
                     v, (idx * (v.shape[0] // n_axis),),
@@ -258,15 +289,22 @@ def shard_update(
                 # never None here.
                 ures, new_state = _master_step(gshard, state, pshard,
                                                extra_args)
-                ubufs = {k: lax.all_gather(v, ax, axis=0, tiled=True)
-                         for k, v in ures.items()}
-                return _unpack_padded(ubufs, layout), new_state
-            ushard, new_state = optimizer.update(
-                {"buf": gshard, "big": []}, state,
-                None if pshard is None else {"buf": pshard, "big": []},
-                **extra_args)
+            else:
+                ushard, new_state = optimizer.update(
+                    {"buf": gshard, "big": []}, state,
+                    None if pshard is None else {"buf": pshard,
+                                                 "big": []},
+                    **extra_args)
+                ures = ushard["buf"]
+            if stats is not None:
+                if pol == "halt":
+                    finite = _jnum.all_finite(stats)
+                    ures = _jnum.guard_updates(finite, ures)
+                    new_state = _jnum.guard_state(finite, new_state,
+                                                  state)
+                _observe(stats, _jnum.per_rank_nonfinite(gbufs, ax))
             ubufs = {k: lax.all_gather(v, ax, axis=0, tiled=True)
-                     for k, v in ushard["buf"].items()}
+                     for k, v in ures.items()}
             return _unpack_padded(ubufs, layout), new_state
 
         # --- eager path: allreduce + full-buffer update ---------------
@@ -285,14 +323,22 @@ def shard_update(
             return out
 
         gfull = {k: reduce_full(v) for k, v in gbufs.items()}
+        stats = _jnum.bucket_stats(gfull) if pol != "off" else None
         if sdt is not None:
             ures, new_state = _master_step(gfull, state, pbufs, extra_args)
-            return _unpack_padded(ures, layout), new_state
-        ufull, new_state = optimizer.update(
-            {"buf": gfull, "big": []}, state,
-            None if pbufs is None else {"buf": pbufs, "big": []},
-            **extra_args)
-        return _unpack_padded(ufull["buf"], layout), new_state
+        else:
+            ufull, new_state = optimizer.update(
+                {"buf": gfull, "big": []}, state,
+                None if pbufs is None else {"buf": pbufs, "big": []},
+                **extra_args)
+            ures = ufull["buf"]
+        if stats is not None:
+            if pol == "halt":
+                finite = _jnum.all_finite(stats)
+                ures = _jnum.guard_updates(finite, ures)
+                new_state = _jnum.guard_state(finite, new_state, state)
+            _observe(stats)
+        return _unpack_padded(ures, layout), new_state
 
     return optax.GradientTransformationExtraArgs(init, update)
 
@@ -322,6 +368,64 @@ def resident_from_masters(opt_state, params_like):
     bufs = {k: jnp.asarray(v).astype(k)
             for k, v in opt_state["master"].items()}
     return _unpack({"buf": bufs, "big": []}, layout)
+
+
+#: Magnitude floor for the drift unit: below this |master| the absolute
+#: re-anchor error stays bounded while the RAW ulp spacing shrinks
+#: without limit, so ulps-at-the-value would read noisy-large for
+#: healthy near-zero weights (the same floor the equivalence tests pin).
+DRIFT_MAG_FLOOR = 1e-3
+
+
+def drift_ulp(opt_state, params) -> dict:
+    """Master↔resident divergence per dtype bucket, as the max distance
+    between ``cast(master)`` and the resident parameters measured in
+    **ulps at the master's magnitude** (``max(|master|, 1e-3) × eps``)
+    — the automated form of the docs/troubleshooting.md "bf16-state
+    convergence drift" ladder's manual audit, in the same unit the
+    equivalence suite pins. The re-anchored :func:`shard_update` path
+    keeps this at stable single digits by construction — 0 right after
+    init/restore, ~1-2 in steady state, transiently higher only when a
+    step's own update is large against a small weight (the re-anchor
+    error is bounded by one rounding of the step's delta, never by
+    history) — so a GROWING gauge means the policy is not applied where
+    you think (or a caller mutated residents outside the update).
+    Raw ulp distance at the value itself would be the wrong unit: near
+    zero the spacing shrinks without limit and a healthy re-anchor
+    rounds to tens of value-ulps while staying absolutely tiny.
+
+    Host-side and periodic (the Trainer calls it every
+    ``HVD_NUMERICS_EVERY`` steps under the numerics policy): the master
+    shards are globalized with :func:`~horovod_tpu.ops.collectives.fetch`
+    — in a multi-controller world this is a collective, call it in
+    lockstep on every process."""
+    import numpy as np
+
+    if not has_master_shards(opt_state):
+        raise ValueError("opt_state carries no master shards (was the "
+                         "optimizer built with state_dtype=...?)")
+    world = _world()
+    layout = _layout_of(params, _PACK_ALL)
+    packed = _pack(params, layout)
+    out = {}
+    for k, master in opt_state["master"].items():
+        res = jnp.asarray(_C.fetch(_C._pad_dim0(packed["buf"][k], world)))
+        m64 = np.asarray(_C.fetch(master), np.float64)
+        cast64 = np.asarray(jnp.asarray(_C.fetch(master))
+                            .astype(res.dtype), np.float64)
+        res64 = np.asarray(res, np.float64)
+        eps = float(jnp.finfo(res.dtype).eps)
+        band = np.maximum(np.abs(m64), DRIFT_MAG_FLOOR) * eps
+        if not res64.size:
+            out[k] = 0
+            continue
+        with np.errstate(invalid="ignore"):
+            mx = float(np.max(np.abs(res64 - cast64) / band))
+        # NaN/Inf anywhere (a poisoned step the warn policy let through)
+        # IS infinite divergence: report a huge finite gauge value
+        # instead of crashing the fit loop mid-observation.
+        out[k] = int(np.ceil(mx)) if np.isfinite(mx) else (1 << 62)
+    return out
 
 
 def sharded_state_specs(opt_state, axis: str = HVD_AXIS):

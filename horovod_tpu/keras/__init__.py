@@ -25,7 +25,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 from horovod_tpu.common.topology import (  # noqa: F401
     HorovodInternalError, init, shutdown, is_initialized, size, rank,
-    local_size, local_rank, cross_size, cross_rank, mesh,
+    local_size, local_rank, cross_size, cross_rank, mesh, num_processes,
 )
 from horovod_tpu.jax import (
     DistributedOptimizer,  # noqa: F401 — same wrapper (reference binds P9 to keras)
@@ -39,9 +39,16 @@ from horovod_tpu.jax import (
     state_storage as _state_storage,
 )
 from horovod_tpu.jax import allreduce as _allreduce
+from horovod_tpu.jax import numerics as _jnumerics
+from horovod_tpu.jax.sharded import (
+    drift_ulp as _drift_ulp,
+    has_master_shards as _has_master_shards,
+)
+from horovod_tpu.core import numerics as _numerics
 from horovod_tpu.core import sentinel as _sentinel
 from horovod_tpu.core import telemetry as _tele
 from horovod_tpu.keras import callbacks  # noqa: F401
+from horovod_tpu.ops import collectives as _ops
 from horovod_tpu.ops.collectives import HVD_AXIS
 from horovod_tpu.utils import checkpoint as _ckpt
 
@@ -180,6 +187,7 @@ class Trainer:
         self._train_step = None
         self._eval_step = None
         self._epoch = 0
+        self._gstep = 0  # global step counter (numerics attribution)
 
     # -- state ---------------------------------------------------------------
 
@@ -223,6 +231,48 @@ class Trainer:
         self.opt_state = broadcast_pytree(opt_state, root_rank)
         jax.block_until_ready((self.params, self.batch_stats,
                                self.opt_state))
+        # Consistency anchor (core/numerics.py): right after the sync
+        # broadcast every process MUST digest identically — an eager
+        # drain point, so the allgather is safe, and a mismatch here is
+        # attributed before training compounds it.
+        if _numerics.enabled() and num_processes() > 1:
+            self.check_consistency(tag="broadcast_state")
+
+    def check_consistency(self, tag: str = "params"):
+        """Cross-rank state-consistency digest (core/numerics.py): every
+        process digests its parameter/batch-stats buckets (crc32 + sum +
+        nonfinite count per dtype), the digests are allgathered, and a
+        mismatch yields an attributed ``diverged`` verdict + flight dump
+        on EVERY process naming the deviating rank and bucket. A
+        collective — call in lockstep on every process (fit calls it at
+        epoch boundaries and after :meth:`broadcast_state`)."""
+        return _numerics.check_consistency(
+            {"params": self.params, "batch_stats": self.batch_stats},
+            tag=tag, step=self._gstep)
+
+    def _note_numerics(self, health):
+        """Per-step host intake on the HVD_NUMERICS_EVERY cadence (every
+        step under halt — a delayed check could not raise before the
+        next poisoned update). The device_get is the only forced fetch
+        the numerics layer adds to the loop, and only on checked
+        steps."""
+        pol = _numerics.policy()
+        if pol == "off":
+            return
+        every = _numerics.check_every()
+        if pol == "halt" or self._gstep % every == 0:
+            _numerics.note_step_health(jax.device_get(health),
+                                       step=self._gstep,
+                                       origin="trainer")
+        if (self._gstep % every == 0
+                and _has_master_shards(self.opt_state)):
+            # bf16 drift gauge: master↔resident max ULP per bucket (the
+            # automated troubleshooting-ladder audit). Globalizing the
+            # master shards is a collective in multi-controller worlds —
+            # the step cadence is lockstep across processes.
+            _numerics.note_drift(
+                _drift_ulp(self.opt_state, self.params),
+                step=self._gstep)
 
     def set_lr_scale(self, scale: float, momentum_correction: bool = False):
         """Scale the effective learning rate (callbacks drive this). With
@@ -286,6 +336,14 @@ class Trainer:
         scale_inside = (self._state_dtype is not None
                         and self._sharded_update)
 
+        # Numerics observatory (core/numerics.py): the optimizer wrapper
+        # computes in-step gradient health and stashes it mid-trace;
+        # collect it HERE (same trace) and return it device-resident in
+        # the logs — the host fetches on the HVD_NUMERICS_EVERY cadence.
+        # Read at build time: the compiled program either carries the
+        # stats or (policy off) lowers to the identical pre-numerics HLO.
+        num_on = _numerics.enabled()
+
         @_hvd_jit(in_specs=(P(), P(), ospec, P(HVD_AXIS), P(HVD_AXIS), P(),
                             P()),
                   out_specs=(P(), P(), ospec, P()),
@@ -295,6 +353,7 @@ class Trainer:
             (loss, (logits, new_bs)), grads = jax.value_and_grad(
                 forward, has_aux=True)(params, batch_stats, x, y, True,
                                        dropout_key)
+            prev_state = opt_state
             if scale_inside:
                 updates, opt_state = opt.update(grads, opt_state, params,
                                                 lr_scale=lr_scale)
@@ -302,8 +361,38 @@ class Trainer:
                 updates, opt_state = opt.update(grads, opt_state, params)
                 updates = jax.tree_util.tree_map(lambda u: u * lr_scale,
                                                  updates)
-            params = optax.apply_updates(params, updates)
-            return params, new_bs, opt_state, metrics_of(loss, logits, y)
+            logs = metrics_of(loss, logits, y)
+            if num_on:
+                health = _jnumerics.collect_traced()
+                if health is None:
+                    # Fallback (the optimizer wrapper did not run — e.g.
+                    # distributed=False): gradient health straight from
+                    # the local grads, psum'd over the rank axis when one
+                    # is bound so a NaN on ANY rank is seen identically
+                    # everywhere (host-side reads of a replicated output
+                    # only ever see device 0's tile). Under halt the
+                    # guard must run HERE — the wrapper's guard did not.
+                    ax = (_ops.rank_axes()
+                          if _ops.in_spmd(loss) else None)
+                    stats = _jnumerics.tree_stats(grads, ax=ax)
+                    per_rank = (_jnumerics.per_rank_nonfinite(grads, ax)
+                                if ax is not None else None)
+                    health = _jnumerics.health_of(stats, per_rank)
+                    if _numerics.policy() == "halt":
+                        finite = _jnumerics.all_finite(stats)
+                        updates = _jnumerics.guard_updates(finite,
+                                                           updates)
+                        opt_state = _jnumerics.guard_state(
+                            finite, opt_state, prev_state)
+                new_params = optax.apply_updates(params, updates)
+                # Masterless-drift gauge input (fused.state_storage
+                # caveat): update/param norm ratio per step.
+                health["update_norm"] = _jnumerics.norm(updates)
+                health["param_norm"] = _jnumerics.norm(new_params)
+                logs["_numerics"] = health
+            else:
+                new_params = optax.apply_updates(params, updates)
+            return new_params, new_bs, opt_state, logs
 
         @_hvd_jit(in_specs=(P(), P(), P(HVD_AXIS), P(HVD_AXIS)),
                   out_specs=P())
@@ -402,6 +491,14 @@ class Trainer:
                 # together with the device-resident logs below, measured
                 # 2.1x on the tunneled chip, docs/benchmarks.md).
                 nxt = next(batches, None)
+                # Numerics: pop the device-resident health dict BEFORE
+                # the logs proxy (callbacks must not see — or float() —
+                # the per-rank vector); checked on the numerics cadence.
+                self._gstep += 1
+                health = (logs.pop("_numerics", None)
+                          if isinstance(logs, dict) else None)
+                if health is not None:
+                    self._note_numerics(health)
                 # Batch logs stay device-resident (fetching every batch
                 # costs a full host round trip); the proxy converts any
                 # value a callback actually reads to a Python float at
@@ -414,6 +511,14 @@ class Trainer:
             # Epoch logs come from the last batch's view INCLUDING any
             # callback writes (plain-dict behavior before _LazyLogs).
             logs = lazy.copy()
+            # Epoch boundary = eager drain point: report the (already
+            # host-visible) loss to the sentinel for perf.jsonl's
+            # final_loss column, and run the cross-rank consistency
+            # digest when there is more than one controller to diverge.
+            if "loss" in logs:
+                _sentinel.note_loss(logs["loss"])
+            if _numerics.enabled() and num_processes() > 1:
+                self.check_consistency(tag="epoch_end")
             if validation_data is not None:
                 val = self.evaluate(*validation_data, batch_size=batch_size)
                 logs.update({f"val_{k}": v for k, v in val.items()})

@@ -40,6 +40,7 @@ from horovod_tpu.common import topology as _topo
 from horovod_tpu.common.topology import HVD_AXIS
 
 from horovod_tpu.common.compat import shard_map as _shard_map
+from horovod_tpu.core import numerics as _num
 from horovod_tpu.core import telemetry as _tele
 
 
@@ -399,8 +400,22 @@ def _nbytes(tensor) -> int:
 def _record_eager(op: str, tensor, elided: bool = False):
     """Feed the telemetry registry for one eager collective. The compiled
     (SPMD) path deliberately records nothing here — tracing happens once,
-    and its cost story lives in the xplane capture instead."""
+    and its cost story lives in the xplane capture instead.
+
+    Under the numerics policy (core/numerics.py) a HOST-resident eager
+    input is also scanned for nonfinite values — eager collectives are
+    control-plane traffic (metric averaging, state broadcasts), exactly
+    where a NaN silently spreads to every rank. Device-resident inputs
+    are deliberately NOT scanned: np.asarray on them would force a
+    blocking device→host fetch per tensor inside the drain window
+    CLAUDE.md flags as rendezvous-sensitive (the compiled-path health
+    and the engine submit hooks cover those buffers without extra
+    transfers). Counter only, no verdict: the collective itself may be
+    the legitimate carrier (a broadcast of a diverged peer's state for
+    inspection), and MetricAverage has its own masking."""
     _tele.record_eager(op, _nbytes(tensor), elided=elided)
+    if _num.enabled() and isinstance(tensor, np.ndarray):
+        _num.note_eager_nonfinite(op, _num.np_nonfinite(tensor))
 
 
 def _localize(x):

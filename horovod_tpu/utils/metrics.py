@@ -12,10 +12,15 @@ family so "how much of my eager traffic is metrics" is answerable.
 
 from __future__ import annotations
 
+import logging
+import math
+
 import jax.numpy as jnp
 
 from horovod_tpu.core import telemetry as _tele
 from horovod_tpu.ops import collectives as _C
+
+LOG = logging.getLogger("horovod_tpu.metrics")
 
 
 class Metric:
@@ -61,12 +66,38 @@ class Metric:
 def MetricAverage(values: dict) -> dict:
     """Allreduce-average a dict of scalars across ranks in one fused
     collective (reference: _keras/callbacks.py:52-67 does it one allreduce
-    per metric)."""
+    per metric).
+
+    Nonfinite contributions are EXCLUDED instead of silently poisoning
+    the cross-rank average (one rank's NaN loss used to NaN the metric
+    on every rank): each rank ships ``(masked value, finite flag)`` in
+    the same single collective and the average divides by the finite
+    count — flagged by the ``metrics.nonfinite_skipped`` counter and one
+    warning naming the keys. A key nonfinite on EVERY rank has no finite
+    contribution and stays NaN (there is no honest number to report).
+    The masking is shape-uniform across ranks, so a rank-local NaN can
+    never desynchronize the fused collective."""
     if not values:
         return {}
     keys = sorted(values)
     _tele.REGISTRY.counter("metrics.averages").inc()
     _tele.REGISTRY.counter("metrics.averaged_values").inc(len(keys))
-    stacked = jnp.asarray([float(values[k]) for k in keys], jnp.float32)
-    avg = _C.allreduce(stacked, average=True)
-    return {k: float(avg[i]) for i, k in enumerate(keys)}
+    local = [float(values[k]) for k in keys]
+    bad = [k for k, v in zip(keys, local) if not math.isfinite(v)]
+    if bad:
+        _tele.REGISTRY.counter("metrics.nonfinite_skipped").inc(len(bad))
+        LOG.warning(
+            "MetricAverage: nonfinite local value(s) for %s excluded "
+            "from the cross-rank average", bad)
+    # Row 0: values with nonfinite entries zeroed; row 1: finite flags.
+    # One fused SUM collective carries both, every rank contributes the
+    # same shape regardless of where the NaN is.
+    masked = [v if math.isfinite(v) else 0.0 for v in local]
+    flags = [1.0 if math.isfinite(v) else 0.0 for v in local]
+    stacked = jnp.asarray([masked, flags], jnp.float32)
+    summed = _C.allreduce(stacked, average=False)
+    out = {}
+    for i, k in enumerate(keys):
+        n = float(summed[1, i])
+        out[k] = float(summed[0, i]) / n if n > 0 else float("nan")
+    return out

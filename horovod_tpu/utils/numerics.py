@@ -1,0 +1,170 @@
+"""Numerics CLI — the focused query tool over the numerics observatory.
+
+    python -m horovod_tpu.utils.numerics <target> [--json]
+
+``target`` is one of:
+
+- a Prometheus-style exposition file written by ``HVD_TELEMETRY_FILE``
+  — the ``hvd_numerics_*`` / ``hvd_sentinel_verdict_*`` family is
+  filtered out and rendered (the general-purpose view of everything
+  else is ``python -m horovod_tpu.utils.stats``);
+- an ``http://host:port`` endpoint (``HVD_TELEMETRY_PORT``) — the same
+  metric filter over ``/metrics``, PLUS the ``/healthz`` numerics
+  section (policy, live verdicts, drift, consistency) which only the
+  live process can serve;
+- ``live`` — :func:`horovod_tpu.core.numerics.report` of the current
+  process (code/REPL use).
+
+``--json`` keeps the ``utils.stats`` envelope shape for file targets
+(``{"source", "target", "samples"}``) and emits the structured health/
+report document for http/live targets — the machine-readable form of
+what the table shows.
+
+Exit codes: 0 healthy/no-data, 1 usage/IO error, 3 when a ``nonfinite``
+or ``diverged`` verdict is visible in the target (scriptable: a CI
+convergence job can fail on numerics trouble without parsing tables).
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+from typing import Dict, List, Tuple
+
+from horovod_tpu.utils.stats import (
+    _envelope,
+    _is_http,
+    fetch_http,
+    parse_prometheus,
+    render,
+)
+
+#: Exposition-name prefixes that belong to the numerics observatory.
+_PREFIXES = ("hvd_numerics_", "hvd_sentinel_verdict_nonfinite",
+             "hvd_sentinel_verdict_diverged",
+             "hvd_metrics_nonfinite_skipped")
+
+
+def numerics_samples(samples: List[Tuple[str, Dict[str, str], float]]
+                     ) -> List[Tuple[str, Dict[str, str], float]]:
+    return [s for s in samples if s[0].startswith(_PREFIXES)]
+
+
+def _verdict_visible(samples, health: dict = None) -> bool:
+    """True when the target shows a nonfinite/diverged event (the exit-3
+    signal)."""
+    for name, _, value in samples:
+        if value and name.startswith(("hvd_numerics_nonfinite_events",
+                                      "hvd_numerics_diverged_events",
+                                      "hvd_sentinel_verdict_nonfinite",
+                                      "hvd_sentinel_verdict_diverged")):
+            return True
+    if health:
+        num = health.get("numerics") or {}
+        if num.get("verdicts"):
+            return True
+        v = (health.get("verdict") or {}).get("verdict")
+        if v in ("nonfinite", "diverged"):
+            return True
+    return False
+
+
+def _render_health(health: dict) -> str:
+    num = health.get("numerics") or {}
+    lines = [f"policy      {num.get('policy', '?')}",
+             f"status      {health.get('status', '?')} "
+             f"(rank {health.get('rank')})"]
+    if num.get("verdicts"):
+        lines.append(f"verdicts    {', '.join(num['verdicts'])}")
+    drift = num.get("drift")
+    if drift:
+        ulp = " ".join(f"{k}={v}" for k, v in
+                       sorted((drift.get("ulp") or {}).items()))
+        lines.append(f"drift_ulp   {ulp} (step {drift.get('step')})")
+    if num.get("consistency_ok") is not None:
+        lines.append(f"consistency {'ok' if num['consistency_ok'] else 'DIVERGED'}")
+    v = health.get("verdict")
+    if v and v.get("verdict") in ("nonfinite", "diverged"):
+        who = v.get("ranks") or v.get("processes")
+        lines.append(
+            f"last        {v['verdict']} at step {v.get('step')}"
+            + (f", bucket(s) {sorted(v['buckets'])}"
+               if v.get("buckets") else "")
+            + (f", rank(s) {who}" if who else "")
+            + (f", dump {v.get('dump')}" if v.get("dump") else ""))
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    import argparse
+
+    ap = argparse.ArgumentParser(
+        prog="python -m horovod_tpu.utils.numerics",
+        description="Numerics observatory view: gradient health, "
+                    "nonfinite/divergence verdicts, bf16 drift gauges "
+                    "and the consistency digest — from an exposition "
+                    "file, an http://host:port endpoint, or 'live'.")
+    ap.add_argument("target",
+                    help="exposition file | http://host:port | 'live'")
+    ap.add_argument("--json", action="store_true",
+                    help="machine-readable output")
+    args = ap.parse_args(argv)
+
+    if args.target == "live":
+        from horovod_tpu.core import numerics as _num
+
+        rep = _num.report()
+        if args.json:
+            print(json.dumps(rep, default=str))
+        else:
+            print(f"policy      {rep['policy']} "
+                  f"(every {rep['check_every']} steps)")
+            if rep["verdicts"]:
+                print(f"verdicts    {', '.join(sorted(rep['verdicts']))}")
+            if rep["drift"]:
+                print(f"drift_ulp   {rep['drift'].get('ulp')}")
+            if rep["consistency"] is not None:
+                print(f"consistency "
+                      f"{'ok' if rep['consistency']['ok'] else 'DIVERGED'}")
+            for k, v in sorted(rep["metrics"].items()):
+                print(f"{k:44s} {v}")
+        return 3 if rep["verdicts"] else 0
+
+    health = None
+    if _is_http(args.target):
+        try:
+            text = fetch_http(args.target)
+            hz = fetch_http(args.target.rstrip("/") + "/healthz")
+            health = json.loads(hz) if hz.lstrip().startswith("{") \
+                else None
+        except Exception as exc:
+            print(f"cannot fetch {args.target}: {exc}", file=sys.stderr)
+            return 1
+        source = "http"
+    else:
+        try:
+            with open(args.target) as fh:
+                text = fh.read()
+        except OSError as exc:
+            print(f"cannot read {args.target}: {exc}", file=sys.stderr)
+            return 1
+        source = "file"
+
+    samples = numerics_samples(parse_prometheus(text))
+    if args.json:
+        env = _envelope(source, args.target, samples)
+        if health is not None:
+            env["healthz"] = health
+        print(json.dumps(env))
+    else:
+        if health is not None:
+            print(_render_health(health))
+            print()
+        print(render(samples) if samples
+              else "no numerics samples (is HVD_NUMERICS off, or has "
+                   "no step run yet?)")
+    return 3 if _verdict_visible(samples, health) else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

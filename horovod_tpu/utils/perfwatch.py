@@ -63,8 +63,12 @@ _SPREAD_RE = re.compile(
     r"spread\s+(\d+(?:\.\d+)?)-(\d+(?:\.\d+)?)\s+img/sec")
 
 #: The normalized record fields every loader emits (missing -> None).
+#: final_loss rides perf.jsonl auto-capture records (the sentinel stamps
+#: the Trainer's last epoch loss) — a convergence column next to the
+#: throughput ones; histories without it simply show "-" in the trend
+#: table and are never gated on it.
 FIELDS = ("metric", "value", "step_time_ms", "gflops_per_step", "mfu",
-          "hbm_gb_per_step", "membw_util")
+          "hbm_gb_per_step", "membw_util", "final_loss")
 
 
 def _normalize(parsed: dict, label: str,
@@ -235,7 +239,11 @@ _COLS = (("value", "img/s", "{:.0f}"), ("step_time_ms", "step ms",
                                         "{:.2f}"),
          ("mfu", "mfu", "{:.3f}"), ("hbm_gb_per_step", "hbm GB",
                                     "{:.2f}"),
-         ("membw_util", "membw", "{:.3f}"))
+         ("membw_util", "membw", "{:.3f}"),
+         # Convergence next to throughput (numerics observatory): only
+         # perf.jsonl records carry it — older histories refuse the
+         # column with "-" rather than crashing or faking a number.
+         ("final_loss", "loss", "{:.4g}"))
 
 
 def trend_table(records: List[dict]) -> str:

@@ -17,6 +17,16 @@ import os
 # singleton). setdefault: an operator's explicit env still wins.
 os.environ.setdefault("HVD_WATCHDOG", "0")
 
+# The numerics observatory (core/numerics.py) likewise defaults ON
+# (warn) in production; in the suite, hundreds of heterogeneous tiny
+# models — several of which deliberately produce NaN — would trip
+# verdicts/dump files (and the halt policy would abort legitimate
+# tests). The numerics tests re-enable it explicitly per-test
+# (tests/test_numerics.py sets HVD_NUMERICS and resets the module
+# latches). setdefault: an operator's explicit env still wins, and
+# spawned multiprocess worlds inherit it.
+os.environ.setdefault("HVD_NUMERICS", "off")
+
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (

@@ -904,6 +904,80 @@ def main():
         out = hvt.allreduce(torch.ones(3), average=False, name="after")
         np.testing.assert_allclose(out.numpy(),
                                    np.full((3,), float(local_devices * nproc)))
+    elif scenario == "numerics_chaos":
+        # Numerics observatory (ISSUE 8 acceptance), both engines via the
+        # test parametrization: (1) process 1 submits NaN-poisoned
+        # gradients through the engine — the `nonfinite` verdict on
+        # EVERY survivor names that process (the submit-side counts are
+        # allgathered at detection); (2) the cross-rank consistency
+        # digest catches an artificially-desynced parameter bucket with
+        # an attributed report naming the process AND the bucket,
+        # identically on every process. Flight dumps land for both.
+        import glob as _glob
+        import json as _json
+
+        from horovod_tpu.core import engine as eng
+        from horovod_tpu.core import numerics as numx
+
+        assert os.environ.get("HVD_NUMERICS") == "warn"
+        fdir = os.environ["HVD_FLIGHT_DIR"]  # test-made, empty, shared
+        e = eng.get_engine()
+        t = np.ones((4,), np.float32)
+        if pid == 1:
+            t[1] = np.nan
+        h = e.allreduce_async("poison/grad", t, average=False)
+        res = e.synchronize(h)  # warn: observe, don't raise
+        assert np.isnan(res).any()  # the NaN survived the reduction
+        rep = numx.report()
+        v = rep["verdicts"]["nonfinite"]
+        assert v["tensor"] == "poison/grad", v
+        assert v["processes"] == [1], v
+        assert v["local_nonfinite_at_submit"] == (1 if pid == 1 else 0)
+        print(f"proc {pid}: NONFINITE names process 1", flush=True)
+        # Counter-name parity across engines: the hooks ARE shared code;
+        # pin the exact family so the native/python runs can't diverge.
+        flat = rep["metrics"]
+        for name in ("numerics.engine.nonfinite_results",
+                     "numerics.nonfinite.events"):
+            assert flat.get(name, 0) >= 1, (name, sorted(flat))
+        if pid == 1:
+            assert flat.get("numerics.engine.nonfinite_submits", 0) >= 1
+        # The engine still works after the verdict (warn is observe-only).
+        h = e.allreduce_async("after", np.ones((2,), np.float32), False)
+        np.testing.assert_allclose(
+            e.synchronize(h), np.full((2,), float(local_devices * nproc)))
+
+        # --- consistency digest on a desynced bucket -------------------
+        params = {"w": jnp.arange(24.0, dtype=jnp.float32),
+                  "s": jnp.ones((5,), jnp.bfloat16)}
+        ok = hvd.check_consistency(params, tag="sync")
+        assert ok["ok"] is True, ok
+        if pid == 1:
+            w = np.asarray(params["w"]).copy()
+            w[7] += 1e-3  # one element, one process: desync
+            params["w"] = jnp.asarray(w)
+        bad = hvd.check_consistency(params, tag="desync", step=42)
+        assert bad["ok"] is False, bad
+        assert sorted(bad["mismatch"]) == ["float32"], bad
+        # Two controllers, 4 chips each: a digest disagreement is a
+        # 4-vs-4 TIE — no strict majority exists, so the report honestly
+        # names BOTH processes and marks the ambiguity (a vote that
+        # crowned either side could blame the healthy one). Identical
+        # report on every process is the cross-rank contract.
+        assert bad["processes"] == [0, 1], bad
+        assert bad.get("ambiguous") is True, bad
+        v2 = numx.report()["verdicts"]["diverged"]
+        assert v2["processes"] == [0, 1] and v2["buckets"] == ["float32"]
+        assert v2["step"] == 42 and v2["tag"] == "desync"
+        print(f"proc {pid}: DIVERGED tie names both processes, "
+              "bucket float32", flush=True)
+        dumps = _glob.glob(
+            os.path.join(fdir, f"hvd_flight.rank{pid}.*.json"))
+        assert len(dumps) >= 2, dumps  # one per verdict kind, this rank
+        reasons = sorted(_json.load(open(d))["reason"] for d in dumps)
+        assert any("nonfinite" in r for r in reasons), reasons
+        assert any("diverged" in r for r in reasons), reasons
+        print(f"proc {pid}: FLIGHT dumps {len(dumps)}", flush=True)
     elif scenario == "mismatch":
         os.environ["HVD_CONSISTENCY_CHECKS"] = "1"
         from horovod_tpu.common.topology import HorovodInternalError

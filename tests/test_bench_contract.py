@@ -65,11 +65,16 @@ def test_bench_dry_one_json_line_contract(poisoned_env):
     rec = json.loads(lines[0])
     for key in ("metric", "value", "unit", "vs_baseline", "step_time_ms",
                 "gflops_per_step", "mfu", "hbm_gb_per_step", "hbm_source",
-                "membw_util", "spread_pct", "gate", "state_dtype", "dry"):
+                "membw_util", "spread_pct", "gate", "state_dtype",
+                "numerics", "dry"):
         assert key in rec, (key, rec)
     assert rec["metric"] == "resnet50_train_images_per_sec_per_chip_bs32"
     assert rec["unit"] == "images/sec/chip"
     assert rec["dry"] is True
+    # Numerics observatory (ISSUE 8): the field is present-but-null
+    # under --dry (nothing ran, nothing was watched — and the import-free
+    # contract above means the observatory was never even imported).
+    assert rec["numerics"] is None
 
 
 def test_bench_dry_check_keeps_contract_and_gate_fields_null(poisoned_env):

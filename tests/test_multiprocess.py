@@ -272,6 +272,30 @@ def test_two_process_cache_eviction_forces_full_rounds(engine):
 
 
 @pytest.mark.parametrize("engine", ENGINES)
+def test_two_process_numerics_chaos_attributes_poisoner(engine, tmp_path):
+    """Numerics observatory chaos (ISSUE 8 acceptance), BOTH engines:
+    process 1 submits NaN-poisoned gradients -> the `nonfinite` verdict
+    names process 1 on every survivor; a deliberately desynced parameter
+    bucket -> the consistency digest's `diverged` report names the
+    float32 bucket and BOTH processes (a 2-controller disagreement is a
+    structural 4-vs-4 digest tie — no vote can single one out, and the
+    report says so) identically on every process; a flight dump lands
+    per verdict per rank. Counter names are pinned inside the worker, so
+    the native and python runs cannot drift apart."""
+    fdir = tmp_path / f"flight_{engine}"
+    fdir.mkdir()
+    outs = _run_world("numerics_chaos",
+                      extra_env={"HVD_ENGINE": engine,
+                                 "HVD_NUMERICS": "warn",
+                                 "HVD_FLIGHT_DIR": str(fdir),
+                                 "HVD_FLIGHT_MIN_INTERVAL": "0"})
+    for out in outs:
+        assert "NONFINITE names process 1" in out, out[-3000:]
+        assert ("DIVERGED tie names both processes, bucket float32"
+                in out), out[-3000:]
+
+
+@pytest.mark.parametrize("engine", ENGINES)
 def test_two_process_peer_shutdown_propagates(engine):
     """A peer stopping its engine fails outstanding collectives with
     ShutdownError instead of hanging (reference: SHUT_DOWN_ERROR,

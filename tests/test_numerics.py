@@ -1,0 +1,706 @@
+"""Numerics observatory (ISSUE 8): in-step gradient health, the
+`nonfinite` sentinel verdict with the off|warn|halt policy, bf16 drift
+gauges, the cross-rank consistency digest, MetricAverage nonfinite
+masking, the broadcast non-root masking contract, and the CLI — on the
+8-device virtual mesh. The suite-wide default is HVD_NUMERICS=off
+(conftest); every test here re-enables the policy explicitly and resets
+the module latches.
+"""
+
+import glob
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+from jax.sharding import PartitionSpec as P
+
+import horovod_tpu.jax as hj
+import horovod_tpu.jax.numerics as jnum
+from horovod_tpu.common.topology import HVD_AXIS
+from horovod_tpu.core import numerics as num
+from horovod_tpu.core import sentinel as sentinel
+from horovod_tpu.core import telemetry as tele
+from horovod_tpu.ops import collectives as C
+from horovod_tpu.utils.metrics import MetricAverage
+
+
+@pytest.fixture(autouse=True)
+def _numerics_on(hvd, monkeypatch, tmp_path):
+    """warn policy, per-step cadence, a private flight dir, no dump rate
+    limit — and clean module latches before AND after (a fired verdict
+    must not leak into the next test or into /healthz checks elsewhere)."""
+    monkeypatch.setenv("HVD_NUMERICS", "warn")
+    monkeypatch.setenv("HVD_NUMERICS_EVERY", "1")
+    (tmp_path / "flight").mkdir()
+    monkeypatch.setenv("HVD_FLIGHT_DIR", str(tmp_path / "flight"))
+    monkeypatch.setenv("HVD_FLIGHT_MIN_INTERVAL", "0")
+    num.reset()
+    yield
+    num.reset()
+    sentinel.reset_sentinel()
+
+
+def _dumps(tmp_path):
+    return sorted(glob.glob(str(tmp_path / "flight" / "hvd_flight.*.json")))
+
+
+# ---------------------------------------------------------------------------
+# Policy / knob parsing
+# ---------------------------------------------------------------------------
+
+
+def test_policy_spellings(monkeypatch):
+    for raw, want in (("off", "off"), ("0", "off"), ("false", "off"),
+                      ("warn", "warn"), ("1", "warn"), ("on", "warn"),
+                      ("halt", "halt"), ("HALT", "halt"),
+                      ("bogus", "warn")):
+        monkeypatch.setenv("HVD_NUMERICS", raw)
+        assert num.policy() == want, raw
+    monkeypatch.delenv("HVD_NUMERICS")
+    assert num.policy() == "warn"  # production default is warn
+
+
+def test_check_every_parsing(monkeypatch):
+    monkeypatch.delenv("HVD_NUMERICS_EVERY", raising=False)
+    assert num.check_every() == 50
+    monkeypatch.setenv("HVD_NUMERICS_EVERY", "7")
+    assert num.check_every() == 7
+    monkeypatch.setenv("HVD_NUMERICS_EVERY", "0")
+    assert num.check_every() == 1  # clamped: 0 would divide by zero
+    monkeypatch.setenv("HVD_NUMERICS_EVERY", "junk")
+    assert num.check_every() == 50
+
+
+# ---------------------------------------------------------------------------
+# Traced building blocks (jax/numerics.py)
+# ---------------------------------------------------------------------------
+
+
+def test_max_ulp_zero_one_and_nan():
+    a = jnp.asarray([1.0, -2.5, 0.0], jnp.float32)
+    assert int(jnum.max_ulp(a, a)) == 0
+    b = jnp.asarray(np.nextafter(np.asarray(a), np.inf))
+    assert int(jnum.max_ulp(a, b)) == 1
+    r = jnp.asarray([1.0, 1.0], jnp.bfloat16)
+    r1 = jnp.asarray([1.0, 1.0 + 2 ** -7], jnp.bfloat16)  # 1 bf16 ulp
+    assert int(jnum.max_ulp(r, r1)) == 1
+    n = jnp.asarray([1.0, float("nan")], jnp.float32)
+    assert int(jnum.max_ulp(a[:2], n)) > 1 << 24  # NaN reads as huge
+    with pytest.raises(ValueError):
+        jnum.max_ulp(a, r)
+    with pytest.raises(ValueError, match="16/32-bit"):
+        jnum.max_ulp(np.zeros(2, np.float64), np.zeros(2, np.float64))
+
+
+def test_guard_updates_is_bitwise_noop_including_signed_zeros():
+    params = {"w": jnp.asarray([0.0, -0.0, 1.5, -3.25], jnp.float32),
+              "n": jnp.asarray([2, 3], jnp.int32)}
+    updates = {"w": jnp.asarray([1.0, 1.0, 1.0, 1.0], jnp.float32),
+               "n": jnp.zeros((2,), jnp.int32)}
+    skipped = jnum.guard_updates(jnp.asarray(False), updates)
+    after = optax.apply_updates(params, skipped)
+    for k in params:
+        assert (np.asarray(after[k]).tobytes()
+                == np.asarray(params[k]).tobytes()), k
+    passed = jnum.guard_updates(jnp.asarray(True), updates)
+    np.testing.assert_array_equal(np.asarray(passed["w"]),
+                                  np.asarray(updates["w"]))
+
+
+def test_tree_stats_buckets_and_counts():
+    tree = {"a": jnp.asarray([1.0, float("nan"), float("inf")],
+                             jnp.float32),
+            "b": jnp.asarray([3.0, 4.0], jnp.float32),
+            "c": jnp.ones((4,), jnp.bfloat16),
+            "n": jnp.arange(5, dtype=jnp.int32)}
+    stats = jnum.tree_stats(tree)
+    assert set(stats) == {"float32", "bfloat16", "int32"}
+    assert int(stats["float32"]["nonfinite"]) == 2
+    assert int(stats["bfloat16"]["nonfinite"]) == 0
+    assert int(stats["int32"]["nonfinite"]) == 0
+    # finite sumsq still accumulates the finite bucket exactly
+    assert float(stats["bfloat16"]["sumsq"]) == 4.0
+    health = jnum.health_of(stats)
+    assert int(health["nonfinite"]) == 2
+    assert set(health["buckets"]) == set(stats)
+    assert not bool(jnum.all_finite(stats))
+
+
+# ---------------------------------------------------------------------------
+# Host intake: verdicts, fire-once, the halt policy (core/numerics.py)
+# ---------------------------------------------------------------------------
+
+
+def _poisoned_health():
+    return {
+        "grad_norm": float("inf"),
+        "nonfinite": 3,
+        "buckets": {"float32": {"norm": float("inf"), "nonfinite": 3},
+                    "bfloat16": {"norm": 1.0, "nonfinite": 0}},
+        "per_rank_nonfinite": np.asarray([0, 0, 3, 0, 0, 0, 0, 0]),
+    }
+
+
+def test_nonfinite_verdict_fires_once_with_attribution(tmp_path):
+    num.note_step_health(_poisoned_health(), step=7)
+    rep = num.report()
+    v = rep["verdicts"]["nonfinite"]
+    assert v["step"] == 7
+    assert v["buckets"] == {"float32": 3}
+    assert v["ranks"] == [2]
+    dumps = _dumps(tmp_path)
+    assert len(dumps) == 1, dumps
+    dump = json.load(open(dumps[0]))
+    assert "nonfinite" in dump["reason"] and "step 7" in dump["reason"]
+    assert "float32" in dump["reason"] and "[2]" in dump["reason"]
+    assert any(ev.get("name") == "NUMERICS_VERDICT"
+               for ev in dump["events"])
+    # Second poisoned step: counted, NOT re-dumped (fire-once latch).
+    before = tele.REGISTRY.counter("numerics.nonfinite.steps").value
+    num.note_step_health(_poisoned_health(), step=8)
+    assert tele.REGISTRY.counter(
+        "numerics.nonfinite.steps").value == before + 1
+    assert len(_dumps(tmp_path)) == 1
+    assert num.report()["verdicts"]["nonfinite"]["step"] == 7  # first wins
+
+
+def test_halt_policy_raises_after_dump(tmp_path, monkeypatch):
+    monkeypatch.setenv("HVD_NUMERICS", "halt")
+    with pytest.raises(num.NonfiniteError) as exc:
+        num.note_step_health(_poisoned_health(), step=3)
+    assert "step 3" in str(exc.value)
+    assert "float32" in str(exc.value)
+    assert "NOT applied" in str(exc.value)
+    assert len(_dumps(tmp_path)) == 1  # the dump landed before the raise
+
+
+def test_healthy_step_is_silent(tmp_path):
+    health = {"grad_norm": 1.25, "nonfinite": 0,
+              "buckets": {"float32": {"norm": 1.25, "nonfinite": 0}}}
+    assert num.note_step_health(health, step=1) is None
+    assert num.report()["verdicts"] is None
+    assert _dumps(tmp_path) == []
+    flat = tele.REGISTRY.flat()
+    assert flat["numerics.grad_norm"]["last"] == 1.25
+    assert flat["numerics.grad_norm.float32"] == 1.25
+
+
+def test_healthz_degrades_on_numerics_verdict():
+    sentinel.reset_sentinel()
+    assert sentinel.health()["status"] == "init"
+    num.note_step_health(_poisoned_health(), step=11)
+    h = sentinel.health()
+    assert h["status"] == "warn"
+    assert h["verdict"]["verdict"] == "nonfinite"
+    assert h["verdict"]["step"] == 11
+    assert h["numerics"]["verdicts"] == ["nonfinite"]
+    assert h["numerics"]["policy"] == "warn"
+
+
+# ---------------------------------------------------------------------------
+# Cross-rank consistency digest
+# ---------------------------------------------------------------------------
+
+
+def test_params_digest_sees_any_bitwise_change():
+    tree = {"w": np.arange(8.0, dtype=np.float32),
+            "b": np.ones((3,), np.float32)}
+    d1 = num.params_digest(tree)
+    d2 = num.params_digest({"w": tree["w"].copy(), "b": tree["b"].copy()})
+    assert set(d1) == {"float32"}
+    np.testing.assert_array_equal(d1["float32"], d2["float32"])
+    flipped = tree["w"].copy()
+    flipped[5] = np.nextafter(flipped[5], np.inf)  # 1-ulp flip
+    d3 = num.params_digest({"w": flipped, "b": tree["b"]})
+    assert tuple(d3["float32"][:2]) != tuple(d1["float32"][:2])  # crc
+    # Both crc halves stay exactly representable on the f32 wire (the
+    # whole point of splitting the 32-bit crc for the allgather).
+    assert all(np.float32(h) == h for h in d3["float32"][:2])
+    poisoned = tree["w"].copy()
+    poisoned[0] = np.nan
+    d4 = num.params_digest({"w": poisoned, "b": tree["b"]})
+    assert d4["float32"][3] == 1.0  # nonfinite count rides the digest
+
+
+def test_compare_digests_names_rank_bucket_process():
+    world, names = 8, ["bfloat16", "float32"]
+    gathered = np.tile(np.asarray([[1.0, 2.0, 0.0], [3.0, 4.0, 0.0]]),
+                       (world, 1, 1))
+    ok = num.compare_digests(gathered, names, local_size=4)
+    assert ok["ok"] and "mismatch" not in ok
+    gathered[5, 1, 0] += 9.0  # rank 5 deviates in the float32 bucket
+    bad = num.compare_digests(gathered, names, local_size=4)
+    assert not bad["ok"]
+    assert bad["mismatch"] == {"float32": [5]}
+    assert bad["ranks"] == [5]
+    assert bad["processes"] == [1]  # rank 5 // local_size 4
+    assert "ambiguous" not in bad  # 7-vs-1 is a strict majority
+
+
+def test_compare_digests_tie_is_ambiguous_not_rank0_biased():
+    """A 2-controller disagreement is a structural 4-vs-4 tie (each
+    process's digest is replicated across its local chips): no vote can
+    single out the corrupt side, and crowning the first-inserted digest
+    would blame the HEALTHY process whenever process 0 is the corrupt
+    one. The report must name everyone and say it's ambiguous —
+    symmetrically, whichever side differs."""
+    world, names = 8, ["float32"]
+    for corrupt_proc in (0, 1):
+        gathered = np.tile(np.asarray([[1.0, 2.0, 0.0]]), (world, 1, 1))
+        lo = corrupt_proc * 4
+        gathered[lo:lo + 4, 0, 0] += 7.0
+        rep = num.compare_digests(gathered, names, local_size=4)
+        assert rep["ok"] is False
+        assert rep["ambiguous"] is True
+        assert rep["ranks"] == list(range(8))
+        assert rep["processes"] == [0, 1], corrupt_proc
+    # Three-way splits without a strict majority are ambiguous too.
+    gathered = np.tile(np.asarray([[1.0, 2.0, 0.0]]), (world, 1, 1))
+    gathered[0:3, 0, 0] += 1.0
+    gathered[3:6, 0, 0] += 2.0  # counts {3, 3, 2}: no strict majority
+    rep = num.compare_digests(gathered, names, local_size=4)
+    assert rep["ok"] is False and rep["ambiguous"] is True
+
+
+def test_check_consistency_in_lockstep_is_ok(hvd):
+    tree = {"w": jnp.arange(16.0, dtype=jnp.float32),
+            "s": jnp.ones((4,), jnp.bfloat16)}
+    rep = num.check_consistency(tree, tag="unit")
+    assert rep["ok"] is True
+    assert rep["tag"] == "unit"
+    assert set(rep["buckets"]) == {"float32", "bfloat16"}
+    assert num.report()["consistency"]["ok"] is True
+
+
+def test_check_consistency_diverged_verdict(hvd, tmp_path, monkeypatch):
+    """A doctored allgather (one chip's digest row off) must yield the
+    attributed `diverged` verdict + dump on this process."""
+    real_allgather = C.allgather
+
+    def doctored(x):
+        out = np.asarray(real_allgather(x))
+        out = out.reshape(hvd.size(), -1).copy()
+        out[3, 0] += 1.0  # chip 3 reports a different crc
+        return out
+
+    monkeypatch.setattr(C, "allgather", doctored)
+    rep = num.check_consistency({"w": jnp.ones((8,), jnp.float32)},
+                                tag="chaos", step=5)
+    assert rep["ok"] is False
+    assert rep["ranks"] == [3]
+    assert rep["mismatch"] == {"float32": [3]}
+    v = num.report()["verdicts"]["diverged"]
+    assert v["ranks"] == [3] and v["buckets"] == ["float32"]
+    assert v["step"] == 5 and v["tag"] == "chaos"
+    dumps = _dumps(tmp_path)
+    assert len(dumps) == 1
+    assert "diverged" in json.load(open(dumps[0]))["reason"]
+
+
+# ---------------------------------------------------------------------------
+# MetricAverage nonfinite masking (satellite)
+# ---------------------------------------------------------------------------
+
+
+def test_metric_average_excludes_nonfinite(hvd, caplog):
+    import logging
+
+    before = tele.REGISTRY.counter("metrics.nonfinite_skipped").value
+    with caplog.at_level(logging.WARNING, "horovod_tpu.metrics"):
+        out = MetricAverage({"loss": float("nan"), "acc": 0.5,
+                             "lr": 0.1})
+    # Finite keys are NOT poisoned by the NaN neighbor (the old path
+    # shipped them through one stacked allreduce and kept them finite
+    # only by luck of element independence; the new path additionally
+    # keeps a nonfinite RANK from poisoning the cross-rank average).
+    assert out["acc"] == pytest.approx(0.5)
+    assert out["lr"] == pytest.approx(0.1)
+    # Nonfinite on every rank -> no honest number: stays NaN.
+    assert np.isnan(out["loss"])
+    assert tele.REGISTRY.counter(
+        "metrics.nonfinite_skipped").value == before + 1
+    assert any("loss" in r.message for r in caplog.records)
+
+
+def test_metric_average_all_finite_identity(hvd):
+    out = MetricAverage({"a": 1.5, "b": -2.0})
+    assert out["a"] == pytest.approx(1.5)
+    assert out["b"] == pytest.approx(-2.0)
+
+
+# ---------------------------------------------------------------------------
+# Broadcast non-root masking contract (satellite)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("dtype", [jnp.float32, jnp.bfloat16])
+def test_broadcast_nonroot_nonfinite_never_poisons(hvd, dtype):
+    """ops/collectives.py `_root_select_psum`: non-root ranks holding
+    NaN/Inf must not leak into the broadcast result (select, not a mask
+    multiply — 0 * NaN would be NaN)."""
+    n = hvd.size()
+    root_row = np.linspace(-2.0, 2.0, 6).astype(np.float32)
+
+    @hj.jit(in_specs=(P(HVD_AXIS, None),), out_specs=P(HVD_AXIS, None))
+    def bcast(stack):
+        got = hj.broadcast(stack[0], root_rank=0)
+        return got[None, :]
+
+    stack = np.tile(root_row, (n, 1))
+    stack[1:, ::2] = np.nan  # every non-root rank poisoned with NaN
+    stack[1:, 1::2] = np.inf # ... and Inf
+    out = np.asarray(bcast(jnp.asarray(stack, dtype)))
+    want = np.asarray(jnp.asarray(root_row, dtype), np.float32)
+    assert np.isfinite(out).all(), out
+    for r in range(n):
+        np.testing.assert_array_equal(out[r].astype(np.float32), want,
+                                      err_msg=f"rank {r}")
+
+
+# ---------------------------------------------------------------------------
+# Engine hooks (python engine; the native twin rides the 2-proc tier)
+# ---------------------------------------------------------------------------
+
+
+class _IdentityExecutor:
+    """Local loopback: the 'reduced' result is the snapshot itself, so a
+    poisoned submit yields a poisoned result (the single-rank view of
+    the cross-rank failure the 2-proc tier exercises end to end)."""
+
+    def allreduce(self, flat, average):
+        return flat
+
+    def allgather(self, t):
+        return t
+
+    def broadcast(self, t, root):
+        return t.copy()
+
+
+def _record_engine():
+    from horovod_tpu.core import engine as eng
+    from horovod_tpu.core import timeline as tl
+
+    return eng.Engine(executor=_IdentityExecutor(), cycle_time_s=0.002,
+                      timeline=tl.Timeline(None))
+
+
+def test_engine_nonfinite_result_verdict(tmp_path):
+    e = _record_engine()
+    try:
+        t = np.ones((4,), np.float32)
+        t[2] = np.nan
+        h = e.allreduce_async("grad/w", t, average=False)
+        e.synchronize(h)  # warn: observe, don't raise
+        v = num.report()["verdicts"]["nonfinite"]
+        assert v["tensor"] == "grad/w"
+        assert v["origin"] == "engine"
+        assert v["local_nonfinite_at_submit"] == 1
+        flat = tele.REGISTRY.flat()
+        assert flat["numerics.engine.nonfinite_submits"] >= 1
+        assert flat["numerics.engine.nonfinite_results"] >= 1
+        assert len(_dumps(tmp_path)) == 1
+    finally:
+        e.shutdown()
+
+
+def test_engine_halt_raises_at_synchronize(monkeypatch):
+    monkeypatch.setenv("HVD_NUMERICS", "halt")
+    e = _record_engine()
+    try:
+        t = np.full((3,), np.inf, np.float32)
+        h = e.allreduce_async("boom", t, average=False)
+        with pytest.raises(num.NonfiniteError, match="boom"):
+            e.synchronize(h)
+    finally:
+        monkeypatch.setenv("HVD_NUMERICS", "off")  # clean engine drain
+        e.shutdown()
+
+
+def test_engine_finite_result_is_silent():
+    e = _record_engine()
+    try:
+        h = e.allreduce_async("ok", np.ones((4,), np.float32),
+                              average=False)
+        e.synchronize(h)
+        assert num.report()["verdicts"] is None
+    finally:
+        e.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# Trainer integration: NaN at step k on the 8-device mesh (acceptance)
+# ---------------------------------------------------------------------------
+
+
+def _fit_data(n=24, poison_batch=None):
+    rng = np.random.RandomState(0)
+    x = rng.randn(n, 8, 8, 1).astype(np.float32)
+    y = (np.arange(n) % 10).astype(np.int32)
+    if poison_batch is not None:
+        # Poison the FIRST row of that (global, 8-row) batch: with
+        # batch rows sharded P('hvd') in order, row 0 of the batch lands
+        # on rank 0 — the per-rank attribution must name exactly that
+        # rank. Trainer.fit batch_size is PER CHIP: batch_size=1 on the
+        # 8-device mesh makes the global batch 8 rows.
+        x[poison_batch * 8] = np.nan
+    return x, y
+
+
+def test_trainer_nan_step_yields_one_attributed_dump(hvd, tmp_path):
+    import horovod_tpu.keras as hvd_keras
+    from horovod_tpu.models import MnistMLP
+
+    x, y = _fit_data(poison_batch=2)  # NaN enters at step 3 (1-based)
+    t = hvd_keras.Trainer(MnistMLP(hidden=8), optax.sgd(0.1))
+    t.fit(x, y, batch_size=1, epochs=1, shuffle=False)
+    v = num.report()["verdicts"]["nonfinite"]
+    assert v["step"] == 3
+    assert "float32" in v["buckets"]
+    # Only rank 0's local (pre-reduction) gradients were nonfinite: the
+    # attribution vector names that rank alone on every rank.
+    assert v["ranks"] == [0]
+    # Exactly ONE dump: later poisoned steps (warn propagates the NaN)
+    # fold into the latch instead of dumping a storm.
+    dumps = _dumps(tmp_path)
+    assert len(dumps) == 1, dumps
+    assert "step 3" in json.load(open(dumps[0]))["reason"]
+    assert tele.REGISTRY.counter("numerics.nonfinite.steps").value >= 1
+
+
+def test_trainer_halt_never_applies_poisoned_update(hvd, tmp_path,
+                                                    monkeypatch):
+    import horovod_tpu.keras as hvd_keras
+    from horovod_tpu.models import MnistMLP
+
+    monkeypatch.setenv("HVD_NUMERICS", "halt")
+    x, y = _fit_data(poison_batch=0)  # the FIRST step is poisoned
+    t = hvd_keras.Trainer(MnistMLP(hidden=8), optax.sgd(0.1))
+    t.build(x[:8])
+    snap = jax.tree_util.tree_map(lambda a: np.array(a), t.params)
+    with pytest.raises(num.NonfiniteError, match="step 1"):
+        t.fit(x, y, batch_size=1, epochs=1, shuffle=False)
+    # The poisoned update was provably never applied: params BITWISE
+    # unchanged (the in-program guard emitted -0.0 updates and
+    # re-selected the optimizer state).
+    live = jax.tree_util.tree_map(lambda a: np.asarray(a), t.params)
+    for (ka, a), (kb, b) in zip(
+            jax.tree_util.tree_leaves_with_path(snap),
+            jax.tree_util.tree_leaves_with_path(live)):
+        assert a.tobytes() == b.tobytes(), ka
+    assert len(_dumps(tmp_path)) == 1
+
+
+def test_trainer_fallback_path_guards_and_attributes(hvd, tmp_path,
+                                                     monkeypatch):
+    """distributed=False Trainer: the optimizer wrapper never runs, so
+    the step's FALLBACK health path must (a) psum the stats over the
+    rank axis — a NaN on a non-zero rank would otherwise be invisible to
+    the host, which only reads device 0's tile of a replicated output —
+    and (b) run the halt guard itself, so the 'update was NOT applied'
+    claim stays true on this path too."""
+    import horovod_tpu.keras as hvd_keras
+    from horovod_tpu.models import MnistMLP
+
+    monkeypatch.setenv("HVD_NUMERICS", "halt")
+    # Poison a NON-zero rank's row (row 5 of the global batch -> rank 5).
+    x, y = _fit_data()
+    x[5] = np.nan
+    t = hvd_keras.Trainer(MnistMLP(hidden=8), optax.sgd(0.1),
+                          distributed=False)
+    t.build(x[:8])
+    snap = jax.tree_util.tree_map(lambda a: np.array(a), t.params)
+    with pytest.raises(num.NonfiniteError, match="step 1"):
+        t.fit(x, y, batch_size=1, epochs=1, shuffle=False)
+    v = num.report()["verdicts"]["nonfinite"]
+    assert v["ranks"] == [5]  # the psum'd per-rank vector names rank 5
+    live = jax.tree_util.tree_map(lambda a: np.asarray(a), t.params)
+    for a, b in zip(jax.tree_util.tree_leaves(snap),
+                    jax.tree_util.tree_leaves(live)):
+        assert a.tobytes() == b.tobytes()
+
+
+def test_trainer_drift_and_update_ratio_gauges(hvd):
+    import horovod_tpu.keras as hvd_keras
+    from horovod_tpu.models import MnistMLP
+
+    x, y = _fit_data()
+    t = hvd_keras.Trainer(MnistMLP(hidden=8), optax.sgd(0.1),
+                          sharded_update=True, state_dtype="bf16")
+    t.fit(x, y, batch_size=1, epochs=1, shuffle=False)
+    flat = tele.REGISTRY.flat()
+    # bf16 drift gauge (ulps at the master's magnitude): the
+    # re-anchored master path reads stable single digits — the
+    # per-step error is bounded by one rounding of that step's delta,
+    # never by accumulated history (a real divergence reads tens to
+    # thousands; see the direct test).
+    assert flat["numerics.drift_ulp.bfloat16"] <= 8
+    assert flat["numerics.drift.checks"] >= 1
+    # Masterless-caveat gauge inputs ride every checked step.
+    assert flat["numerics.update_ratio"] > 0
+    drift = num.report()["drift"]
+    assert drift is not None and "bfloat16" in drift["ulp"]
+
+
+def test_drift_ulp_direct_and_perturbed(hvd):
+    params = {"w": jnp.linspace(-1.0, 1.0, 33, dtype=jnp.float32
+                                ).astype(jnp.bfloat16)}
+    opt = hj.DistributedOptimizer(optax.sgd(0.1), sharded_update=True,
+                                  state_dtype="bf16")
+    state = opt.init(params)
+    assert hj.sharded.has_master_shards(state)
+    clean = hj.sharded.drift_ulp(state, params)
+    assert clean == {"bfloat16": 0}  # init: residents == cast(masters)
+    drifted = hj.sharded.drift_ulp(
+        state, {"w": (params["w"].astype(jnp.float32) * 1.25
+                      ).astype(jnp.bfloat16)})
+    assert drifted["bfloat16"] >= 16  # a real divergence reads large
+    # NaN residents (a poisoned step the warn policy let through) read
+    # as HUGE divergence — never a crash out of the fit loop.
+    poisoned = np.asarray(params["w"], np.float32)
+    poisoned[3] = np.nan
+    nan_drift = hj.sharded.drift_ulp(
+        state, {"w": jnp.asarray(poisoned, jnp.bfloat16)})
+    assert nan_drift["bfloat16"] >= (1 << 62)
+    with pytest.raises(ValueError, match="master"):
+        hj.sharded.drift_ulp(optax.sgd(0.1).init(params), params)
+
+
+# ---------------------------------------------------------------------------
+# The off-policy HLO pin (acceptance: the bench headline path)
+# ---------------------------------------------------------------------------
+
+
+def _opt_step_text(monkeypatch, policy: str) -> str:
+    """Lower a sharded-update step the way the Trainer builds it: under
+    an active policy the stashed in-step health is COLLECTED into the
+    step outputs (uncollected tracers would be dead code and XLA would
+    prune the instrumentation, hiding the warn-vs-off difference)."""
+    monkeypatch.setenv("HVD_NUMERICS", policy)
+    params = {"w": jnp.arange(40.0, dtype=jnp.float32)}
+    opt = hj.DistributedOptimizer(optax.sgd(0.1, momentum=0.9),
+                                  sharded_update=True)
+    state = opt.init(params)
+    ospec = hj.sharded_state_specs(state)
+    num_on = policy != "off"
+
+    @hj.jit(in_specs=(P(), ospec, P()),
+            out_specs=(P(), ospec, P()) if num_on else (P(), ospec))
+    def step(p, s, g):
+        u, s2 = opt.update(g, s, p)
+        p2 = optax.apply_updates(p, u)
+        if num_on:
+            return p2, s2, jnum.collect_traced()
+        return p2, s2
+
+    return step.lower(params, state, params).as_text()
+
+
+def test_off_policy_lowers_zero_instrumentation(hvd, monkeypatch):
+    """HVD_NUMERICS=off must lower the sharded-update step with NO
+    numerics residue — no is_finite, no attribution all_gather beyond
+    the update's own, and the exact op histogram of the uninstrumented
+    program (the bench sets off for its headline window; the AOT window
+    therefore compiles to the identical HLO as pre-numerics builds)."""
+    import re
+
+    off = _opt_step_text(monkeypatch, "off")
+    warn = _opt_step_text(monkeypatch, "warn")
+    assert "is_finite" not in off
+    assert "is_finite" in warn  # the pin is meaningful: warn DOES add it
+    # A second off-lowering is byte-identical (no hidden nondeterminism
+    # to hide instrumentation behind).
+    assert off == _opt_step_text(monkeypatch, "off")
+
+    def ops(txt):
+        hist = {}
+        for m in re.finditer(r"\bstablehlo\.(\w+)", txt):
+            hist[m.group(1)] = hist.get(m.group(1), 0) + 1
+        return hist
+
+    hoff, hwarn = ops(off), ops(warn)
+    assert hoff != hwarn  # warn adds real ops ...
+    assert "is_finite" in hwarn and "is_finite" not in hoff  # ... here
+
+
+def test_off_policy_trainer_logs_carry_no_numerics(hvd, monkeypatch):
+    import horovod_tpu.keras as hvd_keras
+    from horovod_tpu.models import MnistMLP
+
+    monkeypatch.setenv("HVD_NUMERICS", "off")
+    x, y = _fit_data(n=8)
+    before = tele.REGISTRY.counter("numerics.steps.checked").value
+    t = hvd_keras.Trainer(MnistMLP(hidden=8), optax.sgd(0.1))
+    t.fit(x, y, batch_size=1, epochs=1, shuffle=False)
+    # No health was computed, fetched or checked: the compiled step
+    # carried no numerics outputs at all under the off policy.
+    assert tele.REGISTRY.counter(
+        "numerics.steps.checked").value == before
+
+
+# ---------------------------------------------------------------------------
+# Surfaces: hvd.numerics_report, bench compact, the CLI
+# ---------------------------------------------------------------------------
+
+
+def test_top_level_exports(hvd):
+    import horovod_tpu as hvd_top
+
+    assert hvd_top.numerics_report()["policy"] == "warn"
+    assert hvd_top.NonfiniteError is num.NonfiniteError
+    rep = hvd_top.check_consistency({"w": jnp.ones((4,), jnp.float32)})
+    assert rep["ok"] is True
+
+
+def test_compact_shape_for_bench_line():
+    c = num.compact()
+    assert set(c) == {"policy", "steps_checked", "nonfinite_steps",
+                      "grad_norm_last", "consistency_ok", "verdicts"}
+    assert c["policy"] == "warn"
+    json.dumps(c)  # must be JSON-serializable as-is
+
+
+def test_cli_file_target_exit_codes(tmp_path, capsys):
+    from horovod_tpu.utils import numerics as cli
+
+    healthy = tmp_path / "healthy.prom"
+    healthy.write_text("hvd_numerics_steps_checked 12\n"
+                       "hvd_engine_submits 4\n"
+                       "hvd_numerics_grad_norm_last 1.5\n")
+    assert cli.main([str(healthy)]) == 0
+    out = capsys.readouterr().out
+    assert "hvd_numerics_steps_checked" in out
+    assert "hvd_engine_submits" not in out  # numerics filter applies
+
+    sick = tmp_path / "sick.prom"
+    sick.write_text("hvd_numerics_nonfinite_events 1\n"
+                    "hvd_sentinel_verdict_nonfinite 1\n")
+    assert cli.main([str(sick)]) == 3  # scriptable trouble signal
+    assert cli.main([str(tmp_path / "missing.prom")]) == 1
+
+
+def test_cli_json_envelope(tmp_path, capsys):
+    from horovod_tpu.utils import numerics as cli
+
+    f = tmp_path / "m.prom"
+    f.write_text("hvd_numerics_steps_checked 3\n")
+    assert cli.main([str(f), "--json"]) == 0
+    env = json.loads(capsys.readouterr().out)
+    assert env["source"] == "file" and env["target"] == str(f)
+    assert env["samples"] == [{"name": "hvd_numerics_steps_checked",
+                               "labels": {}, "value": 3.0}]
+
+
+def test_cli_live_target(capsys):
+    from horovod_tpu.utils import numerics as cli
+
+    assert cli.main(["live"]) == 0
+    assert "policy      warn" in capsys.readouterr().out
+    num.note_step_health(_poisoned_health(), step=2)
+    assert cli.main(["live"]) == 3
+    out = capsys.readouterr().out
+    assert "nonfinite" in out

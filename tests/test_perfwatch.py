@@ -644,3 +644,58 @@ def test_launcher_exposes_telemetry_port_flag():
     with contextlib.redirect_stdout(buf), pytest.raises(SystemExit):
         launcher.main(["--help"])
     assert "--telemetry-port-base" in buf.getvalue()
+
+
+# ---------------------------------------------------------------------------
+# Numerics observatory satellites (ISSUE 8): the convergence column
+# ---------------------------------------------------------------------------
+
+
+def test_trend_table_final_loss_column():
+    """perf.jsonl records carry final_loss (the sentinel stamps the
+    Trainer's last epoch loss); pre-numerics histories simply REFUSE the
+    column with '-' — never a crash, never a faked number — and the gate
+    never gates on it."""
+    recs = [
+        {"label": "c1", "value": 2900.0, "final_loss": 2.3456},
+        {"label": "c2", "value": 2920.0},  # pre-numerics history record
+    ]
+    table = pw.trend_table(recs)
+    rows = table.splitlines()
+    assert "loss" in rows[0]
+    assert "2.346" in next(r for r in rows if r.startswith("c1"))
+    assert "2.346" not in next(r for r in rows if r.startswith("c2"))
+    # The regression gate ignores the convergence column entirely: a
+    # loss-less reference vs a loss-carrying current still gates on
+    # throughput alone.
+    result = pw.gate({"value": 2920.0, "final_loss": 2.3},
+                     {"value": 2900.0, "label": "ref"})
+    assert result["status"] == "pass"
+    assert [c["field"] for c in result["checks"]] == ["value"]
+
+
+def test_normalize_carries_final_loss_from_perf_jsonl(tmp_path):
+    log = tmp_path / "perf.jsonl"
+    log.write_text(json.dumps({"value": 100.0, "metric": "m",
+                               "final_loss": 0.75}) + "\n"
+                   + json.dumps({"value": 101.0, "metric": "m"}) + "\n")
+    recs = pw.load_records(str(log))
+    assert recs[0]["final_loss"] == 0.75
+    assert recs[1]["final_loss"] is None
+
+
+def test_sentinel_note_loss_feeds_capture_records():
+    from horovod_tpu.core import sentinel as sn
+
+    sn.reset_sentinel()
+    try:
+        s = sn.get_sentinel()
+        assert s.last_loss is None
+        sn.note_loss(2.5)
+        assert s.last_loss == 2.5
+        sn.note_loss("not-a-number")  # ignored, never raises
+        assert s.last_loss == 2.5
+        sn.note_loss(np.float32(1.25))  # host scalars coerce
+        assert s.last_loss == 1.25
+    finally:
+        sn.reset_sentinel()
