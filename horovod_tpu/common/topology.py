@@ -261,11 +261,24 @@ def init(ranks: Optional[Sequence[int]] = None, devices: Optional[Sequence] = No
             from jax._src import distributed as _jax_dist
 
             if _jax_dist.global_state.client is None:
-                jax.distributed.initialize(
-                    coordinator_address=coord,
-                    num_processes=int(os.environ["HVD_NUM_PROCESSES"]),
-                    process_id=int(os.environ.get("HVD_PROCESS_ID", "0")),
-                )
+                from horovod_tpu.core import elastic as _elastic
+
+                if _elastic.enabled():
+                    # Elastic worlds own the bring-up: the stock client
+                    # TERMINATES survivors when the coordination service
+                    # notices a dead peer — detection must live in the
+                    # elastic heartbeat lease instead (core/elastic.py).
+                    _elastic.bring_up_distributed(
+                        coord,
+                        int(os.environ["HVD_NUM_PROCESSES"]),
+                        int(os.environ.get("HVD_PROCESS_ID", "0")))
+                else:
+                    jax.distributed.initialize(
+                        coordinator_address=coord,
+                        num_processes=int(os.environ["HVD_NUM_PROCESSES"]),
+                        process_id=int(os.environ.get("HVD_PROCESS_ID",
+                                                      "0")),
+                    )
 
         # Multi-controller on the CPU platform: current jaxlib executes
         # cross-process CPU collectives only through a CPU collectives
@@ -359,6 +372,19 @@ def init(ranks: Optional[Sequence[int]] = None, devices: Optional[Sequence] = No
                 "failed to start the collective engine for negotiation "
                 "rounds (%s); peer processes' engine collectives will "
                 "stall until HVD_NEGOTIATION_TIMEOUT", exc)
+    # Elastic worlds (HVD_ELASTIC=1): start the heartbeat lease + adopt
+    # the world-epoch journal. No-op when elastic is off.
+    try:
+        from horovod_tpu.core import elastic as _elastic
+
+        if _elastic.enabled():
+            _elastic.get_world().on_init(_state.num_processes,
+                                         _state.process_index)
+    except Exception:
+        import logging
+
+        logging.getLogger("horovod_tpu").warning(
+            "elastic world bring-up failed", exc_info=True)
 
 
 def shutdown():
@@ -377,6 +403,16 @@ def shutdown():
             from horovod_tpu.ops import collectives as _coll
 
             _coll._ranked_program.cache_clear()
+        except Exception:
+            pass
+        try:
+            # Shutdown -> init re-entry (elastic reconfiguration rebuilds
+            # the mesh in-process): cached concrete trees hold arrays of
+            # the outgoing world — clear them with the mesh-keyed
+            # programs so nothing pins the old Mesh/devices.
+            from horovod_tpu import jax as _hjax
+
+            _hjax._ZERO_TREES.clear()
         except Exception:
             pass
         _state.initialized = False

@@ -60,6 +60,7 @@ import heapq
 import json
 import logging
 import os
+import random
 import re
 import threading
 import time
@@ -70,7 +71,14 @@ from horovod_tpu.core import telemetry as _tele
 
 LOG = logging.getLogger("horovod_tpu.coordinator")
 
-_POLL_SLICE_S = 0.5  # granularity of tombstone checks while blocked
+# Blocked-read poll slices grow with jittered exponential backoff from
+# _POLL_SLICE_MIN_S up to HVD_KV_POLL_MAX: long waits (a genuinely slow
+# peer) stop hammering the KV store with fixed-interval probes, while the
+# first slices stay short so quick rounds keep their latency. The jitter
+# de-synchronizes P processes' probe trains against one coordination
+# service.
+_POLL_SLICE_MIN_S = 0.1
+_POLL_SLICE_MAX_S = float(os.environ.get("HVD_KV_POLL_MAX", "2.0"))
 # Max stretch between all-idle rounds. Bounds steady-state KV chatter of a
 # P-process world to O(P^2)/cap reads per second against the coordination
 # service; a fresh enqueue wakes the engine loop immediately (both
@@ -142,12 +150,67 @@ def cache_capacity_from_env() -> int:
         return 1024
 
 
+# Current world epoch (elastic worlds bump it on every reconfiguration;
+# static worlds stay at 0). Carried by KVTimeout messages so a timed-out
+# wait names both the key and the world incarnation it waited in.
+_world_epoch = 0
+
+
+def set_world_epoch(epoch: int):
+    global _world_epoch
+    _world_epoch = int(epoch)
+
+
+def world_epoch() -> int:
+    return _world_epoch
+
+
+# Elastic liveness probe (core/elastic.py registers it): maps a process
+# index to its death-verdict reason, or None while it is presumed alive.
+# Blocked negotiation reads consult it between poll slices so a dead peer
+# fails the round within a heartbeat lease instead of the full
+# negotiation timeout.
+_liveness_probe = None
+
+
+def set_liveness_probe(probe):
+    global _liveness_probe
+    _liveness_probe = probe
+
+
 class KVTimeout(Exception):
-    pass
+    def __init__(self, key: str = "", epoch: Optional[int] = None):
+        self.key = key
+        self.epoch = _world_epoch if epoch is None else int(epoch)
+        super().__init__(
+            f"timed out waiting for KV key '{key}' "
+            f"(world epoch {self.epoch})")
 
 
 class KVError(Exception):
     pass
+
+
+class PeerLost(KVError):
+    """A blocked negotiation read aborted because the awaited peer has an
+    elastic death verdict (missed-heartbeat KV lease) — fail over now
+    instead of waiting out the negotiation timeout."""
+
+    def __init__(self, process: int, reason: str):
+        self.process = process
+        super().__init__(
+            f"process {process} declared dead by the elastic heartbeat "
+            f"lease ({reason}); world epoch {_world_epoch} must "
+            "reconfigure")
+
+
+def _poll_slices(jitter: "random.Random"):
+    """Yield blocked-read slice durations: jittered exponential backoff
+    from _POLL_SLICE_MIN_S to _POLL_SLICE_MAX_S."""
+    s = _POLL_SLICE_MIN_S
+    while True:
+        yield s * jitter.uniform(0.75, 1.25)
+        s = min(s * 2.0, _POLL_SLICE_MAX_S)
 
 
 class PeerShutdown(Exception):
@@ -195,9 +258,24 @@ class JaxKV:
             raise KVError(str(exc)) from None
 
     def get(self, key: str, timeout_s: float) -> str:
+        fn = getattr(self._client, "blocking_key_value_get", None)
+        if fn is None:
+            # No server-side blocking get on this client: emulate with
+            # try_get polls under jittered exponential backoff (a fixed
+            # short-interval spin would hammer the KV store for the
+            # whole wait).
+            deadline = time.monotonic() + timeout_s
+            slices = _poll_slices(random.Random())
+            while True:
+                val = self.try_get(key)
+                if val is not None:
+                    return val
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    raise KVTimeout(key)
+                time.sleep(min(next(slices), remaining))
         try:
-            return self._client.blocking_key_value_get(
-                key, max(1, int(timeout_s * 1000)))
+            return fn(key, max(1, int(timeout_s * 1000)))
         except Exception as exc:  # DEADLINE_EXCEEDED / connection errors
             msg = str(exc)
             if "DEADLINE_EXCEEDED" in msg or "deadline" in msg.lower():
@@ -588,6 +666,9 @@ class Coordinator:
         self.last_tables: Dict[int, set] = {}
         self._last_stall_warn = 0.0
         self._closed = False
+        # Poll-slice jitter stream (blocked reads): seeded per process so
+        # probe trains de-synchronize across the world.
+        self._jitter = random.Random((process_index + 1) * 7919)
         # Control-plane cost accounting (docs/running.md "negotiation
         # cost"): rounds completed, wall time inside negotiate(), and
         # actual KV get attempts (each blocking poll slice counts — the
@@ -738,9 +819,13 @@ class Coordinator:
                 # timeout while a third peer stalls p0's gather (r4
                 # advisor), hence a whole extra timeout_s of grace, not
                 # just poll slack; a DEAD p0 is still caught within one
-                # poll slice by the tombstone check below.
-                deadline += self.timeout_s + 2 * _POLL_SLICE_S
+                # poll slice by the tombstone check below. The slack is
+                # two MAX slices: backed-off polls detect p0's own
+                # deadline with up to one max-slice granularity before
+                # it can republish the error digest.
+                deadline += self.timeout_s + 2 * _POLL_SLICE_MAX_S
         self.waiting_on = peer
+        slices = _poll_slices(self._jitter)
         try:
             while True:
                 if self._closed:
@@ -749,12 +834,19 @@ class Coordinator:
                     # round so engine teardown is not held hostage for the
                     # full negotiation timeout.
                     raise KVError("local engine is shutting down")
+                if _liveness_probe is not None:
+                    verdict = _liveness_probe(peer)
+                    if verdict is not None:
+                        # Elastic death verdict: the peer will never
+                        # publish — fail the round NOW with the
+                        # attribution instead of waiting out timeout_s.
+                        raise PeerLost(peer, verdict)
                 remaining = deadline - time.monotonic()
                 if remaining <= 0:
                     raise NegotiationTimeout(peer, self.timeout_s)
                 try:
                     self.stats["kv_gets"] += 1
-                    raw = self.kv.get(key, min(_POLL_SLICE_S, remaining))
+                    raw = self.kv.get(key, min(next(slices), remaining))
                     msg = json.loads(raw)
                     if digest and "error" in msg:
                         # p0's gather failed; it republished the real

@@ -1,0 +1,790 @@
+"""Elastic worlds — survive rank loss, shrink the mesh, regrow on rejoin.
+
+The reference dies with its first lost peer: MPI is the only control
+plane, and a SIGKILLed rank aborts the world. This module (beyond-
+reference scope — no PARITY row maps to it; see ROADMAP open item 3)
+turns a rank loss into a *reconfiguration*:
+
+1. **Detection — missed-heartbeat KV lease.** Every process beats a
+   sequence counter into the coordination-service KV store
+   (``hvd/elastic/g<gen>/hb/p<pid>``). A peer whose counter stops
+   advancing for ``HVD_ELASTIC_LEASE_S`` (observed on the *reader's*
+   clock — no cross-host clock comparison) hardens into a death
+   verdict; ``NegotiationTimeout``/silent negotiation waits consult the
+   same lease through :func:`coordinator.set_liveness_probe`, so a
+   blocked engine round fails over in seconds instead of waiting out
+   ``HVD_NEGOTIATION_TIMEOUT``. Survivors write a tombstone, dump the
+   flight recorder with the attribution, and flag the world as changed.
+
+2. **Shrink — in-process reconfiguration.** When the survivors of a
+   death verdict are exactly this process's local chips, the world is
+   rebuilt in place: the engine is drained (aborting in-flight
+   negotiation; the response cache dies with its coordinator and the
+   next incarnation starts at a fresh epoch), the poisoned runtime
+   backend is *leaked* (its execution chain holds errors from
+   collectives the dead peer never joined — destroying it would join
+   threads blocked in dead sockets) and a fresh single-process backend
+   is built, the 1-D ``'hvd'`` mesh is re-made over the surviving chips
+   with re-densified ranks, and the trainer resumes from the newest
+   checkpoint through the existing host-first ``broadcast_state``
+   pattern — a recompile, not a crash. Multi-controller survivor sets
+   (and worlds that would drop below ``HVD_ELASTIC_MIN_NP``) take the
+   coordinated-restart path instead: exit with
+   :data:`RESTART_EXIT_CODE` and let the supervisor relaunch the full
+   world from the newest checkpoint (``run.py --elastic``).
+
+3. **Regrow — blacklist-then-readmit.** The supervisor restarts dead
+   children with capped backoff; a recovered rank is blacklisted for
+   ``HVD_ELASTIC_BLACKLIST_S`` (flap protection) before the supervisor
+   files a rejoin request. Survivors see the request at an epoch
+   boundary, checkpoint, and exit for restart; the supervisor relaunches
+   the full world at the next **world epoch**, which resumes from the
+   newest checkpoint and verifies agreement with
+   ``hvd.check_consistency``.
+
+Every transition is observable: ``world.epoch`` / ``world.size`` /
+``world.processes`` / ``world.degraded`` gauges, a ``RECONFIGURE``
+span in the flight dump written per epoch change, and ``/healthz``
+reporting the degraded world (core/sentinel.py).
+
+State shared with the supervisor (join requests, restart votes, the
+epoch journal) lives as files under ``HVD_ELASTIC_DIR`` — it must
+survive the coordination service, whose host may itself be the casualty.
+In-world state (heartbeats, tombstones) rides the existing KV store.
+
+``HVD_ELASTIC`` unset/0 keeps today's fail-fast semantics bit-for-bit:
+nothing here activates, the launcher kills the world on first death, and
+``NegotiationTimeout`` raises untouched.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import threading
+import time
+from typing import Dict, List, Optional
+
+from horovod_tpu.core import telemetry as _tele
+from horovod_tpu.core import timeline as tl
+from horovod_tpu.core.sentinel import _env_float
+
+LOG = logging.getLogger("horovod_tpu.elastic")
+
+#: Exit code a member uses to vote for a coordinated full-world restart
+#: (regrow at an epoch boundary, multi-survivor shrink, below-min-np).
+#: The supervisor (run.py --elastic) relaunches the whole world when it
+#: sees it; anything else keeps ordinary meaning.
+RESTART_EXIT_CODE = 77
+
+
+def enabled() -> bool:
+    """HVD_ELASTIC=1 opts the process into elastic-world semantics."""
+    return os.environ.get("HVD_ELASTIC", "0").lower() not in (
+        "0", "", "false", "off")
+
+
+def lease_s() -> float:
+    """Missed-heartbeat lease: a peer silent this long is dead."""
+    return _env_float("HVD_ELASTIC_LEASE_S", 3.0)
+
+
+def grace_s() -> float:
+    """Startup grace before a *never-heard-from* peer can be declared
+    dead (covers launch/import skew across the cohort)."""
+    return _env_float("HVD_ELASTIC_GRACE_S", 30.0)
+
+
+def blacklist_s() -> float:
+    """Readmission backoff for a recovered host (flap protection) — the
+    supervisor waits this long after a death before filing the rejoin
+    request; doubled per repeat death of the same rank."""
+    return _env_float("HVD_ELASTIC_BLACKLIST_S", 5.0)
+
+
+def min_np() -> int:
+    """Smallest process count the world may shrink to in place
+    (``run.py --elastic --min-np K`` exports it). Below it, survivors
+    vote for a full-world restart instead of training degraded."""
+    try:
+        return max(1, int(os.environ.get("HVD_ELASTIC_MIN_NP", "1")))
+    except ValueError:
+        return 1
+
+
+def generation() -> int:
+    """Supervisor relaunch counter (0 for the first world)."""
+    try:
+        return int(os.environ.get("HVD_ELASTIC_GENERATION", "0"))
+    except ValueError:
+        return 0
+
+
+def elastic_dir() -> Optional[str]:
+    return os.environ.get("HVD_ELASTIC_DIR") or None
+
+
+def checkpoint_dir() -> Optional[str]:
+    """Where elastic training checkpoints live: HVD_CHECKPOINT_DIR, or
+    ``<HVD_ELASTIC_DIR>/ckpt`` when a supervisor runs the world."""
+    explicit = os.environ.get("HVD_CHECKPOINT_DIR")
+    if explicit:
+        return explicit
+    d = elastic_dir()
+    return os.path.join(d, "ckpt") if d else None
+
+
+class WorldChanged(Exception):
+    """A death verdict landed: the current mesh is gone; reconfigure."""
+
+
+class ElasticRestartRequired(Exception):
+    """This transition needs a supervisor-coordinated full-world restart
+    (multi-survivor shrink, below-min-np world, rejoin admission)."""
+
+
+def _write_json_atomic(path: str, payload: dict):
+    tmp = path + ".tmp"
+    with open(tmp, "w") as fh:
+        json.dump(payload, fh)
+    os.replace(tmp, path)
+
+
+def bring_up_distributed(coordinator_address: str, num_processes: int,
+                         process_id: int):
+    """Elastic-mode jax.distributed bring-up.
+
+    The stock ``jax.distributed.initialize`` arms the coordination
+    service's own failure detector: ~100 s after a peer stops
+    heartbeating, the service propagates a fatal error and every
+    surviving client **terminates the process** (LOG(QFATAL) in
+    xla/pjrt/distributed/client.h) — the exact opposite of surviving.
+    Elastic worlds therefore own the bring-up: the service is created
+    with an effectively infinite missed-heartbeat budget (death
+    detection is THIS module's KV lease, not the service's), and the
+    client skips the shutdown barrier at destruction (it can never pass
+    with a dead member). The populated ``global_state`` is the same one
+    the rest of jax reads, so everything downstream is unchanged."""
+    import jax  # noqa: F401  (backend flags must be settable later)
+    from jax._src import distributed as _dist
+    from jax._src.lib import xla_extension as _xe
+
+    gs = _dist.global_state
+    if gs.client is not None:
+        return
+    bind = "[::]:" + coordinator_address.rsplit(":", 1)[1]
+    if process_id == 0 and gs.service is None:
+        gs.service = _xe.get_distributed_runtime_service(
+            bind, num_processes,
+            heartbeat_interval=10, max_missing_heartbeats=1_000_000)
+    gs.client = _xe.get_distributed_runtime_client(
+        coordinator_address, process_id,
+        init_timeout=int(_env_float("HVD_ELASTIC_INIT_TIMEOUT", 120.0)),
+        shutdown_on_destruction=False)
+    gs.client.connect()
+    gs.process_id = process_id
+    gs.num_processes = num_processes
+    gs.coordinator_address = coordinator_address
+    LOG.info("elastic distributed world up: %d process(es), this is %d",
+             num_processes, process_id)
+
+
+class ElasticWorld:
+    """Per-process elastic state machine (singleton via
+    :func:`get_world`). Inert until :meth:`on_init` sees a live
+    topology with elastic enabled."""
+
+    def __init__(self):
+        self.active = False
+        self.epoch = 0
+        self.pid = 0             # process index in the CURRENT world
+        self.nproc = 1
+        self.initial_np = 1
+        self.live: List[int] = []
+        self.dead: Dict[int, str] = {}
+        self.generation = generation()
+        self._changed = threading.Event()
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._kv = None
+        self._seq = 0
+        self._started_at = time.monotonic()
+        # peer -> (last value seen, monotonic time it last CHANGED):
+        # liveness is judged by the counter advancing on OUR clock, so
+        # cross-host wall-clock skew can never fake a death.
+        self._beats: Dict[int, tuple] = {}
+        # Peers with a standing announce_done mark (no verdicts for
+        # them until they announce_active again).
+        self._done_peers: set = set()
+        # Backend objects deliberately kept alive forever after a
+        # shrink: destroying a runtime whose execution chain still holds
+        # threads blocked in a dead peer's sockets is undefined.
+        self._leaked: list = []
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def on_init(self, num_processes: int, process_index: int):
+        """Called from ``topology.init`` once the world is known."""
+        if not enabled():
+            return
+        self.active = True
+        self.pid = process_index
+        self.nproc = num_processes
+        if not self.live:
+            self.initial_np = num_processes
+            self.live = list(range(num_processes))
+        self.generation = generation()
+        self._load_journal()
+        self._publish_gauges()
+        from horovod_tpu.core import coordinator as _coord
+
+        _coord.set_world_epoch(self.epoch)
+        _coord.set_liveness_probe(self.peer_is_dead)
+        if num_processes > 1 and (self._thread is None
+                                  or not self._thread.is_alive()):
+            # is_alive check: the loop self-terminates when a shrink
+            # drops the world to one controller — a later re-entry into
+            # a multi-process world must get a FRESH lease thread, not
+            # a dead handle.
+            self._started_at = time.monotonic()
+            self._thread = threading.Thread(
+                target=self._beat_loop, name="hvd-elastic-heartbeat",
+                daemon=True)
+            self._thread.start()
+        if self.pid == 0 and elastic_dir() and self.epoch == 0 \
+                and self.generation == 0:
+            self._write_journal("init")
+
+    def _load_journal(self):
+        """Adopt the epoch journal (monotonic across supervisor
+        generations): a relaunched generation continues the epoch
+        sequence instead of restarting it at 0."""
+        d = elastic_dir()
+        if not d:
+            return
+        try:
+            with open(os.path.join(d, "epoch.json")) as fh:
+                rec = json.load(fh)
+            prev = int(rec.get("epoch", 0))
+        except (OSError, ValueError):
+            return
+        if self.generation > int(rec.get("generation", 0)) \
+                or rec.get("restart_pending"):
+            # This is the relaunched world after a coordinated restart:
+            # the regrow/restart transition is the epoch bump.
+            self.epoch = prev + 1
+            if self.pid == 0:
+                self._write_journal("regrow")
+        else:
+            self.epoch = max(self.epoch, prev)
+
+    def _write_journal(self, kind: str, **extra):
+        d = elastic_dir()
+        if not d:
+            return
+        try:
+            os.makedirs(d, exist_ok=True)
+            _write_json_atomic(os.path.join(d, "epoch.json"), {
+                "epoch": self.epoch, "kind": kind, "np": self.nproc,
+                "generation": self.generation,
+                "dead": sorted(self.dead),
+                "wall": round(time.time(), 3), **extra})
+        except OSError as exc:
+            LOG.warning("cannot write elastic epoch journal: %s", exc)
+
+    def _publish_gauges(self):
+        """world.* gauges — /healthz, utils/stats and telemetry_report
+        all read these."""
+        try:
+            from horovod_tpu.common import topology as topo
+
+            size = topo.size() if topo.is_initialized() else 0
+        except Exception:
+            size = 0
+        _tele.REGISTRY.gauge("world.epoch").set(self.epoch)
+        _tele.REGISTRY.gauge("world.size").set(size)
+        _tele.REGISTRY.gauge("world.processes").set(self.nproc)
+        _tele.REGISTRY.gauge("world.initial_processes").set(self.initial_np)
+        _tele.REGISTRY.gauge("world.degraded").set(
+            1 if self.nproc < self.initial_np else 0)
+
+    # -- heartbeat lease ------------------------------------------------------
+
+    def _ns(self) -> str:
+        return f"hvd/elastic/g{self.generation}"
+
+    def _hb_key(self, p: int) -> str:
+        return f"{self._ns()}/hb/p{p}"
+
+    def _done_key(self, p: int) -> str:
+        return f"{self._ns()}/done/p{p}"
+
+    def _tomb_key(self, p: int) -> str:
+        return f"{self._ns()}/dead/p{p}"
+
+    def _get_kv(self):
+        if self._kv is None:
+            from horovod_tpu.core import coordinator as _coord
+
+            self._kv = _coord.JaxKV()
+        return self._kv
+
+    def _beat_loop(self):
+        interval = max(0.1, lease_s() / 4.0)
+        while not self._stop.wait(interval):
+            if not self._beat_once():
+                return
+
+    def _beat_once(self) -> bool:
+        """One heartbeat tick: publish our counter, judge each peer's.
+        Returns False when the loop should stop (lone controller)."""
+        with self._lock:
+            if self.nproc <= 1:
+                return False  # shrunk to a lone controller: no lease
+            peers = [p for p in self.live
+                     if p != self.pid and p not in self.dead]
+        try:
+            kv = self._get_kv()
+        except Exception:
+            return True  # coordination service not up yet
+        self._seq += 1
+        try:
+            # The coordination-service KV is INSERT-ONLY (a second set
+            # of the same key fails ALREADY_EXISTS): each beat deletes
+            # then re-inserts. A reader landing in the gap sees a
+            # missing key for one tick, which deliberately does NOT
+            # advance any verdict below.
+            kv.delete(self._hb_key(self.pid))
+            kv.set(self._hb_key(self.pid), str(self._seq))
+        except Exception:
+            return True  # KV down: rank 0 died — supervisor territory
+        now = time.monotonic()
+        for p in peers:
+            try:
+                val = kv.try_get(self._hb_key(p))
+                tomb = kv.try_get(self._tomb_key(p))
+                done = kv.try_get(self._done_key(p))
+            except Exception:
+                break
+            if done is not None:
+                # The peer ANNOUNCED completion (announce_done) before
+                # going silent: that is a finished rank, not a casualty
+                # — no verdict while the mark stands. (Without this,
+                # the first rank to finish a job would be "dead" to any
+                # slower peer.) The mark is revocable: announce_active
+                # (a later fit) deletes the key and normal leasing
+                # resumes, so the beat clock keeps updating below.
+                if p not in self._done_peers:
+                    self._done_peers.add(p)
+                    LOG.info("elastic: process %d announced completion",
+                             p)
+                if val is not None:
+                    last = self._beats.get(p)
+                    if last is None or last[0] != val:
+                        self._beats[p] = (val, now)
+                continue
+            if p in self._done_peers:
+                # Mark revoked (announce_active): grant a fresh lease —
+                # the clock may have run out while the mark stood, and
+                # an instant verdict on revocation would punish a peer
+                # for having finished politely.
+                self._done_peers.discard(p)
+                if val is not None:
+                    self._beats[p] = (val, now)
+            if tomb is not None:
+                self._declare_dead(p, "peer tombstone: " + str(tomb)[:200])
+                continue
+            if val is None:
+                # Never-heard-from peer past the startup grace is dead.
+                # A peer we HAVE seen is usually just mid delete->set
+                # gap — but a key missing for a whole lease means the
+                # peer died INSIDE its gap and will never re-insert.
+                last = self._beats.get(p)
+                if last is None:
+                    if now - self._started_at > grace_s():
+                        self._declare_dead(
+                            p, f"no heartbeat within the "
+                               f"{grace_s():.0f}s startup grace")
+                elif now - last[1] > lease_s():
+                    self._declare_dead(
+                        p, f"heartbeat key vanished and stayed gone "
+                           f"({now - last[1]:.1f}s > "
+                           f"{lease_s():.1f}s lease)")
+                continue
+            last = self._beats.get(p)
+            if last is None or last[0] != val:
+                self._beats[p] = (val, now)
+            elif now - last[1] > lease_s():
+                self._declare_dead(
+                    p, f"heartbeat lease expired "
+                       f"({now - last[1]:.1f}s > "
+                       f"{lease_s():.1f}s without a beat)")
+        return True
+
+    def _declare_dead(self, p: int, reason: str):
+        with self._lock:
+            if p in self.dead:
+                return
+            self.dead[p] = reason
+        LOG.error("elastic death verdict: process %d is dead (%s); "
+                  "world epoch %d will reconfigure", p, reason, self.epoch)
+        _tele.REGISTRY.counter("world.deaths").inc()
+        try:
+            self._get_kv().set(self._tomb_key(p),
+                               json.dumps({"by": self.pid,
+                                           "reason": reason}))
+        except Exception:
+            pass
+        d = elastic_dir()
+        if d:
+            try:
+                os.makedirs(os.path.join(d, "death"), exist_ok=True)
+                _write_json_atomic(
+                    os.path.join(d, "death", f"p{p}.json"),
+                    {"process": p, "reason": reason, "by": self.pid,
+                     "generation": self.generation, "epoch": self.epoch,
+                     "wall": round(time.time(), 3)})
+            except OSError:
+                pass
+        # The attributed post-mortem, while the engine ring still holds
+        # the rounds that stalled on the dead peer.
+        self._dump(f"death verdict: process {p} ({reason}); "
+                   f"world epoch {self.epoch} reconfiguring")
+        self._changed.set()
+
+    def _dump(self, reason: str):
+        try:
+            fdir = os.environ.get("HVD_FLIGHT_DIR")
+            if fdir:
+                os.makedirs(fdir, exist_ok=True)
+            events = []
+            from horovod_tpu.core import engine as _eng
+
+            e = _eng._engine
+            if e is not None:
+                if hasattr(e, "recent_events"):
+                    events = list(e.recent_events())
+                else:
+                    events = list(e.timeline.recent())
+            last_ts = events[-1].get("ts") if events else 0
+            base = int(last_ts) if isinstance(last_ts, (int, float)) else 0
+            # The RECONFIGURE span: trace-merge-compatible events framing
+            # the transition next to the rounds that led to it.
+            events.append({"name": "RECONFIGURE", "ph": "B",
+                           "ts": base + 1, "args": {"reason": reason,
+                                                    "epoch": self.epoch}})
+            events.append({"name": "RECONFIGURE", "ph": "E",
+                           "ts": base + 2})
+            tl.dump_and_warn(events, reason, tl._process_index(), LOG)
+        except Exception:
+            LOG.warning("elastic flight dump failed", exc_info=True)
+
+    # -- verdict surface ------------------------------------------------------
+
+    def peer_is_dead(self, p: int) -> Optional[str]:
+        """Liveness probe (also wired into coordinator._read_peer): the
+        death reason when process ``p`` has a verdict, else None."""
+        with self._lock:
+            return self.dead.get(p)
+
+    def world_changed(self) -> bool:
+        return self._changed.is_set()
+
+    def dead_peers(self) -> Dict[int, str]:
+        with self._lock:
+            return dict(self.dead)
+
+    def await_verdict(self, timeout_s: float) -> bool:
+        """Wait briefly for a death verdict — used when a step raised and
+        the caller needs to know whether a dying peer explains it."""
+        return self._changed.wait(timeout_s)
+
+    # -- reconfiguration ------------------------------------------------------
+
+    def reconfigure(self):
+        """Act on the death verdict: shrink the world in place when the
+        survivors are exactly this controller's chips, else raise
+        :class:`ElasticRestartRequired` for the supervisor path. Returns
+        the new world epoch."""
+        with self._lock:
+            dead = dict(self.dead)
+            survivors = sorted(p for p in self.live if p not in dead)
+        if not dead:
+            return self.epoch
+        if len(survivors) < min_np():
+            raise ElasticRestartRequired(
+                f"{len(survivors)} survivor(s) < --min-np {min_np()}; "
+                "waiting for the supervisor to regrow the world")
+        if survivors != [self.pid]:
+            raise ElasticRestartRequired(
+                f"survivors {survivors} span multiple controllers; "
+                "in-place shrink needs a coordinated restart")
+        t0 = time.monotonic()
+        old_epoch, old_np = self.epoch, self.nproc
+        self._mark_reconfigure_on_timeline()
+        from horovod_tpu.common import topology as topo
+
+        LOG.warning("elastic shrink: draining the engine and tearing "
+                    "down world epoch %d", old_epoch)
+        topo.shutdown()  # drains the engine; aborts in-flight negotiation
+        LOG.warning("elastic shrink: old world down; rebuilding a "
+                    "single-controller backend over the local chips")
+        devs = self._rebuild_local_backend()
+        topo.init(devices=devs)
+        with self._lock:
+            self.epoch = old_epoch + 1
+            self.nproc = 1
+            self.pid = 0  # ranks re-densified: the lone controller is 0
+            self.live = [0]
+            self._changed.clear()
+            self.dead = {}
+            dead_list = sorted(dead)
+        from horovod_tpu.core import coordinator as _coord
+
+        _coord.set_world_epoch(self.epoch)
+        self._write_journal("shrink", lost=dead_list)
+        self._publish_gauges()
+        _tele.REGISTRY.counter("world.reconfigures").inc()
+        reason = (f"RECONFIGURE: world epoch {old_epoch} -> {self.epoch}; "
+                  f"lost process(es) {dead_list} "
+                  f"({'; '.join(dead[p] for p in dead_list)}); "
+                  f"continuing with 1/{old_np} controller(s), "
+                  f"{len(devs)} rank(s), after "
+                  f"{time.monotonic() - t0:.1f}s")
+        LOG.warning(reason)
+        self._dump(reason)
+        return self.epoch
+
+    def _mark_reconfigure_on_timeline(self):
+        """Best-effort RECONFIGURE instant on the live engine timeline
+        before it is torn down — per-rank traces then carry the
+        transition, not just the flight dumps."""
+        try:
+            from horovod_tpu.core import engine as _eng
+
+            e = _eng._engine
+            if e is None:
+                return
+            if hasattr(e, "_lib") and getattr(e, "_ptr", None):
+                e._lib.hvd_engine_timeline_instant(
+                    e._ptr, b"world", b"RECONFIGURE",
+                    f'"epoch":{self.epoch}'.encode())
+            elif hasattr(e, "timeline"):
+                e.timeline.instant("world", "RECONFIGURE",
+                                   {"epoch": self.epoch})
+        except Exception:
+            pass
+
+    def _rebuild_local_backend(self):
+        """Swap in a fresh single-process runtime.
+
+        The old backend's collective-execution chain is poisoned: the
+        program in flight when the peer died eventually fails with a
+        socket error, and every execution enqueued after it inherits the
+        error forever. The old client (and the arrays living on it) is
+        LEAKED — its destructor would join threads still blocked inside
+        the dead peer's sockets — and a new backend is created with the
+        distributed client detached, so it comes up single-process with
+        in-process collectives only."""
+        import jax
+        from jax._src import distributed as _dist
+
+        gs = _dist.global_state
+        try:
+            self._leaked.append(jax.local_devices()[0].client)
+        except Exception:
+            pass
+        kv_client = gs.client
+        self._leaked.append(kv_client)
+        gs.client = None
+        gs.num_processes = 1
+        gs.process_id = 0
+        try:
+            if jax.default_backend() == "cpu":
+                # The fresh CPU client must not re-wire gloo over the
+                # dead world's store.
+                jax.config.update(
+                    "jax_cpu_collectives_implementation", "none")
+        except Exception:
+            pass
+        try:
+            jax.clear_backends()
+        except AttributeError:  # removed from the jax namespace in 0.4.36
+            from jax._src import api as _api
+
+            _api.clear_backends()
+        jax.clear_caches()
+        # (topology.shutdown — already run by reconfigure — cleared the
+        # mesh-keyed program and zero-tree caches.)
+        devs = jax.devices()
+        # The KV plane stays reachable (tombstone reads, debugging);
+        # jax's own world-size view remains 1.
+        gs.client = kv_client
+        return devs
+
+    # -- supervisor protocol (files under HVD_ELASTIC_DIR) -------------------
+
+    def restart_requested(self) -> Optional[str]:
+        """A pending coordinated-restart request (rejoin admission filed
+        by the supervisor, or a member's restart vote), or None."""
+        d = elastic_dir()
+        if not d:
+            return None
+        try:
+            rejoin = os.path.join(d, "rejoin")
+            if os.path.isdir(rejoin):
+                reqs = [f for f in os.listdir(rejoin)
+                        if f.endswith(".json")]
+                if reqs:
+                    return f"rejoin request(s) pending: {sorted(reqs)}"
+            if os.path.exists(os.path.join(d, "restart.json")):
+                with open(os.path.join(d, "restart.json")) as fh:
+                    return json.load(fh).get("reason", "restart requested")
+        except (OSError, ValueError):
+            return None
+        return None
+
+    def request_restart(self, reason: str):
+        d = elastic_dir()
+        if not d:
+            return
+        try:
+            os.makedirs(d, exist_ok=True)
+            _write_json_atomic(os.path.join(d, "restart.json"),
+                               {"reason": reason, "by": self.pid,
+                                "generation": self.generation,
+                                "wall": round(time.time(), 3)})
+        except OSError as exc:
+            LOG.warning("cannot file restart request: %s", exc)
+
+    def exit_for_restart(self, reason: str):
+        """Leave the process with the supervisor's restart exit code.
+        ``os._exit``: interpreter teardown would hang in the distributed
+        client/backend destructors of a world with dead members."""
+        # A restart voter going silent must read as a PLANNED exit, not
+        # a casualty: without the done mark, peers still mid-epoch
+        # lease-verdict this rank and shrink pointlessly before
+        # honoring the same restart request themselves.
+        self.announce_done()
+        self._write_journal("restart_pending", restart_pending=True,
+                            reason=reason)
+        LOG.warning("elastic coordinated restart: %s (exiting with "
+                    "code %d for the supervisor)", reason,
+                    RESTART_EXIT_CODE)
+        self._dump(f"RECONFIGURE: coordinated restart ({reason})")
+        try:
+            import sys
+
+            sys.stdout.flush()
+            sys.stderr.flush()
+        except Exception:
+            pass
+        os._exit(RESTART_EXIT_CODE)
+
+    def park(self, obj):
+        """Keep ``obj`` alive for the rest of the process (the public
+        face of the leak list): state donated into a wedged old-world
+        execution must never run its destructor — it can block inside
+        the dead runtime."""
+        self._leaked.append(obj)
+
+    def announce_done(self):
+        """Tell the cohort this process finished its training work
+        CLEANLY (``Trainer.fit`` calls it at train end; custom loops
+        should too, before their final barriers, while the whole cohort
+        is still up): silent-after-done peers get no death verdict —
+        the last ranks of a finishing job must not shrink the world out
+        from under each other. Revoked by :meth:`announce_active`."""
+        if not self.active or self.nproc <= 1:
+            return
+        try:
+            kv = self._get_kv()
+            kv.delete(self._done_key(self.pid))  # insert-only store
+            kv.set(self._done_key(self.pid), str(round(time.time(), 3)))
+        except Exception:
+            pass
+
+    def announce_active(self):
+        """Revoke a standing completion mark (a later ``fit`` on the
+        same world): peers resume leasing this process normally."""
+        if not self.active or self.nproc <= 1:
+            return
+        try:
+            self._get_kv().delete(self._done_key(self.pid))
+        except Exception:
+            pass
+
+    def shutdown(self):
+        self._stop.set()
+
+    # -- reporting ------------------------------------------------------------
+
+    def summary(self) -> Optional[dict]:
+        if not self.active:
+            return None
+        try:
+            from horovod_tpu.common import topology as topo
+
+            size = topo.size() if topo.is_initialized() else 0
+        except Exception:
+            size = 0
+        with self._lock:
+            return {"epoch": self.epoch, "generation": self.generation,
+                    "size": size, "processes": self.nproc,
+                    "initial_processes": self.initial_np,
+                    "degraded": self.nproc < self.initial_np,
+                    "dead": dict(self.dead)}
+
+
+_world: Optional[ElasticWorld] = None
+_world_lock = threading.Lock()
+
+
+def get_world() -> ElasticWorld:
+    global _world
+    with _world_lock:
+        if _world is None:
+            _world = ElasticWorld()
+        return _world
+
+
+def reset_world():
+    """Tests only: drop the singleton so a fresh env is re-read."""
+    global _world
+    with _world_lock:
+        if _world is not None:
+            _world.shutdown()
+        _world = None
+
+
+def active() -> bool:
+    return enabled() and get_world().active
+
+
+def world_summary() -> Optional[dict]:
+    """The /healthz ``world`` section (None when elastic is off)."""
+    if not enabled() or _world is None:
+        return None
+    return _world.summary()
+
+
+def maybe_restore(trainer, x_sample) -> int:
+    """Resume a Trainer from the newest elastic checkpoint; returns the
+    epoch to resume AT (0 when there is nothing to restore). The restore
+    broadcasts from root — the host-first pattern — so every member of a
+    regrown world starts bitwise-identical."""
+    from horovod_tpu.utils import checkpoint as _ckpt
+
+    d = checkpoint_dir()
+    if not d:
+        return 0
+    path = _ckpt.latest_checkpoint(d)
+    if not path:
+        return 0
+    trainer.load(path, x_sample)
+    trainer.broadcast_state()
+    LOG.info("elastic resume: restored %s (epoch %d)", path,
+             trainer._epoch)
+    return int(trainer._epoch) + 1

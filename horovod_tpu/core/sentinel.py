@@ -792,8 +792,22 @@ class Sentinel:
             numerics = _num.summary()
         except Exception:  # pragma: no cover - defensive
             numerics = None
+        # Elastic world state (core/elastic.py): a shrunk world is a
+        # DEGRADED deployment even when every surviving step is healthy
+        # — /healthz must say so until the mesh regrows.
+        world = None
+        try:
+            from horovod_tpu.core import elastic as _elastic
+
+            world = _elastic.world_summary()
+        except Exception:  # pragma: no cover - defensive
+            pass
+        if world is not None and world.get("degraded") \
+                and status in ("ok", "init"):
+            status = "warn"
         return {
             "status": status,
+            "world": world,
             "rank": tl._process_index(),
             "pid": os.getpid(),
             "enabled": self.enabled,

@@ -451,13 +451,42 @@ def telemetry() -> dict:
     strag = STRAGGLERS.snapshot()
     if strag["tensors"]:
         out["straggler"] = strag
+    world = _world_lines(as_dict=True)
+    if world:
+        out["world"] = world
     return out
+
+
+def _world_lines(as_dict: bool = False):
+    """Elastic world state (core/elastic.py world.* gauges) for the
+    report surfaces: None/[] when the process is not an elastic world
+    member."""
+    try:
+        from horovod_tpu.core import elastic as _elastic
+
+        world = _elastic.world_summary()
+    except Exception:  # pragma: no cover - defensive
+        world = None
+    if as_dict:
+        return world
+    if world is None:
+        return []
+    line = (f"world: epoch {world['epoch']} "
+            f"size {world['size']} "
+            f"({world['processes']}/{world['initial_processes']} "
+            f"process(es), generation {world['generation']})")
+    if world.get("degraded"):
+        line += " DEGRADED"
+        if world.get("dead"):
+            line += " — lost process(es) " + ", ".join(
+                str(p) for p in sorted(world["dead"]))
+    return [line]
 
 
 def report() -> str:
     """Human-readable table — the ``hvd.telemetry_report()`` surface."""
     out = REGISTRY.report()
-    lines = STRAGGLERS.report_lines()
+    lines = _world_lines() + STRAGGLERS.report_lines()
     return out + ("\n" + "\n".join(lines) if lines else "")
 
 

@@ -14,6 +14,8 @@ from __future__ import annotations
 
 import collections.abc
 import math
+import queue as _queue
+import threading
 import time
 from typing import Any, Callable, Optional, Sequence
 
@@ -44,6 +46,7 @@ from horovod_tpu.jax.sharded import (
     drift_ulp as _drift_ulp,
     has_master_shards as _has_master_shards,
 )
+from horovod_tpu.core import elastic as _elastic
 from horovod_tpu.core import numerics as _numerics
 from horovod_tpu.core import sentinel as _sentinel
 from horovod_tpu.core import telemetry as _tele
@@ -51,6 +54,10 @@ from horovod_tpu.keras import callbacks  # noqa: F401
 from horovod_tpu.ops import collectives as _ops
 from horovod_tpu.ops.collectives import HVD_AXIS
 from horovod_tpu.utils import checkpoint as _ckpt
+
+import logging as _logging
+
+_ELASTIC_LOG = _logging.getLogger("horovod_tpu.elastic.trainer")
 
 
 def _default_loss(logits, labels):
@@ -129,6 +136,49 @@ class _LazyLogs(collections.abc.MutableMapping):
         return repr(self.copy())
 
 
+class _SacrificialDispatcher:
+    """Runs closures on a worker thread so the caller can ABANDON a call
+    that wedged (elastic worlds, core/elastic.py).
+
+    A peer dying at the wrong instant can block the runtime's dispatch
+    path itself, synchronously, inside C++ — past any point where
+    Python-level recovery could run. Dispatching from a sacrificial
+    thread keeps the main thread free to observe the death verdict and
+    reconfigure; a wedged worker is simply leaked along with the
+    poisoned backend (it blocks with the GIL released, so it costs a
+    thread, not the process)."""
+
+    def __init__(self):
+        self._req: "_queue.Queue" = _queue.Queue()
+        self._res: "_queue.Queue" = _queue.Queue()
+        self._thread = threading.Thread(
+            target=self._loop, name="hvd-elastic-dispatch", daemon=True)
+        self._thread.start()
+
+    def _loop(self):
+        while True:
+            fn = self._req.get()
+            try:
+                self._res.put(("ok", fn()))
+            except BaseException as exc:  # surfaced to the caller
+                self._res.put(("exc", exc))
+
+    def call(self, fn, poll: Callable[[], None]):
+        """Run ``fn()`` on the worker; ``poll()`` runs every few ms and
+        may raise (the death-verdict escape) — the in-flight call is
+        then abandoned and this dispatcher must be discarded."""
+        self._req.put(fn)
+        while True:
+            try:
+                kind, val = self._res.get(timeout=0.005)
+            except _queue.Empty:
+                poll()
+                continue
+            if kind == "exc":
+                raise val
+            return val
+
+
 class Trainer:
     """Compiled data-parallel fit/evaluate loop over the world mesh.
 
@@ -188,6 +238,12 @@ class Trainer:
         self._eval_step = None
         self._epoch = 0
         self._gstep = 0  # global step counter (numerics attribution)
+        self._elastic_dispatcher: Optional[_SacrificialDispatcher] = None
+        # Elastic: the previous step's donated state, parked until the
+        # NEXT dispatcher call releases it on the worker thread —
+        # releasing donated buffers can block inside a dead runtime, so
+        # the main thread must never hold their last reference.
+        self._elastic_graveyard: list = []
 
     # -- state ---------------------------------------------------------------
 
@@ -443,9 +499,18 @@ class Trainer:
         ``on_batch_end`` receives a :class:`_LazyLogs` mapping — values
         are fetched from device only when read (reads yield Python
         floats; writes land in a host overlay that reaches the epoch
-        history). ``on_epoch_end`` receives a plain float dict."""
+        history). ``on_epoch_end`` receives a plain float dict.
+
+        With ``HVD_ELASTIC=1`` (core/elastic.py) the loop survives rank
+        loss: a death verdict raises out of the epoch, the world is
+        reconfigured (mesh over survivors, fresh compiled steps), the
+        newest elastic checkpoint is restored and broadcast, and
+        training continues at the restored epoch — a recompile, not a
+        crash. Epoch boundaries write the elastic checkpoint and honor
+        supervisor restart requests (rejoin/regrow)."""
         x, y = np.asarray(x), np.asarray(y)
-        self.build(x[:batch_size * max(local_size(), 1)])
+        x_sample = x[:batch_size * max(local_size(), 1)]
+        self.build(x_sample)
         if self._train_step is None:
             self._build_steps()
         self.steps_per_epoch = len(x) // (batch_size * local_size())
@@ -454,63 +519,166 @@ class Trainer:
         history: dict = {}
         for cb in callbacks:
             cb.on_train_begin()
-        for epoch in range(initial_epoch, epochs):
-            self._epoch = epoch
+        elastic_on = _elastic.active()
+        if elastic_on:
+            # A new fit revokes any standing completion mark (peers
+            # resume leasing us), and train end announces completion so
+            # the last rank to finish is not verdicted dead.
+            _elastic.get_world().announce_active()
+        epoch = initial_epoch
+        while epoch < epochs:
+            try:
+                self._run_epoch(epoch, x, y, batch_size, shuffle,
+                                callbacks, validation_data, history,
+                                verbose, elastic_on)
+            except _elastic.WorldChanged:
+                if not elastic_on:
+                    raise
+                _ELASTIC_LOG.warning(
+                    "elastic recovery: death verdict observed at epoch "
+                    "%d; reconfiguring", epoch)
+                epoch = self._elastic_recover(x_sample)
+                # Recovery replays every epoch since the newest
+                # checkpoint: drop the replayed epochs' history entries
+                # so each index keeps exactly one record. (Epoch-indexed
+                # callbacks still observe a replayed epoch twice — the
+                # documented cost of checkpoint-granularity recovery.)
+                for k in history:
+                    del history[k][max(0, epoch - initial_epoch):]
+                continue
+            epoch += 1
+        for cb in callbacks:
+            cb.on_train_end()
+        if elastic_on:
+            _elastic.get_world().announce_done()
+        return history
+
+    def _run_epoch(self, epoch, x, y, batch_size, shuffle, callbacks,
+                   validation_data, history, verbose, elastic_on):
+        self._epoch = epoch
+        for cb in callbacks:
+            cb.on_epoch_begin(epoch)
+        lazy = _LazyLogs({})
+        batches = self._batches(x, y, batch_size, shuffle, seed=epoch)
+        nxt, b = next(batches, None), 0
+        prev_step = None  # elastic: last step's device loss (readiness)
+        while nxt is not None:
+            xb, yb = nxt
             for cb in callbacks:
-                cb.on_epoch_begin(epoch)
-            lazy = _LazyLogs({})
-            batches = self._batches(x, y, batch_size, shuffle, seed=epoch)
-            nxt, b = next(batches, None), 0
-            while nxt is not None:
-                xb, yb = nxt
-                for cb in callbacks:
-                    cb.on_batch_begin(b)
-                self.rng, dk = jax.random.split(self.rng)
-                t_step = time.perf_counter()
-                self.params, self.batch_stats, self.opt_state, logs = \
-                    self._train_step(self.params, self.batch_stats,
-                                     self.opt_state, xb, yb,
-                                     jnp.float32(self.lr_scale), dk)
-                # Compiled-path telemetry: dispatch time of the whole step
-                # program (execution is async — the ring records the host
-                # cost of handing work to the runtime; wall step time
-                # shows up in the inter-dispatch cadence).
-                t_step = time.perf_counter() - t_step
-                _tele.REGISTRY.counter("trainer.steps").inc()
-                _tele.REGISTRY.ring("trainer.step_s").push(t_step)
-                # Performance sentinel: the wall step time feeds the
-                # trainer watchdog (anomaly -> flight dump + bounded
-                # capture + attributed verdict) and drives periodic
-                # auto-capture (HVD_PROFILE_DIR) — see core/sentinel.py.
-                _sentinel.observe_step(t_step, origin="trainer")
-                # Prefetch: the step above dispatched asynchronously;
-                # pulling the next batch NOW overlaps its host->device
-                # transfers with the running step (the role tf.data
-                # prefetching plays for reference keras users — without
-                # it, per-batch feed+fetch serializes with compute:
-                # together with the device-resident logs below, measured
-                # 2.1x on the tunneled chip, docs/benchmarks.md).
-                nxt = next(batches, None)
-                # Numerics: pop the device-resident health dict BEFORE
-                # the logs proxy (callbacks must not see — or float() —
-                # the per-rank vector); checked on the numerics cadence.
-                self._gstep += 1
-                health = (logs.pop("_numerics", None)
-                          if isinstance(logs, dict) else None)
-                if health is not None:
+                cb.on_batch_begin(b)
+            if elastic_on:
+                # Never dispatch into a world with a death verdict (the
+                # collective would wedge behind the dead peer), and keep
+                # the in-flight window at ONE step: the runtime's
+                # dispatch queue is finite, and a deeper backlog behind
+                # a dead peer's collective blocks the dispatch call
+                # itself — past any point where recovery could run. The
+                # one-step lag keeps the device busy (step N executes
+                # while the host preps N+1); only the await's poll
+                # granularity is added latency.
+                self._elastic_guard()
+                self._elastic_await(prev_step)
+            t_step = time.perf_counter()
+            # The split stays on the main thread (tiny, non-donating —
+            # cannot wedge) so the worker closure below never mutates
+            # trainer state: an abandoned call that unwedges after
+            # recovery must have nothing to clobber.
+            self.rng, dk = jax.random.split(self.rng)
+
+            # Everything the step touches is bound at CLOSURE CREATION
+            # (default args), not call time: an abandoned worker that
+            # unwedges after recovery then re-dispatches only into the
+            # OLD world's objects — it can never reach the rebuilt step
+            # or the recovered state.
+            def _one_step(xb=xb, yb=yb, dk=dk, step_fn=self._train_step,
+                          params=self.params, bs=self.batch_stats,
+                          opt=self.opt_state,
+                          grave=self._elastic_graveyard):
+                if elastic_on:
+                    # Release the PREVIOUS step's parked donated state
+                    # here, on the abandonable worker: dropping buffers
+                    # donated into an execution wedged behind a dead
+                    # peer blocks inside the runtime.
+                    grave.clear()
+                return step_fn(params, bs, opt, xb, yb,
+                               jnp.float32(self.lr_scale), dk)
+
+            try:
+                out = (self._elastic_call(_one_step) if elastic_on
+                       else _one_step())
+                if elastic_on:
+                    # Park-then-rebind: the old references stay alive in
+                    # the graveyard, so these assignments never run a
+                    # (possibly blocking) destructor on the main thread
+                    # — and the worker never mutates trainer state, so
+                    # an abandoned call that completes later cannot
+                    # clobber a recovered world.
+                    self._elastic_graveyard.append(
+                        (self.params, self.batch_stats, self.opt_state))
+                self.params, self.batch_stats, self.opt_state, logs = out
+            except Exception as exc:
+                self._elastic_translate(exc, elastic_on)
+                raise
+            # Compiled-path telemetry: dispatch time of the whole step
+            # program (execution is async — the ring records the host
+            # cost of handing work to the runtime; wall step time
+            # shows up in the inter-dispatch cadence).
+            t_step = time.perf_counter() - t_step
+            _tele.REGISTRY.counter("trainer.steps").inc()
+            _tele.REGISTRY.ring("trainer.step_s").push(t_step)
+            # Performance sentinel: the wall step time feeds the
+            # trainer watchdog (anomaly -> flight dump + bounded
+            # capture + attributed verdict) and drives periodic
+            # auto-capture (HVD_PROFILE_DIR) — see core/sentinel.py.
+            _sentinel.observe_step(t_step, origin="trainer")
+            # Prefetch: the step above dispatched asynchronously;
+            # pulling the next batch NOW overlaps its host->device
+            # transfers with the running step (the role tf.data
+            # prefetching plays for reference keras users — without
+            # it, per-batch feed+fetch serializes with compute:
+            # together with the device-resident logs below, measured
+            # 2.1x on the tunneled chip, docs/benchmarks.md).
+            nxt = next(batches, None)
+            # Numerics: pop the device-resident health dict BEFORE
+            # the logs proxy (callbacks must not see — or float() —
+            # the per-rank vector); checked on the numerics cadence.
+            self._gstep += 1
+            health = (logs.pop("_numerics", None)
+                      if isinstance(logs, dict) else None)
+            if health is not None:
+                if elastic_on:
+                    # The intake device_gets this step's health — a
+                    # blocking fetch that wedges on a step the dead peer
+                    # never joins; dispatcher-routed like the step.
+                    self._elastic_call(
+                        lambda h=health: self._note_numerics(h))
+                else:
                     self._note_numerics(health)
-                # Batch logs stay device-resident (fetching every batch
-                # costs a full host round trip); the proxy converts any
-                # value a callback actually reads to a Python float at
-                # that moment, so float-expecting callbacks keep working
-                # and pay only for what they read.
-                lazy = _LazyLogs(logs)
+            # Batch logs stay device-resident (fetching every batch
+            # costs a full host round trip); the proxy converts any
+            # value a callback actually reads to a Python float at
+            # that moment, so float-expecting callbacks keep working
+            # and pay only for what they read.
+            if elastic_on and isinstance(logs, dict):
+                prev_step = logs.get("loss")
+            lazy = _LazyLogs(logs)
+            if elastic_on and callbacks:
+                # A callback reading lazy logs performs a blocking
+                # device fetch of this step's outputs — dispatcher-
+                # routed like every other fetch that could wedge behind
+                # a dead peer.
+                self._elastic_call(
+                    lambda b=b, lazy=lazy: [cb.on_batch_end(b, lazy)
+                                            for cb in callbacks])
+            else:
                 for cb in callbacks:
                     cb.on_batch_end(b, lazy)
-                b += 1
-            # Epoch logs come from the last batch's view INCLUDING any
-            # callback writes (plain-dict behavior before _LazyLogs).
-            logs = lazy.copy()
+            b += 1
+        # Epoch logs come from the last batch's view INCLUDING any
+        # callback writes (plain-dict behavior before _LazyLogs).
+        try:
+            logs = (self._elastic_epoch_logs(lazy) if elastic_on
+                    else lazy.copy())
             # Epoch boundary = eager drain point: report the (already
             # host-visible) loss to the sentinel for perf.jsonl's
             # final_loss column, and run the cross-rank consistency
@@ -518,20 +686,173 @@ class Trainer:
             if "loss" in logs:
                 _sentinel.note_loss(logs["loss"])
             if _numerics.enabled() and num_processes() > 1:
-                self.check_consistency(tag="epoch_end")
+                # A collective: in elastic mode it runs on the
+                # sacrificial dispatcher so a peer dying mid-digest
+                # cannot wedge the loop past recovery.
+                if elastic_on:
+                    self._elastic_call(
+                        lambda: self.check_consistency(tag="epoch_end"))
+                else:
+                    self.check_consistency(tag="epoch_end")
             if validation_data is not None:
-                val = self.evaluate(*validation_data, batch_size=batch_size)
+                # Collectives + blocking metric fetches: dispatcher-
+                # routed in elastic mode for the same wedge-proofing as
+                # the train step.
+                if elastic_on:
+                    val = self._elastic_call(
+                        lambda: self.evaluate(*validation_data,
+                                              batch_size=batch_size))
+                else:
+                    val = self.evaluate(*validation_data,
+                                        batch_size=batch_size)
                 logs.update({f"val_{k}": v for k, v in val.items()})
-            for cb in callbacks:
-                cb.on_epoch_end(epoch, logs)
-            for k, v in logs.items():
-                history.setdefault(k, []).append(v)
-            if verbose:
-                print(f"epoch {epoch}: " +
-                      " ".join(f"{k}={v:.4f}" for k, v in logs.items()))
+        except Exception as exc:
+            self._elastic_translate(exc, elastic_on)
+            raise
         for cb in callbacks:
-            cb.on_train_end()
-        return history
+            cb.on_epoch_end(epoch, logs)
+        for k, v in logs.items():
+            history.setdefault(k, []).append(v)
+        if verbose:
+            print(f"epoch {epoch}: " +
+                  " ".join(f"{k}={v:.4f}" for k, v in logs.items()))
+        if elastic_on:
+            self._elastic_epoch_boundary(epoch)
+
+    # -- elastic worlds (core/elastic.py) ------------------------------------
+
+    def _elastic_guard(self):
+        if _elastic.get_world().world_changed():
+            raise _elastic.WorldChanged()
+
+    def _elastic_call(self, fn):
+        """Run ``fn`` on the sacrificial dispatcher, polling for a death
+        verdict: the call is abandoned (and the dispatcher discarded for
+        a fresh one) the moment the world changes under it."""
+        if self._elastic_dispatcher is None:
+            self._elastic_dispatcher = _SacrificialDispatcher()
+        try:
+            return self._elastic_dispatcher.call(fn, self._elastic_guard)
+        except _elastic.WorldChanged:
+            # The in-flight call may be wedged inside the dead world's
+            # runtime forever — never reuse this worker.
+            self._elastic_dispatcher = None
+            raise
+
+    def _elastic_await(self, arr):
+        """Bounded-in-flight await: poll one device value's readiness,
+        bailing to recovery the moment a death verdict lands. A plain
+        blocking fetch would sit inside a collective the dead peer never
+        joins; deeper dispatch queues wedge the dispatch call itself."""
+        if arr is None:
+            return
+        is_ready = getattr(arr, "is_ready", None)
+        if is_ready is None:
+            return
+        w = _elastic.get_world()
+        while True:
+            if w.world_changed():
+                raise _elastic.WorldChanged()
+            try:
+                if is_ready():
+                    return
+            except Exception:
+                return  # errored buffer: the step's own fetch surfaces it
+            time.sleep(0.005)
+
+    def _elastic_translate(self, exc: Exception, elastic_on: bool):
+        """A step/fetch raised: when a death verdict explains it (or
+        arrives within a couple of leases — the runtime error usually
+        beats the heartbeat), convert to WorldChanged so fit recovers
+        instead of crashing."""
+        if isinstance(exc, _elastic.WorldChanged) or not elastic_on:
+            return
+        w = _elastic.get_world()
+        if w.world_changed() or w.await_verdict(2 * _elastic.lease_s()):
+            raise _elastic.WorldChanged() from exc
+
+    def _elastic_epoch_logs(self, lazy) -> dict:
+        """Epoch-end fetch that cannot wedge on a dead world: poll the
+        device values' readiness, bailing to recovery the moment a death
+        verdict lands (a blocking fetch would sit inside a collective
+        the dead peer never joins)."""
+        w = _elastic.get_world()
+        for v in list(lazy._raw.values()):
+            is_ready = getattr(v, "is_ready", None)
+            if is_ready is None:
+                continue
+            while True:
+                if w.world_changed():
+                    raise _elastic.WorldChanged()
+                try:
+                    if is_ready():
+                        break
+                except Exception:
+                    break  # the copy below surfaces the real error
+                time.sleep(0.05)
+        return lazy.copy()
+
+    def _elastic_epoch_boundary(self, epoch: int):
+        """Elastic bookkeeping at the epoch drain point: write the
+        checkpoint recovery resumes from, then honor a pending
+        supervisor restart request (rejoin admission / regrow)."""
+        d = _elastic.checkpoint_dir()
+        if d:
+            try:
+                # save() globalizes sharded state (a collective) and
+                # fetches device buffers — dispatcher-routed for the
+                # same wedge-proofing as the step itself.
+                self._elastic_call(lambda: self.save(d, step=epoch))
+            except Exception as exc:
+                self._elastic_translate(exc, True)
+                raise
+        req = _elastic.get_world().restart_requested()
+        if req:
+            _elastic.get_world().exit_for_restart(req)
+
+    def _elastic_recover(self, x_sample) -> int:
+        """Death-verdict recovery: reconfigure the world (in-place
+        shrink, or exit for a supervisor-coordinated restart), rebuild
+        the compiled steps over the new mesh, and resume from the newest
+        checkpoint via the host-first broadcast pattern. Returns the
+        epoch to resume at."""
+        w = _elastic.get_world()
+        self._elastic_dispatcher = None  # may be wedged in the old world
+        try:
+            w.reconfigure()
+        except _elastic.ElasticRestartRequired as exc:
+            w.exit_for_restart(str(exc))  # no return
+        _ELASTIC_LOG.warning("elastic recovery: world reconfigured "
+                             "(epoch %d); rebuilding steps and restoring "
+                             "the newest checkpoint", w.epoch)
+        # Fresh programs + fresh state on the new backend: everything
+        # from the old world (including the RNG key, an old-backend
+        # array) is unusable. The old references are PARKED, not
+        # dropped — releasing state donated into a wedged execution can
+        # block inside the dead runtime. The graveyard (previous step's
+        # donated state awaiting worker-side release) is parked whole
+        # for the same reason.
+        w.park((self.params, self.batch_stats, self.opt_state,
+                self.rng, self._elastic_graveyard))
+        self._elastic_graveyard = []
+        self._train_step = self._eval_step = None
+        self.rng = jax.random.PRNGKey(997 + int(w.epoch))
+        self.params = None
+        self.batch_stats = {}
+        self.opt_state = None
+        self.build(x_sample)
+        # Same restore-and-resume path the regrown world uses at
+        # startup (newest checkpoint -> host-first broadcast -> resume
+        # at the restored epoch + 1).
+        resume = _elastic.maybe_restore(self, x_sample)
+        self._build_steps()
+        if resume:
+            return resume
+        # No checkpoint to resume from: reinitialize (the loss curve
+        # restarts — elastic training should checkpoint every epoch,
+        # which fit does automatically when a checkpoint dir is set).
+        self.broadcast_state()
+        return self._epoch
 
     def evaluate(self, x, y, batch_size: int = 32) -> dict:
         x, y = np.asarray(x), np.asarray(y)

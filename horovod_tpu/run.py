@@ -10,11 +10,28 @@ Spawns N controller processes wired together through ``jax.distributed``
 simulated exactly like the reference's single-host ``mpirun -np N`` test
 tier (SURVEY.md §4). On real multi-host TPU pods, prefer one process per
 host started by your scheduler; this launcher is for local runs and tests.
+
+Failure semantics:
+
+- Default (``mpirun`` parity): the first child death is REPORTED — which
+  rank, which pid, which signal or exit code — before the remaining
+  children are torn down, and that child's status becomes the
+  launcher's own (``128+signum`` for signal deaths).
+- ``--elastic`` (supervisor mode, core/elastic.py): children run with
+  ``HVD_ELASTIC=1`` and are *supervised*, not collectively killed. A
+  crashed/killed child gets a death note; survivors keep training on a
+  shrunk world; after an ``HVD_ELASTIC_BLACKLIST_S`` backoff (doubled
+  per repeat death, capped by ``--max-restarts``) the supervisor files a
+  rejoin request, survivors checkpoint and exit with the restart code,
+  and the whole world is relaunched at the next generation — resuming
+  from the newest checkpoint with the recovered rank readmitted.
+  ``--min-np`` bounds how far the world may shrink in place.
 """
 
 from __future__ import annotations
 
 import argparse
+import json
 import os
 import signal
 import socket
@@ -35,6 +52,253 @@ def _stream(prefix: str, pipe, out):
         out.write(f"{prefix}{line}")
         out.flush()
     pipe.close()
+
+
+def _describe_exit(rank: int, pid: int, code: int) -> str:
+    """Human attribution of one child's exit (the satellite the old
+    launcher lacked: *which* rank died, *how*, before the teardown)."""
+    if code < 0:
+        try:
+            name = signal.Signals(-code).name
+        except ValueError:
+            name = f"signal {-code}"
+        return f"rank {rank} (pid {pid}) was killed by {name}"
+    return f"rank {rank} (pid {pid}) exited with code {code}"
+
+
+def _exit_status(code: int) -> int:
+    """Shell-convention launcher status for a child status: 128+signum
+    for signal deaths (a raw negative returncode would be truncated to a
+    meaningless byte), the child's own code otherwise."""
+    return 128 - code if code < 0 else code
+
+
+# Keep in sync with horovod_tpu.core.elastic.RESTART_EXIT_CODE (pinned by
+# tests/test_world_elastic.py); importing the module here would drag jax
+# into the launcher process.
+RESTART_EXIT_CODE = 77
+
+
+def _run_failfast(args, spawn_world) -> int:
+    """mpirun parity: first child death tears the world down — after an
+    attributed report of who died and how. A sequential wait() would
+    never observe a higher-index child dying while process 0 blocks in a
+    collective, hence the poll loop."""
+    procs, threads = spawn_world({})
+
+    def _kill_all(signum=None, frame=None):
+        for p in procs:
+            if p.poll() is None:
+                p.terminate()
+
+    signal.signal(signal.SIGINT, _kill_all)
+    signal.signal(signal.SIGTERM, _kill_all)
+
+    rc = 0
+    pending = set(range(len(procs)))
+    while pending:
+        exited = [i for i in pending if procs[i].poll() is not None]
+        for i in exited:
+            pending.discard(i)
+            code = procs[i].returncode
+            if code != 0 and rc == 0:
+                # The FIRST failure is the cause; children _kill_all
+                # subsequently terminates (SIGTERM, code -15) are
+                # casualties, not causes — the cause's status is the
+                # launcher's status (128+signum for a signal death; the
+                # old launcher returned the raw negative, which the
+                # shell mangled into its own meaningless byte).
+                rc = _exit_status(code)
+                sys.stderr.write(
+                    "[launcher] " + _describe_exit(i, procs[i].pid, code)
+                    + "; terminating the remaining processes\n")
+                _kill_all()
+        if pending and not exited:
+            time.sleep(0.05)
+    for t in threads:
+        t.join(timeout=5)
+    return rc
+
+
+def _supervise_elastic(args, spawn_world) -> int:
+    """Elastic supervisor (core/elastic.py): children survive peer
+    death; this loop supplies the process-management half — death notes,
+    blacklist-then-readmit rejoin requests, and capped full-world
+    relaunches when the members vote for a coordinated restart."""
+    import tempfile
+
+    edir = args.elastic_dir or os.environ.get("HVD_ELASTIC_DIR") \
+        or tempfile.mkdtemp(prefix="hvd_elastic_")
+    os.makedirs(edir, exist_ok=True)
+    sys.stderr.write(f"[launcher] elastic supervisor: dir {edir}, "
+                     f"min-np {args.min_np}, "
+                     f"max-restarts {args.max_restarts}\n")
+    restarts = {i: 0 for i in range(args.num_proc)}
+    world_relaunches = 0
+    generation = 0
+    interrupted = []
+
+    def _on_signal(signum, frame):
+        interrupted.append(signum)
+
+    signal.signal(signal.SIGINT, _on_signal)
+    signal.signal(signal.SIGTERM, _on_signal)
+
+    # Read the knob from env directly — importing core.elastic here
+    # would drag jax (and the TPU plugin) into the supervisor process,
+    # the same reason RESTART_EXIT_CODE is duplicated above. Keep the
+    # default in sync with core/elastic.py blacklist_s().
+    try:
+        blacklist = float(os.environ.get("HVD_ELASTIC_BLACKLIST_S", "5"))
+    except ValueError:
+        blacklist = 5.0
+
+    while True:
+        # Consume control files from the previous generation: a stale
+        # rejoin request would bounce the fresh world straight back into
+        # a restart loop.
+        for name in ("restart.json",):
+            try:
+                os.unlink(os.path.join(edir, name))
+            except OSError:
+                pass
+        rejoin_dir = os.path.join(edir, "rejoin")
+        if os.path.isdir(rejoin_dir):
+            for f in os.listdir(rejoin_dir):
+                try:
+                    os.unlink(os.path.join(rejoin_dir, f))
+                except OSError:
+                    pass
+
+        procs, threads = spawn_world({
+            "HVD_ELASTIC": "1",
+            "HVD_ELASTIC_DIR": edir,
+            "HVD_ELASTIC_GENERATION": str(generation),
+            "HVD_ELASTIC_MIN_NP": str(args.min_np),
+        })
+        statuses: dict = {}
+        rejoin_due: dict = {}
+        while len(statuses) < len(procs) and not interrupted:
+            for i, p in enumerate(procs):
+                if i in statuses or p.poll() is None:
+                    continue
+                code = p.returncode
+                statuses[i] = code
+                desc = _describe_exit(i, p.pid, code)
+                if code == RESTART_EXIT_CODE:
+                    sys.stderr.write(f"[launcher] {desc} "
+                                     "(coordinated-restart vote)\n")
+                elif code == 0:
+                    sys.stderr.write(f"[launcher] rank {i} (pid {p.pid}) "
+                                     "completed\n")
+                else:
+                    sys.stderr.write(
+                        f"[launcher] {desc}; elastic world continues "
+                        "degraded\n")
+                    try:
+                        os.makedirs(os.path.join(edir, "death"),
+                                    exist_ok=True)
+                        with open(os.path.join(
+                                edir, "death",
+                                f"p{i}.supervisor.json"), "w") as fh:
+                            json.dump({"process": i, "pid": p.pid,
+                                       "status": code,
+                                       "generation": generation,
+                                       "wall": round(time.time(), 3)},
+                                      fh)
+                    except OSError:
+                        pass
+                    if restarts[i] < args.max_restarts:
+                        backoff = blacklist * (2 ** restarts[i])
+                        restarts[i] += 1
+                        rejoin_due[i] = time.monotonic() + backoff
+                        sys.stderr.write(
+                            f"[launcher] rank {i} blacklisted for "
+                            f"{backoff:.1f}s before readmission "
+                            f"(restart {restarts[i]}/"
+                            f"{args.max_restarts})\n")
+                    else:
+                        sys.stderr.write(
+                            f"[launcher] rank {i} exceeded "
+                            f"--max-restarts={args.max_restarts}; "
+                            "not readmitting\n")
+            # A rank can be lease-verdicted by its peers while its
+            # process is WEDGED rather than dead (blocked inside the
+            # runtime): the survivors' death notes name it — reap it,
+            # or the wait loop above blocks on it forever.
+            death_dir = os.path.join(edir, "death")
+            if os.path.isdir(death_dir):
+                for i, p in enumerate(procs):
+                    if i in statuses or p.poll() is not None:
+                        continue
+                    note = os.path.join(death_dir, f"p{i}.json")
+                    try:
+                        with open(note) as fh:
+                            rec = json.load(fh)
+                    except (OSError, ValueError):
+                        continue
+                    if rec.get("generation") == generation:
+                        sys.stderr.write(
+                            f"[launcher] rank {i} (pid {p.pid}) was "
+                            "declared dead by its peers but is still "
+                            "running (wedged); killing it\n")
+                        p.kill()
+            now = time.monotonic()
+            for i in [i for i, due in rejoin_due.items() if now >= due]:
+                del rejoin_due[i]
+                try:
+                    os.makedirs(rejoin_dir, exist_ok=True)
+                    with open(os.path.join(rejoin_dir, f"p{i}.json"),
+                              "w") as fh:
+                        json.dump({"process": i, "generation": generation,
+                                   "wall": round(time.time(), 3)}, fh)
+                    sys.stderr.write(
+                        f"[launcher] rank {i} blacklist expired; rejoin "
+                        "request filed (survivors restart at their next "
+                        "epoch boundary)\n")
+                except OSError as exc:
+                    sys.stderr.write(
+                        f"[launcher] cannot file rejoin request: {exc}\n")
+            time.sleep(0.05)
+        if interrupted:
+            for p in procs:
+                if p.poll() is None:
+                    p.terminate()
+            return 130
+        for t in threads:
+            t.join(timeout=5)
+
+        votes = sorted(i for i, c in statuses.items()
+                       if c == RESTART_EXIT_CODE)
+        completed = sorted(i for i, c in statuses.items() if c == 0)
+        crashed = sorted(i for i, c in statuses.items()
+                         if c not in (0, RESTART_EXIT_CODE))
+        if completed and not votes:
+            # The job finished (possibly degraded — a crashed rank that
+            # was never readmitted is reported above, not fatal).
+            return 0
+        if (votes or crashed) and world_relaunches < args.max_restarts:
+            world_relaunches += 1
+            generation += 1
+            sys.stderr.write(
+                f"[launcher] relaunching the world: generation "
+                f"{generation} (votes {votes}, crashed {crashed}, "
+                f"relaunch {world_relaunches}/{args.max_restarts})\n")
+            continue
+        if crashed:
+            code = statuses[crashed[0]]
+            sys.stderr.write(
+                "[launcher] giving up: relaunch budget exhausted\n")
+            return _exit_status(code)
+        if votes:
+            # Members exited mid-training expecting a relaunch the
+            # budget no longer allows — that is an incomplete job, not
+            # a success.
+            sys.stderr.write(
+                "[launcher] giving up: relaunch budget exhausted with "
+                f"pending restart votes from ranks {votes}\n")
+            return 1
+        return 0
 
 
 def main(argv=None):
@@ -59,6 +323,25 @@ def main(argv=None):
                          "HVD_TELEMETRY_PORT; query with "
                          "python -m horovod_tpu.utils.stats "
                          "http://127.0.0.1:PORT)")
+    ap.add_argument("--elastic", action="store_true", default=False,
+                    help="supervisor mode: children run with "
+                         "HVD_ELASTIC=1, a dead rank does not kill the "
+                         "world, and recovered ranks rejoin at an epoch "
+                         "boundary through a full-world relaunch "
+                         "(docs/running.md 'Elastic worlds')")
+    ap.add_argument("--min-np", type=int, default=1, metavar="K",
+                    help="elastic: smallest process count the world may "
+                         "shrink to in place; below it survivors wait "
+                         "for a relaunch (default 1)")
+    ap.add_argument("--max-restarts", type=int, default=3, metavar="N",
+                    help="elastic: per-rank readmissions and full-world "
+                         "relaunches allowed before giving up "
+                         "(default 3)")
+    ap.add_argument("--elastic-dir", default=None, metavar="DIR",
+                    help="elastic: state directory shared with the "
+                         "children (epoch journal, death notes, rejoin "
+                         "requests, checkpoints; default "
+                         "HVD_ELASTIC_DIR or a fresh temp dir)")
     ap.add_argument("command", nargs=argparse.REMAINDER,
                     help="command to run, e.g. python train.py --epochs 1")
     args = ap.parse_args(argv)
@@ -102,64 +385,44 @@ def main(argv=None):
                 f"{args.num_proc} processes need a directory "
                 "(per-rank traces + auto-merge)")
 
-    port = _free_port()
-    procs = []
-    threads = []
-    for i in range(args.num_proc):
-        env = dict(os.environ)
-        env["HVD_COORDINATOR_ADDRESS"] = f"127.0.0.1:{port}"
-        env["HVD_NUM_PROCESSES"] = str(args.num_proc)
-        env["HVD_PROCESS_ID"] = str(i)
-        if timeline:
-            env["HVD_TIMELINE"] = timeline
-        if args.telemetry_port_base is not None:
-            env["HVD_TELEMETRY_PORT"] = str(args.telemetry_port_base + i)
-        if args.cpu:
-            # HVD_PLATFORM is applied via jax.config inside hvd.init()
-            # (plain JAX_PLATFORMS can be preempted by plugins).
-            env["HVD_PLATFORM"] = "cpu"
-            env["JAX_PLATFORMS"] = "cpu"
-            env["XLA_FLAGS"] = (
-                env.get("XLA_FLAGS", "") +
-                f" --xla_force_host_platform_device_count="
-                f"{args.ncpus_per_proc}").strip()
-        p = subprocess.Popen(cmd, env=env, stdout=subprocess.PIPE,
-                             stderr=subprocess.STDOUT, text=True)
-        procs.append(p)
-        prefix = f"[{i}] " if args.tag_output else ""
-        t = threading.Thread(target=_stream, args=(prefix, p.stdout,
-                                                   sys.stdout), daemon=True)
-        t.start()
-        threads.append(t)
+    def _spawn_world(extra_env: dict):
+        port = _free_port()
+        procs, threads = [], []
+        for i in range(args.num_proc):
+            env = dict(os.environ)
+            env["HVD_COORDINATOR_ADDRESS"] = f"127.0.0.1:{port}"
+            env["HVD_NUM_PROCESSES"] = str(args.num_proc)
+            env["HVD_PROCESS_ID"] = str(i)
+            env.update(extra_env)
+            if timeline:
+                env["HVD_TIMELINE"] = timeline
+            if args.telemetry_port_base is not None:
+                env["HVD_TELEMETRY_PORT"] = str(
+                    args.telemetry_port_base + i)
+            if args.cpu:
+                # HVD_PLATFORM is applied via jax.config inside hvd.init()
+                # (plain JAX_PLATFORMS can be preempted by plugins).
+                env["HVD_PLATFORM"] = "cpu"
+                env["JAX_PLATFORMS"] = "cpu"
+                env["XLA_FLAGS"] = (
+                    env.get("XLA_FLAGS", "") +
+                    f" --xla_force_host_platform_device_count="
+                    f"{args.ncpus_per_proc}").strip()
+            p = subprocess.Popen(cmd, env=env, stdout=subprocess.PIPE,
+                                 stderr=subprocess.STDOUT, text=True)
+            procs.append(p)
+            prefix = f"[{i}] " if args.tag_output else ""
+            t = threading.Thread(target=_stream,
+                                 args=(prefix, p.stdout, sys.stdout),
+                                 daemon=True)
+            t.start()
+            threads.append(t)
+        return procs, threads
 
-    def _kill_all(signum=None, frame=None):
-        for p in procs:
-            if p.poll() is None:
-                p.terminate()
-
-    signal.signal(signal.SIGINT, _kill_all)
-    signal.signal(signal.SIGTERM, _kill_all)
-
-    # Poll ALL children each tick (mpirun semantics: first failure tears
-    # down the whole job). A sequential wait() would never observe a
-    # higher-index child dying while process 0 blocks in a collective.
-    rc = 0
-    pending = set(range(len(procs)))
-    while pending:
-        exited = [i for i in pending if procs[i].poll() is not None]
-        for i in exited:
-            pending.discard(i)
-            code = procs[i].returncode
-            if code != 0 and rc == 0:
-                rc = code
-                sys.stderr.write(
-                    f"process {i} exited with code {code}; "
-                    "terminating the remaining processes\n")
-                _kill_all()
-        if pending and not exited:
-            time.sleep(0.05)
-    for t in threads:
-        t.join(timeout=5)
+    if args.elastic:
+        rc = _supervise_elastic(args, _spawn_world)
+    else:
+        rc = _run_failfast(args, _spawn_world)
     if timeline_dir:
         # Collect + auto-merge the per-rank traces (whatever landed on
         # disk — the truncation-tolerant reader handles ranks that died

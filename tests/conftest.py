@@ -42,7 +42,7 @@ import pytest  # noqa: E402
 
 # Cmdline markers of multiprocess-world processes this suite spawns.
 _WORLD_MARKERS = ("multiproc_worker.py", "launcher_worker.py",
-                  "horovod_tpu.run")
+                  "elastic_worker.py", "horovod_tpu.run")
 
 
 def _ancestor_pids() -> set:
@@ -97,6 +97,15 @@ def _stale_world_processes():
 
 
 def pytest_configure(config):
+    # Declared markers: `slow` gates the opt-in multi-minute tier
+    # (ROADMAP tier-1 runs -m 'not slow'); `chaos` tags the elastic
+    # failure-injection scenarios (tests/test_world_elastic.py) — they
+    # run in tier-1 like the other multiprocess worlds (sequentially;
+    # the stale-world preflight below already covers their children).
+    config.addinivalue_line(
+        "markers", "slow: opt-in multi-minute tier (HVD_SLOW_TESTS=1)")
+    config.addinivalue_line(
+        "markers", "chaos: elastic chaos-monkey multiprocess scenarios")
     if os.environ.get("HVD_COORDINATOR_ADDRESS") or os.environ.get(
             "HVD_NUM_PROCESSES") or os.environ.get("HVD_PREFLIGHT_SKIP"):
         # We ARE a spawned world member (frontend suites re-run under the

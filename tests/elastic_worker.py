@@ -1,0 +1,124 @@
+"""Chaos-monkey elastic training worker — run under the supervisor:
+
+    python -m horovod_tpu.run -np 2 --cpu --elastic -- python elastic_worker.py
+
+Generation 0: rank 1 SIGKILLs itself mid-epoch. The survivor must take a
+death verdict, shrink the world in place (epoch bump, local mesh,
+recompile), resume from the newest checkpoint and KEEP TRAINING with a
+continuous loss curve. The supervisor blacklists the dead rank, then
+files a rejoin request; the survivor checkpoints and votes a coordinated
+restart at its next epoch boundary.
+
+Generation 1: the full world relaunches, resumes from the newest
+checkpoint, finishes the remaining epochs, and proves agreement with
+``hvd.check_consistency`` on the regrown mesh.
+
+Per-epoch losses land in ``$HVD_ELASTIC_DIR/losses.rank<N>.jsonl`` so the
+pytest driver can assert the curve is continuous (no NaN, no
+restart-from-scratch jump)."""
+
+import json
+import os
+import signal
+import sys
+import time
+
+RANK = int(os.environ.get("HVD_PROCESS_ID", "0"))
+GEN = int(os.environ.get("HVD_ELASTIC_GENERATION", "0"))
+EDIR = os.environ["HVD_ELASTIC_DIR"]
+
+KILL_EPOCH = 1
+KILL_BATCH = 5
+EPOCHS = int(os.environ.get("HVD_TEST_EPOCHS", "30"))
+
+if os.environ.get("HVD_TEST_DEBUG_TRACE"):
+    import faulthandler
+
+    faulthandler.dump_traceback_later(45, repeat=True)
+
+import numpy as np  # noqa: E402
+
+import horovod_tpu as hvd  # noqa: E402
+import horovod_tpu.keras as hk  # noqa: E402
+from horovod_tpu.core import elastic  # noqa: E402
+
+hvd.init()
+print(f"WORLD gen={GEN} rank={hvd.process_index()} "
+      f"np={hvd.num_processes()} size={hvd.size()} "
+      f"epoch={elastic.get_world().epoch}", flush=True)
+
+import flax.linen as nn  # noqa: E402
+import optax  # noqa: E402
+
+
+class MLP(nn.Module):
+    @nn.compact
+    def __call__(self, x, train=False):
+        h = nn.relu(nn.Dense(16)(x))
+        return nn.Dense(4)(h)
+
+
+rng = np.random.default_rng(0)
+N, BS = 256, 4
+x = rng.normal(size=(N, 8)).astype(np.float32)
+w_true = rng.normal(size=(8, 4)).astype(np.float32)
+y = (x @ w_true).argmax(axis=1).astype(np.int32)
+
+
+class ChaosAndLog(hk.callbacks.Callback):
+    """Pace epochs (so detection/rejoin timing is exercised mid-run),
+    SIGKILL rank 1 mid-epoch in generation 0, and log per-epoch losses
+    for the continuity assertion."""
+
+    def on_batch_end(self, batch, logs=None):
+        if os.environ.get("HVD_TEST_DEBUG_TRACE"):
+            print(f"BATCH gen={GEN} rank={RANK} "
+                  f"e{self.trainer._epoch} b{batch}", flush=True)
+        if GEN == 0 and RANK == 1 \
+                and self.trainer._epoch == KILL_EPOCH \
+                and batch == KILL_BATCH:
+            print(f"CHAOS rank={RANK} dying at epoch "
+                  f"{self.trainer._epoch} batch {batch}", flush=True)
+            os.kill(os.getpid(), signal.SIGKILL)
+        time.sleep(0.04)  # pacing: ~0.6 s/epoch of wall time
+
+    def on_epoch_end(self, epoch, logs=None):
+        rec = {"gen": GEN, "rank": RANK, "epoch": epoch,
+               "world_epoch": elastic.get_world().epoch,
+               "size": hvd.size(), "loss": float(logs.get("loss", -1.0)),
+               "wall": round(time.time(), 3)}
+        with open(os.path.join(EDIR, f"losses.rank{RANK}.jsonl"),
+                  "a") as fh:
+            fh.write(json.dumps(rec) + "\n")
+        print(f"EPOCH gen={GEN} rank={RANK} epoch={epoch} "
+              f"size={hvd.size()} loss={rec['loss']:.4f}", flush=True)
+
+
+trainer = hk.Trainer(MLP(), optax.sgd(0.02, momentum=0.9), rng=0)
+x_sample = x[:BS * hvd.local_size()]
+initial_epoch = elastic.maybe_restore(trainer, x_sample)
+if initial_epoch:
+    print(f"RESUMED gen={GEN} rank={RANK} at epoch {initial_epoch} "
+          f"world_epoch={elastic.get_world().epoch}", flush=True)
+
+trainer.fit(x, y, batch_size=BS, epochs=EPOCHS, shuffle=False,
+            initial_epoch=initial_epoch, callbacks=[ChaosAndLog()])
+
+# Training work is done: announce completion BEFORE the final barriers
+# below, while every peer (and the KV host) is still up — a silent exit
+# reads as a death to any slower peer.
+elastic.get_world().announce_done()
+
+if hvd.num_processes() > 1:
+    ok = trainer.check_consistency(tag="post_rejoin")
+    assert ok["ok"] is True, ok
+    print(f"CONSISTENCY OK gen={GEN} rank={hvd.process_index()} "
+          f"size={hvd.size()}", flush=True)
+
+print(f"ELASTIC DONE gen={GEN} rank={RANK} size={hvd.size()} "
+      f"np={hvd.num_processes()} "
+      f"world_epoch={elastic.get_world().epoch}", flush=True)
+sys.stdout.flush()
+# Interpreter teardown in a world that lost members would hang in the
+# distributed-client destructors; the markers above are the contract.
+os._exit(0)

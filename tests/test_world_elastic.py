@@ -1,0 +1,417 @@
+"""Elastic worlds (ISSUE 9): survive rank loss, shrink the mesh, regrow
+on rejoin.
+
+Tiers in this file:
+
+- unit: heartbeat-lease verdicts on a LocalKV, KV poll backoff, KVTimeout
+  attribution, the launcher/elastic exit-code contract, and the
+  topology ``shutdown() -> init()`` re-entry that reconfiguration needs;
+- launcher: the non-elastic death report + exit-status propagation;
+- ``chaos`` marker: the 2-process SIGKILL / shrink / rejoin scenario for
+  BOTH engines, driven through ``run.py --elastic`` (the supervisor).
+
+(The file name sorts last in the suite on purpose: the chaos worlds are
+the most expensive tier and must not displace earlier coverage under a
+wall-clock cap.)
+"""
+
+import json
+import math
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import pytest
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_WORKER = os.path.join(_REPO, "tests", "elastic_worker.py")
+
+
+# ---------------------------------------------------------------------------
+# units: lease verdicts, poll backoff, exit-code contract
+# ---------------------------------------------------------------------------
+
+
+def test_restart_exit_code_in_sync():
+    """run.py hardcodes the code (importing core.elastic would drag jax
+    into the launcher); the two must never drift."""
+    from horovod_tpu import run as run_mod
+    from horovod_tpu.core import elastic
+
+    assert run_mod.RESTART_EXIT_CODE == elastic.RESTART_EXIT_CODE == 77
+
+
+def test_kvtimeout_names_key_and_world_epoch():
+    from horovod_tpu.core import coordinator as coord
+
+    coord.set_world_epoch(0)
+    err = coord.KVTimeout("hvd/neg/g0/r3/p1")
+    assert "hvd/neg/g0/r3/p1" in str(err) and "world epoch 0" in str(err)
+    try:
+        coord.set_world_epoch(4)
+        err = coord.KVTimeout("some/key")
+        assert "world epoch 4" in str(err)
+        # LocalKV's blocking get raises the same attributed timeout.
+        kv = coord.LocalKV({})
+        with pytest.raises(coord.KVTimeout, match="some/other.*epoch 4"):
+            kv.get("some/other", timeout_s=0.05)
+    finally:
+        coord.set_world_epoch(0)
+
+
+def test_poll_slices_back_off_with_jitter():
+    import random
+
+    from horovod_tpu.core import coordinator as coord
+
+    gen = coord._poll_slices(random.Random(7))
+    slices = [next(gen) for _ in range(12)]
+    # Grows from the short first slice toward the cap...
+    assert slices[0] < 0.2
+    assert max(slices) <= coord._POLL_SLICE_MAX_S * 1.25 + 1e-9
+    assert slices[-1] > coord._POLL_SLICE_MAX_S * 0.7
+    # ...monotone-ish growth then a jittered plateau, never a fixed spin.
+    assert slices[3] > slices[0]
+    assert len({round(s, 6) for s in slices[-6:]}) > 1  # jitter alive
+
+
+def test_heartbeat_lease_verdicts(tmp_path, monkeypatch):
+    """The missed-heartbeat KV lease: a stalled counter (or a missing
+    one past the startup grace) hardens into a death verdict with a
+    tombstone, a death note, and an attributed flight dump."""
+    monkeypatch.setenv("HVD_ELASTIC", "1")
+    monkeypatch.setenv("HVD_ELASTIC_LEASE_S", "0.2")
+    monkeypatch.setenv("HVD_ELASTIC_GRACE_S", "30")
+    monkeypatch.setenv("HVD_ELASTIC_DIR", str(tmp_path))
+    monkeypatch.setenv("HVD_FLIGHT_DIR", str(tmp_path / "flight"))
+    monkeypatch.setenv("HVD_FLIGHT_MIN_INTERVAL", "0")
+    from horovod_tpu.core import coordinator as coord
+    from horovod_tpu.core import elastic
+
+    store = {}
+    w = elastic.ElasticWorld()
+    w.active = True
+    w.pid, w.nproc = 0, 2
+    w.live = [0, 1]
+    w._kv = coord.LocalKV(store)
+
+    # Peer beating: no verdict, our own beat published.
+    store["hvd/elastic/g0/hb/p1"] = "1"
+    assert w._beat_once() is True
+    assert w.dead == {} and not w.world_changed()
+    assert store.get("hvd/elastic/g0/hb/p0") == "1"
+
+    # Counter advances -> lease refreshed on OUR clock.
+    store["hvd/elastic/g0/hb/p1"] = "2"
+    w._beat_once()
+    time.sleep(0.25)  # > lease without an advance
+    w._beat_once()
+    assert 1 in w.dead and "lease expired" in w.dead[1]
+    assert w.world_changed()
+    assert w.peer_is_dead(1)
+    # Tombstone + death note + flight dump all attribute process 1.
+    assert "hvd/elastic/g0/dead/p1" in store
+    note = json.load(open(tmp_path / "death" / "p1.json"))
+    assert note["process"] == 1 and "lease" in note["reason"]
+    dumps = list((tmp_path / "flight").glob("*.json"))
+    assert dumps, "no flight dump for the death verdict"
+    assert any("process 1" in json.load(open(d))["reason"]
+               for d in dumps)
+
+    # A verdicted peer is not re-verdicted (idempotent).
+    n = len(w.dead)
+    w._beat_once()
+    assert len(w.dead) == n
+
+
+def test_heartbeat_grace_for_silent_peer(tmp_path, monkeypatch):
+    monkeypatch.setenv("HVD_ELASTIC", "1")
+    monkeypatch.setenv("HVD_ELASTIC_LEASE_S", "0.2")
+    monkeypatch.setenv("HVD_ELASTIC_GRACE_S", "10")
+    monkeypatch.setenv("HVD_ELASTIC_DIR", str(tmp_path))
+    monkeypatch.setenv("HVD_FLIGHT_DIR", str(tmp_path / "flight"))
+    from horovod_tpu.core import coordinator as coord
+    from horovod_tpu.core import elastic
+
+    w = elastic.ElasticWorld()
+    w.active = True
+    w.pid, w.nproc, w.live = 0, 2, [0, 1]
+    w._kv = coord.LocalKV({})
+    w._beat_once()
+    assert w.dead == {}  # silent peer inside the startup grace
+    w._started_at -= 11.0  # grace elapsed
+    w._beat_once()
+    assert 1 in w.dead and "grace" in w.dead[1]
+
+
+def test_announced_done_peer_is_retired_not_verdicted(tmp_path,
+                                                      monkeypatch):
+    """A rank that announced completion and then went silent is a
+    finished rank, not a casualty: retired from the lease, no verdict,
+    no reconfiguration (the last ranks of a finishing job must not
+    shrink the world out from under each other)."""
+    monkeypatch.setenv("HVD_ELASTIC", "1")
+    monkeypatch.setenv("HVD_ELASTIC_LEASE_S", "0.1")
+    monkeypatch.setenv("HVD_ELASTIC_DIR", str(tmp_path))
+    monkeypatch.setenv("HVD_FLIGHT_DIR", str(tmp_path / "flight"))
+    from horovod_tpu.core import coordinator as coord
+    from horovod_tpu.core import elastic
+
+    store = {}
+    w = elastic.ElasticWorld()
+    w.active = True
+    w.pid, w.nproc, w.live = 0, 2, [0, 1]
+    w._kv = coord.LocalKV(store)
+    store["hvd/elastic/g0/hb/p1"] = "5"
+    w._beat_once()
+    store["hvd/elastic/g0/done/p1"] = "123.0"  # peer announces + exits
+    time.sleep(0.15)  # heartbeat silent past the lease
+    w._beat_once()
+    assert w.dead == {} and not w.world_changed()
+    assert 1 in w._done_peers
+    # Revocation (a later fit calls announce_active): a fresh lease is
+    # granted — no instant verdict for the time spent marked done...
+    del store["hvd/elastic/g0/done/p1"]
+    store["hvd/elastic/g0/hb/p1"] = "6"
+    w._beat_once()
+    assert w.dead == {} and 1 not in w._done_peers
+    # ...but normal leasing has resumed: silence now verdicts again.
+    time.sleep(0.15)
+    w._beat_once()
+    assert 1 in w.dead
+    # And our own announce publishes/retracts the key peers look for.
+    w.announce_done()
+    assert store.get("hvd/elastic/g0/done/p0") is not None
+    w.announce_active()
+    assert store.get("hvd/elastic/g0/done/p0") is None
+
+
+def test_restart_request_protocol(tmp_path, monkeypatch):
+    monkeypatch.setenv("HVD_ELASTIC", "1")
+    monkeypatch.setenv("HVD_ELASTIC_DIR", str(tmp_path))
+    from horovod_tpu.core import elastic
+
+    w = elastic.ElasticWorld()
+    assert w.restart_requested() is None
+    w.request_restart("below min-np")
+    assert "below min-np" in w.restart_requested()
+    os.unlink(tmp_path / "restart.json")
+    # The supervisor's rejoin request is also a restart trigger.
+    os.makedirs(tmp_path / "rejoin")
+    json.dump({"process": 1}, open(tmp_path / "rejoin" / "p1.json", "w"))
+    assert "p1.json" in w.restart_requested()
+
+
+def test_liveness_probe_fails_negotiation_early():
+    """A blocked negotiation read consults the elastic lease and raises
+    PeerLost immediately instead of waiting out the negotiation
+    timeout."""
+    from horovod_tpu.core import coordinator as coord
+
+    store = {}
+    c = coord.Coordinator(coord.LocalKV(store), 2, 0, 0.005, 0,
+                          timeout_s=30.0)
+    coord.set_liveness_probe(
+        lambda p: "lease expired" if p == 1 else None)
+    try:
+        t0 = time.monotonic()
+        with pytest.raises(coord.PeerLost, match="process 1 declared"):
+            c.negotiate([])
+        assert time.monotonic() - t0 < 5.0  # not the 30 s timeout
+        assert coord.is_shutdownish(coord.PeerLost(1, "x")) is False
+    finally:
+        coord.set_liveness_probe(None)
+
+
+# ---------------------------------------------------------------------------
+# topology re-entry (required by in-process reconfiguration)
+# ---------------------------------------------------------------------------
+
+
+def test_topology_shutdown_init_reentry_shrink_then_regrow(hvd):
+    """shutdown() -> init() must rebuild the mesh in-process without
+    leaking the old Mesh/two-tier state: shrink the 8-device virtual
+    mesh to 4, run eager + compiled collectives, then regrow to 8."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    import horovod_tpu.jax as hj
+    from horovod_tpu.common import topology as topo
+    from horovod_tpu.ops import collectives as C
+
+    assert hvd.size() == 8
+    all_devices = jax.devices()
+    try:
+        topo.shutdown()
+        assert not topo.is_initialized()
+        assert topo._state.mesh is None and topo._state.two_tier is None
+        assert topo._state.devices == []  # nothing pins the old Mesh
+        assert C._ranked_program.cache_info().currsize == 0
+
+        topo.init(devices=all_devices[:4])
+        assert hvd.size() == 4
+        out = np.asarray(hvd.allreduce(jnp.ones((3,)), average=False))
+        np.testing.assert_allclose(out, np.full((3,), 4.0))
+
+        @hj.jit(in_specs=(P(hj.HVD_AXIS),), out_specs=P())
+        def f(x):
+            return C.allreduce(x[0], average=False)
+
+        mesh = hvd.mesh()
+        shards = [jax.device_put(jnp.full((1, 2), 2.0), d)
+                  for d in all_devices[:4]]
+        x = jax.make_array_from_single_device_arrays(
+            (4, 2), NamedSharding(mesh, P(hj.HVD_AXIS)), shards)
+        np.testing.assert_allclose(np.asarray(f(x)), np.full((2,), 8.0))
+
+        # Regrow back to the full world in the same process.
+        topo.shutdown()
+        topo.init()
+        assert hvd.size() == 8
+        out = np.asarray(hvd.allreduce(jnp.ones((2,)), average=False))
+        np.testing.assert_allclose(out, np.full((2,), 8.0))
+    finally:
+        # Leave the session world exactly as the other tests expect.
+        if not topo.is_initialized() or topo.size() != 8:
+            topo.shutdown()
+            topo.init()
+
+
+# ---------------------------------------------------------------------------
+# launcher: non-elastic death attribution + exit-status propagation
+# ---------------------------------------------------------------------------
+
+
+def _clean_env(extra=None):
+    env = dict(os.environ)
+    env.pop("JAX_PLATFORMS", None)
+    env.pop("XLA_FLAGS", None)
+    env["PYTHONPATH"] = _REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env.update(extra or {})
+    return env
+
+
+def test_launcher_reports_signal_death_and_propagates_status():
+    """Non-elastic satellite: a child killed by a signal is reported —
+    rank, pid, signal name — BEFORE the rest is torn down, and the
+    launcher exits 128+signum (the raw negative returncode used to win,
+    which the shell mangled)."""
+    script = ("import os, signal, time\n"
+              "if os.environ['HVD_PROCESS_ID'] == '1':\n"
+              "    time.sleep(0.5)\n"
+              "    os.kill(os.getpid(), signal.SIGKILL)\n"
+              "time.sleep(60)\n")
+    proc = subprocess.run(
+        [sys.executable, "-m", "horovod_tpu.run", "-np", "2",
+         "--", sys.executable, "-c", script],
+        capture_output=True, text=True, timeout=120, env=_clean_env(),
+        cwd=_REPO)
+    assert proc.returncode == 128 + signal.SIGKILL, (
+        proc.returncode, proc.stderr[-1000:])
+    assert "rank 1 (pid " in proc.stderr and "SIGKILL" in proc.stderr, \
+        proc.stderr[-1000:]
+    assert "terminating the remaining processes" in proc.stderr
+
+
+# ---------------------------------------------------------------------------
+# chaos tier: SIGKILL -> shrink -> continuous loss -> rejoin -> regrow
+# ---------------------------------------------------------------------------
+
+ENGINES = ["native", "python"]
+
+
+def _parse_losses(path):
+    with open(path) as fh:
+        return [json.loads(line) for line in fh if line.strip()]
+
+
+@pytest.mark.chaos
+@pytest.mark.parametrize("engine", ENGINES)
+def test_chaos_sigkill_shrink_and_rejoin(engine, tmp_path):
+    """ISSUE 9 acceptance, both engines: SIGKILL one of 2 ranks
+    mid-training. The survivor must emit a RECONFIGURE epoch bump,
+    resume from the newest checkpoint and keep a continuous loss curve
+    (no NaN, no restart-from-scratch); the flight dump attributes the
+    death; the restarted rank rejoins after the blacklist and
+    ``hvd.check_consistency`` passes on the regrown world."""
+    edir = str(tmp_path / f"elastic_{engine}")
+    os.makedirs(edir)
+    env = _clean_env({
+        "HVD_ENGINE": engine,
+        "HVD_NUMERICS": "warn",
+        # One CPU core runs both ranks: a sub-second lease would flake
+        # on GIL/compile contention. 5 s detection still exercises the
+        # mid-training verdict; the blacklist leaves the survivor time
+        # to demonstrably train on the shrunk world before readmission.
+        "HVD_ELASTIC_LEASE_S": "5",
+        "HVD_ELASTIC_GRACE_S": "120",
+        "HVD_ELASTIC_BLACKLIST_S": "15",
+        "HVD_NEGOTIATION_TIMEOUT": "60",
+        "HVD_FLIGHT_DIR": os.path.join(edir, "flight"),
+        "HVD_FLIGHT_MIN_INTERVAL": "0",
+        "HVD_TEST_EPOCHS": "30",
+    })
+    proc = subprocess.run(
+        [sys.executable, "-m", "horovod_tpu.run", "-np", "2", "--cpu",
+         "--elastic", "--min-np", "1", "--max-restarts", "2",
+         "--elastic-dir", edir, "--", sys.executable, _WORKER],
+        capture_output=True, text=True, timeout=420, env=env, cwd=_REPO)
+    out, err = proc.stdout, proc.stderr
+    assert proc.returncode == 0, (proc.returncode, out[-4000:],
+                                  err[-3000:])
+
+    # The chaos actually happened, and the supervisor attributed it.
+    assert "CHAOS rank=1 dying" in out, out[-3000:]
+    assert "rank 1 (pid " in err and "SIGKILL" in err, err[-2000:]
+    assert "elastic world continues degraded" in err, err[-2000:]
+
+    # Shrink: epoch bump + the survivor kept TRAINING on the 4-rank
+    # world (at least one epoch completed at size=4 in generation 0).
+    assert "RECONFIGURE: world epoch 0 -> 1" in out, out[-4000:]
+    gen0_shrunk = [ln for ln in out.splitlines()
+                   if ln.startswith("[0] EPOCH gen=0") and "size=4" in ln]
+    assert gen0_shrunk, out[-4000:]
+
+    # Flight dump attributes the dead process.
+    import glob
+
+    dumps = glob.glob(os.path.join(edir, "flight", "*.json"))
+    reasons = []
+    for d in dumps:
+        try:
+            reasons.append(json.load(open(d)).get("reason", ""))
+        except (OSError, ValueError):
+            pass
+    assert any("process 1" in r for r in reasons), reasons
+
+    # Rejoin: blacklist expired -> request filed -> coordinated restart
+    # -> generation 1 resumes from the newest checkpoint on the FULL
+    # regrown mesh, and the consistency digests agree on every rank.
+    assert "rejoin request filed" in err, err[-2000:]
+    assert "relaunching the world: generation 1" in err, err[-2000:]
+    assert "RESUMED gen=1" in out, out[-3000:]
+    assert out.count("CONSISTENCY OK gen=1") == 2, out[-3000:]
+    done = [ln for ln in out.splitlines() if "ELASTIC DONE gen=1" in ln]
+    assert len(done) == 2 and all("size=8" in ln for ln in done), done
+
+    # Loss continuity on the survivor's curve: no NaN anywhere, no
+    # restart-from-scratch jump at either boundary (shrink, regrow),
+    # and net progress end to end.
+    recs = _parse_losses(os.path.join(edir, "losses.rank0.jsonl"))
+    assert len(recs) >= 5, recs
+    losses = [r["loss"] for r in recs]
+    assert all(math.isfinite(v) for v in losses), losses
+    for prev, cur in zip(recs, recs[1:]):
+        if cur["epoch"] <= prev["epoch"]:
+            continue  # an epoch re-run after recovery may repeat a value
+        assert cur["loss"] <= prev["loss"] * 1.35 + 0.05, (prev, cur)
+    assert losses[-1] < losses[0], losses
+    # Both boundaries are present in the curve: full -> shrunk -> full.
+    sizes = [r["size"] for r in recs]
+    assert 8 in sizes and 4 in sizes and sizes[-1] == 8, sizes
+    # The world epoch advanced across the shrink.
+    assert max(r["world_epoch"] for r in recs) >= 1, recs
