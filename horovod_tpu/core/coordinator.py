@@ -67,6 +67,7 @@ import time
 from dataclasses import dataclass
 from typing import Dict, List, Optional, Sequence, Tuple
 
+from horovod_tpu.core import faultline as _flt
 from horovod_tpu.core import telemetry as _tele
 
 LOG = logging.getLogger("horovod_tpu.coordinator")
@@ -253,11 +254,21 @@ class JaxKV:
 
     def set(self, key: str, value: str):
         try:
+            # Fault site kv.set (core/faultline.py): inside the wrap so
+            # an injected error surfaces as KVError like an organic one;
+            # 'torn' swaps in a half-written value.
+            value = _flt.kv_set(key, value)
             self._client.key_value_set(key, value)
         except Exception as exc:
             raise KVError(str(exc)) from None
 
     def get(self, key: str, timeout_s: float) -> str:
+        try:
+            # Fault site kv.get: delay sleeps here (a slow KV read);
+            # error surfaces as KVError like an organic RPC failure.
+            _flt.kv_get(key)
+        except _flt.FaultInjected as exc:
+            raise KVError(str(exc)) from None
         fn = getattr(self._client, "blocking_key_value_get", None)
         if fn is None:
             # No server-side blocking get on this client: emulate with
@@ -283,6 +294,8 @@ class JaxKV:
             raise KVError(msg) from None
 
     def try_get(self, key: str) -> Optional[str]:
+        if _flt.kv_try_get(key):
+            return None  # fault site kv.try_get: the key 'vanished'
         try:
             fn = getattr(self._client, "key_value_try_get", None)
             if fn is not None:
@@ -313,11 +326,22 @@ class LocalKV:
             "__cond__", threading.Condition())
 
     def set(self, key: str, value: str):
+        # Same fault sites as JaxKV (core/faultline.py): the unit tier
+        # exercises every KV injection mode on this backend, and an
+        # injected error must surface as KVError on both.
+        try:
+            value = _flt.kv_set(key, value)
+        except _flt.FaultInjected as exc:
+            raise KVError(str(exc)) from None
         with self._cond:
             self._store[key] = value
             self._cond.notify_all()
 
     def get(self, key: str, timeout_s: float) -> str:
+        try:
+            _flt.kv_get(key)
+        except _flt.FaultInjected as exc:
+            raise KVError(str(exc)) from None
         deadline = time.monotonic() + timeout_s
         with self._cond:
             while key not in self._store:
@@ -328,6 +352,8 @@ class LocalKV:
             return self._store[key]
 
     def try_get(self, key: str) -> Optional[str]:
+        if _flt.kv_try_get(key):
+            return None
         with self._cond:
             return self._store.get(key)
 

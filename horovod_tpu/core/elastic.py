@@ -66,6 +66,7 @@ import threading
 import time
 from typing import Dict, List, Optional
 
+from horovod_tpu.core import faultline as _flt
 from horovod_tpu.core import telemetry as _tele
 from horovod_tpu.core import timeline as tl
 from horovod_tpu.core.sentinel import _env_float
@@ -103,6 +104,21 @@ def blacklist_s() -> float:
     return _env_float("HVD_ELASTIC_BLACKLIST_S", 5.0)
 
 
+def kv_failover_s() -> float:
+    """How long the coordination-service KV may stop answering before the
+    heartbeat/lease plane cuts over to the HVD_ELASTIC_DIR file fallback
+    (rank-0/coordination-host death then becomes an attributed verdict
+    instead of an unattributed KVTimeout abort)."""
+    return _env_float("HVD_ELASTIC_KV_FAILOVER_S", max(1.0, lease_s()))
+
+
+def rebuild_timeout_s() -> float:
+    """Budget for the in-place multi-survivor rebuild (root election,
+    address rendezvous, new-backend bring-up); past it survivors fall
+    back to the coordinated exit-77 restart."""
+    return _env_float("HVD_ELASTIC_REBUILD_TIMEOUT_S", 60.0)
+
+
 def min_np() -> int:
     """Smallest process count the world may shrink to in place
     (``run.py --elastic --min-np K`` exports it). Below it, survivors
@@ -135,6 +151,75 @@ def checkpoint_dir() -> Optional[str]:
     return os.path.join(d, "ckpt") if d else None
 
 
+def verdict_wait_s() -> float:
+    """How long a raised step should wait for a death verdict to explain
+    it: two leases (the runtime error usually beats the heartbeat), plus
+    the KV-failover window when a file plane exists — a rank-0 death
+    must first time the primary plane out before its file-plane lease
+    can expire."""
+    extra = kv_failover_s() if elastic_dir() else 0.0
+    return 2 * lease_s() + extra
+
+
+class KVPlaneTimeout(Exception):
+    """A primary-KV operation exceeded the probe deadline. The dead
+    coordination service's failure mode is a HANG, not an error
+    (measured: blocked key_value RPCs never return once the host dies),
+    so 'not answering' must be detected by deadline, and this exception
+    feeds the failover clock exactly like an RPC error."""
+
+
+class _AbandonableWorker:
+    """Runs closures on a worker thread with a deadline. A timed-out
+    call leaves the worker BUSY (its thread may be blocked forever
+    inside a dead service's RPC); further calls fail fast with
+    KVPlaneTimeout — the plane is still unanswering — WITHOUT stacking
+    more blocked calls, so a permanently hung plane costs one parked
+    thread, not one per tick. If the blocked RPC eventually returns
+    (the service was merely slow), the late result is drained on the
+    next call and probing resumes on the same thread."""
+
+    def __init__(self):
+        import queue as _q
+
+        self._req: "object" = _q.Queue()
+        self._res: "object" = _q.Queue()
+        self._empty = _q.Empty
+        self._busy = False  # a call timed out and is still outstanding
+        t = threading.Thread(target=self._loop,
+                             name="hvd-elastic-kvprobe", daemon=True)
+        t.start()
+
+    def _loop(self):
+        while True:
+            fn = self._req.get()
+            try:
+                self._res.put(("ok", fn()))
+            except BaseException as exc:
+                self._res.put(("exc", exc))
+
+    def call(self, fn, timeout_s: float):
+        if self._busy:
+            try:
+                self._res.get_nowait()  # stale result of the timed-out
+                self._busy = False      # call: the thread came back
+            except self._empty:
+                raise KVPlaneTimeout(
+                    "previous primary KV op is still blocked (plane "
+                    "unanswering or wedged)") from None
+        self._req.put(fn)
+        try:
+            kind, val = self._res.get(timeout=timeout_s)
+        except self._empty:
+            self._busy = True
+            raise KVPlaneTimeout(
+                f"primary KV op exceeded {timeout_s:.1f}s (plane "
+                "unanswering or wedged)") from None
+        if kind == "exc":
+            raise val
+        return val
+
+
 class WorldChanged(Exception):
     """A death verdict landed: the current mesh is gone; reconfigure."""
 
@@ -148,11 +233,124 @@ def _write_json_atomic(path: str, payload: dict):
     tmp = path + ".tmp"
     with open(tmp, "w") as fh:
         json.dump(payload, fh)
+        fh.flush()
+        os.fsync(fh.fileno())
     os.replace(tmp, path)
 
 
+def _free_port() -> int:
+    import socket
+
+    with socket.socket() as s:
+        s.bind(("", 0))
+        return s.getsockname()[1]
+
+
+class FileKV:
+    """Atomic-rename file KV — the fallback coordination plane under
+    ``HVD_ELASTIC_DIR/kv`` (shared storage is already the supervisor's
+    assumption). Survivors cut the heartbeat/lease/tombstone namespace
+    over to it when the coordination-service KV stops answering within
+    :func:`kv_failover_s` — so losing the KV host (rank 0) yields an
+    attributed verdict through THIS plane instead of every survivor
+    waiting out ``KVTimeout`` into an unattributed abort. Also the
+    rendezvous plane for the in-place multi-survivor rebuild (the
+    coordination service being rebuilt cannot host its own election).
+
+    Unlike the TSL KV, writes are overwrite-in-place (rename), so beats
+    need no delete+insert dance; readers never observe a torn value."""
+
+    def __init__(self, directory: str):
+        self._dir = directory
+        os.makedirs(directory, exist_ok=True)
+
+    def _path(self, key: str) -> str:
+        # Keys are slash-namespaced; files are flat ('~' never appears
+        # in our key grammar).
+        return os.path.join(self._dir, key.replace("/", "~"))
+
+    def set(self, key: str, value: str, durable: bool = True):
+        """``durable=False`` skips the fsync: os.replace alone already
+        guarantees readers an untorn value, and ephemeral keys written
+        every tick (heartbeat mirrors) must not put a synchronous fsync
+        in the control loop — a beat lost to a power failure is
+        indistinguishable from one missed tick. Control records
+        (tombstones, rendezvous, done marks) stay durable."""
+        path = self._path(key)
+        tmp = f"{path}.{os.getpid()}.tmp"
+        with open(tmp, "w") as fh:
+            fh.write(value)
+            if durable:
+                fh.flush()
+                os.fsync(fh.fileno())
+        os.replace(tmp, path)
+
+    def try_get(self, key: str) -> Optional[str]:
+        try:
+            with open(self._path(key)) as fh:
+                return fh.read()
+        except OSError:
+            return None
+
+    def get(self, key: str, timeout_s: float) -> Optional[str]:
+        """Poll until the key exists; None on timeout (rendezvous
+        callers treat absence as 'fall back to the restart path')."""
+        deadline = time.monotonic() + timeout_s
+        pause = 0.05
+        while True:
+            val = self.try_get(key)
+            if val is not None:
+                return val
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                return None
+            time.sleep(min(pause, remaining))
+            pause = min(pause * 1.5, 0.5)
+
+    def delete(self, key: str):
+        try:
+            os.unlink(self._path(key))
+        except OSError:
+            pass
+
+
+def _on_coordination_error(*args):
+    """Replacement for the jax distributed client's process-terminating
+    failure callback (missed heartbeats AND polled service errors route
+    here). Called from a C++ thread: never raise, never block — just
+    record the evidence; the heartbeat lease owns the verdict."""
+    try:
+        LOG.error(
+            "coordination service reported a fatal error (%s) — "
+            "suppressed: elastic worlds survive the KV host; the "
+            "heartbeat lease / file-plane failover attributes what "
+            "actually died", " ".join(str(a) for a in args) or "n/a")
+        _tele.REGISTRY.counter("world.coordination_errors").inc()
+    except Exception:
+        pass
+
+
+def _rebuild_host() -> str:
+    """Reachable host for an elected root's fresh coordination service:
+    explicit ``HVD_ELASTIC_REBUILD_HOST``, else the original
+    coordinator's host when it is a loopback (the local-launcher world —
+    any root is reachable there), else this host's own name (multi-host
+    deployments with shared ``HVD_ELASTIC_DIR``)."""
+    explicit = os.environ.get("HVD_ELASTIC_REBUILD_HOST")
+    if explicit:
+        return explicit
+    old = os.environ.get("HVD_COORDINATOR_ADDRESS", "")
+    host = old.rsplit(":", 1)[0] if ":" in old else ""
+    if host in ("127.0.0.1", "localhost", "::1", "[::1]"):
+        return host
+    import socket
+
+    return socket.gethostname()
+
+
 def bring_up_distributed(coordinator_address: str, num_processes: int,
-                         process_id: int):
+                         process_id: int,
+                         init_timeout_s: Optional[float] = None):
     """Elastic-mode jax.distributed bring-up.
 
     The stock ``jax.distributed.initialize`` arms the coordination
@@ -178,10 +376,38 @@ def bring_up_distributed(coordinator_address: str, num_processes: int,
         gs.service = _xe.get_distributed_runtime_service(
             bind, num_processes,
             heartbeat_interval=10, max_missing_heartbeats=1_000_000)
+    if init_timeout_s is None:
+        init_timeout_s = _env_float("HVD_ELASTIC_INIT_TIMEOUT", 120.0)
+    # The client's OWN failure detector must be disarmed too: when the
+    # coordination-service HOST dies, every surviving client's
+    # PollForError long-poll fails instantly ("Socket closed") and the
+    # default callback LOG(FATAL)-terminates the survivor
+    # (xla/pjrt/distributed/client.h) — measured as a SIGABRT within
+    # ~1 ms of rank 0's SIGKILL. Missed-heartbeat deaths route through
+    # the same callback. Replacing it disarms the fatal — but on this
+    # jaxlib the binding cannot convert the callback's absl::Status
+    # argument to Python, so the invocation throws a C++ cast error
+    # that unwinds the agent thread into std::terminate. The termshield
+    # (core/native/termshield.cc) parks such threads instead of dying —
+    # the leak-the-wedged-thread doctrine this module already applies
+    # to backends and dispatch workers. Only with the shield installed
+    # is the replacement callback safe; without a toolchain we keep the
+    # stock fatal (the supervisor's relaunch then covers KV-host death).
+    kwargs = {}
+    try:
+        from horovod_tpu.core import native as _native
+
+        _native.load_termshield()
+        kwargs["missed_heartbeat_callback"] = _on_coordination_error
+    except Exception as exc:
+        LOG.warning(
+            "termshield unavailable (%s): coordination-HOST death will "
+            "terminate survivors (stock jax client behavior); the "
+            "supervisor relaunch remains the recovery path", exc)
     gs.client = _xe.get_distributed_runtime_client(
         coordinator_address, process_id,
-        init_timeout=int(_env_float("HVD_ELASTIC_INIT_TIMEOUT", 120.0)),
-        shutdown_on_destruction=False)
+        init_timeout=max(1, int(init_timeout_s)),
+        shutdown_on_destruction=False, **kwargs)
     gs.client.connect()
     gs.process_id = process_id
     gs.num_processes = num_processes
@@ -211,6 +437,25 @@ class ElasticWorld:
         self._kv = None
         self._seq = 0
         self._started_at = time.monotonic()
+        # KV-plane failover state: the file fallback plane (lazy), the
+        # first monotonic instant the primary KV stopped answering
+        # (None while healthy), and whether the lease plane has cut
+        # over to files for good.
+        self._file_kv: Optional[FileKV] = None
+        self._kv_err_since: Optional[float] = None
+        self._failed_over = False
+        # Deadline-probed primary-plane access (see _AbandonableWorker).
+        # The lock serializes callers: the beat thread and the main
+        # thread (announce_done/announce_active) share one worker whose
+        # queues carry no call correlation — two concurrent calls would
+        # cross-deliver each other's results.
+        self._kv_worker: Optional[_AbandonableWorker] = None
+        self._kv_worker_lock = threading.Lock()
+        # Reconfiguration in progress: the beat loop idles (it must not
+        # judge leases over a world being rebuilt) and topology.init's
+        # on_init callback must not clobber the state the rebuild is
+        # computing.
+        self._reconfiguring = False
         # peer -> (last value seen, monotonic time it last CHANGED):
         # liveness is judged by the counter advancing on OUR clock, so
         # cross-host wall-clock skew can never fake a death.
@@ -228,6 +473,13 @@ class ElasticWorld:
     def on_init(self, num_processes: int, process_index: int):
         """Called from ``topology.init`` once the world is known."""
         if not enabled():
+            return
+        if self._reconfiguring:
+            # Mid-rebuild re-entry (reconfigure calls topo.init): the
+            # rebuild function owns every field it is about to set —
+            # adopting jax's re-densified process index here would
+            # clobber the stable launch-rank identity the lease/death-
+            # note/journal plane keys on.
             return
         self.active = True
         self.pid = process_index
@@ -309,11 +561,19 @@ class ElasticWorld:
         _tele.REGISTRY.gauge("world.initial_processes").set(self.initial_np)
         _tele.REGISTRY.gauge("world.degraded").set(
             1 if self.nproc < self.initial_np else 0)
+        _tele.REGISTRY.gauge("world.kv_plane").set(
+            1 if self._failed_over else 0)
 
     # -- heartbeat lease ------------------------------------------------------
 
     def _ns(self) -> str:
-        return f"hvd/elastic/g{self.generation}"
+        # Epoch-scoped past epoch 0: an in-place shrink re-densifies
+        # ranks, and the FILE plane's keys survive the transition — a
+        # fresh namespace keeps the new world's beats from colliding
+        # with the old world's (the journal makes the epoch agreed
+        # across members before any beat lands in the new namespace).
+        base = f"hvd/elastic/g{self.generation}"
+        return base if self.epoch == 0 else f"{base}/e{self.epoch}"
 
     def _hb_key(self, p: int) -> str:
         return f"{self._ns()}/hb/p{p}"
@@ -331,11 +591,100 @@ class ElasticWorld:
             self._kv = _coord.JaxKV()
         return self._kv
 
+    def _get_file_kv(self) -> Optional[FileKV]:
+        if self._file_kv is None:
+            d = elastic_dir()
+            if d:
+                try:
+                    self._file_kv = FileKV(os.path.join(d, "kv"))
+                except OSError:
+                    return None
+        return self._file_kv
+
+    def _kv_probe_timeout_s(self) -> float:
+        return max(0.2, min(lease_s() / 2.0, kv_failover_s() / 2.0))
+
+    def _primary_call(self, fn):
+        """Run a primary-plane KV op under a deadline; a hang counts as
+        the plane not answering (KVPlaneTimeout feeds the failover
+        clock) and the wedged worker is abandoned. Serialized: the
+        worker's queues have no call correlation, so exactly one call
+        may be in flight (callers are the beat thread and the main
+        thread's announce_* — both bounded by the probe deadline)."""
+        with self._kv_worker_lock:
+            w = self._kv_worker
+            if w is None:
+                w = self._kv_worker = _AbandonableWorker()
+            # A timed-out worker stays — marked busy — and later calls
+            # fail fast until its blocked RPC returns (or never): a
+            # permanently hung plane costs ONE parked thread total,
+            # while a transient stall resumes probing on the same one.
+            return w.call(fn, self._kv_probe_timeout_s())
+
     def _beat_loop(self):
         interval = max(0.1, lease_s() / 4.0)
         while not self._stop.wait(interval):
-            if not self._beat_once():
-                return
+            try:
+                if not self._beat_once():
+                    return
+            except Exception:
+                # The lease MUST keep running: a surprise here would
+                # silently kill liveness detection for the whole world
+                # (we'd publish no beats — peers verdict us — and judge
+                # none — we'd never detect a real death).
+                LOG.warning("heartbeat tick failed; lease continues",
+                            exc_info=True)
+
+    def _note_kv_failure(self, exc):
+        """A primary-KV operation failed: start (or continue) the
+        failover clock; cut over once the plane has been unanswering for
+        a full :func:`kv_failover_s` and a file plane exists."""
+        now = time.monotonic()
+        if self._kv_err_since is None:
+            self._kv_err_since = now
+            return
+        if self._failed_over or now - self._kv_err_since < kv_failover_s():
+            return
+        fkv = self._get_file_kv()
+        if fkv is None:
+            return  # no fallback plane: supervisor territory (as before)
+        self._failed_over = True
+        self._kv = None  # never touch the dead client again from here
+        down_s = now - self._kv_err_since
+        # Fresh leases on the file plane: every still-live peer has been
+        # mirroring beats there all along, but judge from NOW so the
+        # primary outage itself cannot be double-counted as peer
+        # silence. A peer that is genuinely gone (the KV host) will
+        # never beat again on ANY plane and expires one lease later.
+        for p in list(self._beats):
+            self._beats[p] = (self._beats[p][0], now)
+        _tele.REGISTRY.counter("world.kv_failovers").inc()
+        _tele.REGISTRY.gauge("world.kv_plane").set(1)
+        reason = (f"KV-plane failover: coordination KV unanswering for "
+                  f"{down_s:.1f}s (> {kv_failover_s():.1f}s; last error: "
+                  f"{str(exc)[:200]}); heartbeat lease now rides the "
+                  f"file plane under {elastic_dir()}")
+        LOG.error(reason)
+        self._dump(reason)
+
+    def _publish_beat(self, kv, value: str, vanish: bool,
+                      file_plane: bool):
+        if file_plane:
+            # Atomic-rename writes overwrite in place — no delete+insert
+            # dance, and readers never see a gap. Non-durable: a beat is
+            # an ephemeral counter, not a control record.
+            if vanish:
+                kv.delete(self._hb_key(self.pid))
+            else:
+                kv.set(self._hb_key(self.pid), value, durable=False)
+            return
+        # The coordination-service KV is INSERT-ONLY (a second set of
+        # the same key fails ALREADY_EXISTS): each beat deletes then
+        # re-inserts. A reader landing in the gap sees a missing key for
+        # one tick, which deliberately does NOT advance any verdict.
+        kv.delete(self._hb_key(self.pid))
+        if not vanish:
+            kv.set(self._hb_key(self.pid), value)
 
     def _beat_once(self) -> bool:
         """One heartbeat tick: publish our counter, judge each peer's.
@@ -343,31 +692,69 @@ class ElasticWorld:
         with self._lock:
             if self.nproc <= 1:
                 return False  # shrunk to a lone controller: no lease
+            if self._reconfiguring:
+                return True  # world mid-rebuild: no publishes, no verdicts
             peers = [p for p in self.live
                      if p != self.pid and p not in self.dead]
-        try:
-            kv = self._get_kv()
-        except Exception:
-            return True  # coordination service not up yet
-        self._seq += 1
-        try:
-            # The coordination-service KV is INSERT-ONLY (a second set
-            # of the same key fails ALREADY_EXISTS): each beat deletes
-            # then re-inserts. A reader landing in the gap sees a
-            # missing key for one tick, which deliberately does NOT
-            # advance any verdict below.
-            kv.delete(self._hb_key(self.pid))
-            kv.set(self._hb_key(self.pid), str(self._seq))
-        except Exception:
-            return True  # KV down: rank 0 died — supervisor territory
+        fkv = self._get_file_kv()
+        # Fault site hb.beat (core/faultline.py): skip/freeze stop the
+        # counter advancing (a process that is alive but not beating —
+        # the case the lease must distinguish from death), vanish
+        # deletes the key outright.
+        fault_mode = _flt.heartbeat()
+        if fault_mode not in ("skip", "freeze"):
+            self._seq += 1
+        beat_val = str(self._seq)
+        if fault_mode != "skip":
+            # Mirror every beat to the file plane while the primary is
+            # healthy: failover is then just "stop asking the dead
+            # service" — the fallback plane is already warm.
+            if fkv is not None:
+                try:
+                    self._publish_beat(fkv, beat_val,
+                                       fault_mode == "vanish",
+                                       file_plane=True)
+                except OSError as exc:
+                    LOG.warning("file-plane beat failed: %s", exc)
+            if not self._failed_over:
+                try:
+                    # Deadline-probed: a dead service HANGS these RPCs
+                    # rather than erroring them (measured) — the probe
+                    # turns the hang into failover-clock evidence.
+                    self._primary_call(lambda: self._publish_beat(
+                        self._get_kv(), beat_val, fault_mode == "vanish",
+                        file_plane=False))
+                    self._kv_err_since = None
+                except Exception as exc:
+                    # Coordination service not up yet, or down for good
+                    # (rank 0 died): the failover clock decides which.
+                    self._note_kv_failure(exc)
+                    if not self._failed_over:
+                        return True
+        reads: Dict[int, tuple] = {}
+        if self._failed_over:
+            if fkv is None:
+                return True
+            for p in peers:
+                reads[p] = (fkv.try_get(self._hb_key(p)),
+                            fkv.try_get(self._tomb_key(p)),
+                            fkv.try_get(self._done_key(p)))
+        else:
+            def _read_all():
+                kv = self._get_kv()
+                return {p: (kv.try_get(self._hb_key(p)),
+                            kv.try_get(self._tomb_key(p)),
+                            kv.try_get(self._done_key(p)))
+                        for p in peers}
+
+            try:
+                reads = self._primary_call(_read_all)
+            except Exception as exc:
+                self._note_kv_failure(exc)
+                return True
         now = time.monotonic()
         for p in peers:
-            try:
-                val = kv.try_get(self._hb_key(p))
-                tomb = kv.try_get(self._tomb_key(p))
-                done = kv.try_get(self._done_key(p))
-            except Exception:
-                break
+            val, tomb, done = reads[p]
             if done is not None:
                 # The peer ANNOUNCED completion (announce_done) before
                 # going silent: that is a finished rank, not a casualty
@@ -424,6 +811,13 @@ class ElasticWorld:
         return True
 
     def _declare_dead(self, p: int, reason: str):
+        if self._failed_over:
+            # The verdict was reached through the fallback plane — the
+            # attribution must say so (and name the likely first cause:
+            # the KV host going down IS how we got here).
+            reason += (" [attributed via the fallback file KV plane; "
+                       "the coordination KV is down — its host may be "
+                       "the casualty]")
         with self._lock:
             if p in self.dead:
                 return
@@ -431,12 +825,19 @@ class ElasticWorld:
         LOG.error("elastic death verdict: process %d is dead (%s); "
                   "world epoch %d will reconfigure", p, reason, self.epoch)
         _tele.REGISTRY.counter("world.deaths").inc()
-        try:
-            self._get_kv().set(self._tomb_key(p),
-                               json.dumps({"by": self.pid,
-                                           "reason": reason}))
-        except Exception:
-            pass
+        tomb = json.dumps({"by": self.pid, "reason": reason})
+        if not self._failed_over:
+            try:  # probed: a dead service hangs rather than errors
+                self._primary_call(
+                    lambda: self._get_kv().set(self._tomb_key(p), tomb))
+            except Exception:
+                pass
+        fkv = self._get_file_kv()
+        if fkv is not None:
+            try:  # mirrored: peers already failed over must see it too
+                fkv.set(self._tomb_key(p), tomb)
+            except OSError:
+                pass
         d = elastic_dir()
         if d:
             try:
@@ -504,8 +905,10 @@ class ElasticWorld:
     # -- reconfiguration ------------------------------------------------------
 
     def reconfigure(self):
-        """Act on the death verdict: shrink the world in place when the
-        survivors are exactly this controller's chips, else raise
+        """Act on the death verdict: shrink the world in place — to this
+        lone controller's chips, or (multi-survivor) to a rebuilt
+        multi-process backend over the survivor set rendezvoused through
+        the surviving file plane — else raise
         :class:`ElasticRestartRequired` for the supervisor path. Returns
         the new world epoch."""
         with self._lock:
@@ -517,13 +920,21 @@ class ElasticWorld:
             raise ElasticRestartRequired(
                 f"{len(survivors)} survivor(s) < --min-np {min_np()}; "
                 "waiting for the supervisor to regrow the world")
-        if survivors != [self.pid]:
-            raise ElasticRestartRequired(
-                f"survivors {survivors} span multiple controllers; "
-                "in-place shrink needs a coordinated restart")
+        self._reconfiguring = True  # beat loop idles; on_init defers
+        try:
+            if survivors == [self.pid]:
+                return self._shrink_local(dead)
+            return self._shrink_multi(dead, survivors)
+        finally:
+            self._reconfiguring = False
+
+    def _shrink_local(self, dead: Dict[int, str]):
+        """The lone-survivor path: rebuild a single-process backend over
+        this controller's chips (PR 9 semantics, unchanged)."""
         t0 = time.monotonic()
         old_epoch, old_np = self.epoch, self.nproc
         self._mark_reconfigure_on_timeline()
+        self._abandon_engine_if_wedged()
         from horovod_tpu.common import topology as topo
 
         LOG.warning("elastic shrink: draining the engine and tearing "
@@ -556,6 +967,169 @@ class ElasticWorld:
         LOG.warning(reason)
         self._dump(reason)
         return self.epoch
+
+    def _shrink_multi(self, dead: Dict[int, str], survivors: List[int]):
+        """In-place multi-survivor shrink: the survivors elect the
+        lowest live rank as re-densification root, rendezvous a fresh
+        coordination service through the surviving file plane (the
+        coordination KV being rebuilt cannot host its own election),
+        rebuild a multi-process backend over the survivor set at
+        epoch+1 — no supervisor relaunch — and the caller resumes from
+        the newest checkpoint exactly as the single-survivor path does.
+        Any election/rebuild timeout falls back to the coordinated
+        exit-77 restart via :class:`ElasticRestartRequired`."""
+        fkv = self._get_file_kv()
+        if fkv is None:
+            raise ElasticRestartRequired(
+                f"survivors {survivors} span multiple controllers and "
+                "no HVD_ELASTIC_DIR file plane exists for the rebuild "
+                "rendezvous; coordinated restart")
+        t0 = time.monotonic()
+        old_epoch, old_np = self.epoch, self.nproc
+        new_epoch = old_epoch + 1
+        root = survivors[0]  # election: lowest live rank, deterministic
+        my_new_pid = survivors.index(self.pid)
+        ns = f"hvd/elastic/g{self.generation}/rebuild/e{new_epoch}"
+        LOG.warning(
+            "elastic multi-survivor shrink: survivors %s elect root %d; "
+            "world epoch %d -> %d rebuilding in place", survivors, root,
+            old_epoch, new_epoch)
+        if self.pid == root:
+            addr = f"{_rebuild_host()}:{_free_port()}"
+            rec = {"addr": addr, "survivors": survivors,
+                   "epoch": new_epoch, "root": root,
+                   "wall": round(time.time(), 3)}
+            try:
+                fkv.set(f"{ns}/addr", json.dumps(rec))
+            except OSError as exc:
+                raise ElasticRestartRequired(
+                    f"cannot publish the rebuild rendezvous: {exc}")
+        else:
+            raw = fkv.get(f"{ns}/addr", rebuild_timeout_s())
+            if raw is None:
+                raise ElasticRestartRequired(
+                    f"rebuild rendezvous timed out after "
+                    f"{rebuild_timeout_s():.0f}s waiting for root "
+                    f"{root}'s coordinator address")
+            try:
+                rec = json.loads(raw)
+            except ValueError as exc:
+                raise ElasticRestartRequired(
+                    f"torn rebuild rendezvous record: {exc}")
+            if rec.get("survivors") != survivors:
+                raise ElasticRestartRequired(
+                    f"survivor sets diverged: root published "
+                    f"{rec.get('survivors')}, this process sees "
+                    f"{survivors}; a coordinated restart resolves it")
+            addr = rec["addr"]
+        self._mark_reconfigure_on_timeline()
+        self._abandon_engine_if_wedged()
+        from horovod_tpu.common import topology as topo
+
+        LOG.warning("elastic shrink: draining the engine and tearing "
+                    "down world epoch %d", old_epoch)
+        topo.shutdown()  # drains the engine; aborts in-flight rounds
+        try:
+            devs = self._rebuild_multi_backend(
+                addr, len(survivors), my_new_pid)
+        except Exception as exc:
+            raise ElasticRestartRequired(
+                f"multi-survivor backend rebuild failed ({exc}); "
+                "falling back to the coordinated restart")
+        topo.init()
+        with self._lock:
+            self.epoch = int(rec["epoch"])
+            self.nproc = len(survivors)
+            # live/pid keep the ORIGINAL launch ranks: the lease plane,
+            # death notes and the supervisor all key on them; only jax's
+            # own process ids re-densify (my_new_pid).
+            self.live = list(survivors)
+            self._changed.clear()
+            self.dead = {}
+            self._beats.clear()
+            self._done_peers.clear()
+            dead_list = sorted(dead)
+        self._started_at = time.monotonic()  # fresh grace on the new ns
+        self._failed_over = False  # the NEW coordination service is up
+        self._kv_err_since = None
+        self._kv = None  # lazily rebuilt over the new client
+        from horovod_tpu.core import coordinator as _coord
+
+        _coord.set_world_epoch(self.epoch)
+        if my_new_pid == 0:
+            self._write_journal("shrink_multi", lost=dead_list,
+                                survivors=survivors)
+        self._publish_gauges()
+        _tele.REGISTRY.counter("world.reconfigures").inc()
+        reason = (f"RECONFIGURE: world epoch {old_epoch} -> {self.epoch};"
+                  f" lost process(es) {dead_list} "
+                  f"({'; '.join(dead[p] for p in dead_list)}); "
+                  f"continuing IN PLACE with {len(survivors)}/{old_np} "
+                  f"controller(s) {survivors} (root {root}), "
+                  f"{len(devs)} rank(s), after "
+                  f"{time.monotonic() - t0:.1f}s")
+        LOG.warning(reason)
+        self._dump(reason)
+        return self.epoch
+
+    def _rebuild_multi_backend(self, addr: str, num_processes: int,
+                               process_id: int):
+        """Swap the poisoned runtime for a fresh multi-process backend
+        over the survivor set: leak the old client (and old service, if
+        this process hosted one — threads may be wedged inside the dead
+        peer's sockets), detach jax.distributed, clear backends, and
+        bring up a NEW coordination service + client at ``addr`` (the
+        elected root hosts the service)."""
+        import jax
+        from jax._src import distributed as _dist
+
+        gs = _dist.global_state
+        try:
+            self._leaked.append(jax.local_devices()[0].client)
+        except Exception:
+            pass
+        self._leaked.append(gs.client)
+        if getattr(gs, "service", None) is not None:
+            # This process hosted the OLD coordination service (a
+            # non-zero rank died while rank 0 survived): it still owns
+            # its port and threads — leak it, never destroy.
+            self._leaked.append(gs.service)
+        gs.client = None
+        gs.service = None
+        try:
+            if jax.default_backend() == "cpu":
+                # The fresh CPU client must re-wire gloo over the NEW
+                # world's store, not the dead one's.
+                jax.config.update(
+                    "jax_cpu_collectives_implementation", "gloo")
+        except Exception:
+            pass
+        try:
+            jax.clear_backends()
+        except AttributeError:  # removed from the jax namespace in 0.4.36
+            from jax._src import api as _api
+
+            _api.clear_backends()
+        jax.clear_caches()
+        bring_up_distributed(addr, num_processes, process_id,
+                             init_timeout_s=rebuild_timeout_s())
+        return jax.devices()
+
+    def _abandon_engine_if_wedged(self):
+        """After a KV-plane failover the engine's control plane is
+        wedged inside the dead coordination service (blocked RPCs never
+        return — measured): a normal drain would JOIN those threads
+        forever. Abandon the engine instead (threads parked, object
+        leaked), so topology.shutdown's engine teardown is a no-op."""
+        if not self._failed_over:
+            return
+        from horovod_tpu.core import engine as _eng
+
+        e = _eng.abandon_engine()
+        if e is not None:
+            LOG.warning("elastic: abandoned the engine (control plane "
+                        "wedged in the dead KV service)")
+            self._leaked.append(e)
 
     def _mark_reconfigure_on_timeline(self):
         """Best-effort RECONFIGURE instant on the live engine timeline
@@ -699,22 +1273,39 @@ class ElasticWorld:
         from under each other. Revoked by :meth:`announce_active`."""
         if not self.active or self.nproc <= 1:
             return
-        try:
-            kv = self._get_kv()
-            kv.delete(self._done_key(self.pid))  # insert-only store
-            kv.set(self._done_key(self.pid), str(round(time.time(), 3)))
-        except Exception:
-            pass
+        stamp = str(round(time.time(), 3))
+        if not self._failed_over:
+            def _mark():
+                kv = self._get_kv()
+                kv.delete(self._done_key(self.pid))  # insert-only store
+                kv.set(self._done_key(self.pid), stamp)
+
+            try:  # probed: must not wedge the exiting main thread
+                self._primary_call(_mark)
+            except Exception:
+                pass
+        fkv = self._get_file_kv()
+        if fkv is not None:
+            try:  # mirrored: a failed-over peer must see the mark too
+                fkv.set(self._done_key(self.pid), stamp)
+            except OSError:
+                pass
 
     def announce_active(self):
         """Revoke a standing completion mark (a later ``fit`` on the
         same world): peers resume leasing this process normally."""
         if not self.active or self.nproc <= 1:
             return
-        try:
-            self._get_kv().delete(self._done_key(self.pid))
-        except Exception:
-            pass
+        if not self._failed_over:
+            try:
+                self._primary_call(
+                    lambda: self._get_kv().delete(
+                        self._done_key(self.pid)))
+            except Exception:
+                pass
+        fkv = self._get_file_kv()
+        if fkv is not None:
+            fkv.delete(self._done_key(self.pid))
 
     def shutdown(self):
         self._stop.set()
@@ -735,6 +1326,8 @@ class ElasticWorld:
                     "size": size, "processes": self.nproc,
                     "initial_processes": self.initial_np,
                     "degraded": self.nproc < self.initial_np,
+                    "kv_plane": ("file" if self._failed_over
+                                 else "coordination-service"),
                     "dead": dict(self.dead)}
 
 

@@ -33,6 +33,7 @@ from typing import Callable, Dict, Optional
 
 import numpy as np
 
+from horovod_tpu.core import faultline as flt
 from horovod_tpu.core import numerics as numx
 from horovod_tpu.core import telemetry as tele
 from horovod_tpu.core import timeline as tl
@@ -42,6 +43,18 @@ LOG = logging.getLogger("horovod_tpu.engine")
 DEFAULT_CYCLE_TIME_S = 0.005  # reference: 5 ms, operations.cc:1747
 DEFAULT_FUSION_THRESHOLD = 64 * 1024 * 1024  # reference: 64 MB, operations.cc:1739
 STALL_WARNING_TIME_S = 60.0  # reference: operations.cc:253
+
+
+def _poison_result(fault, out: np.ndarray) -> np.ndarray:
+    """engine.exec 'poison' fault: NaN-fill a float result AFTER the real
+    collective ran — the reduced value every rank hands back is poisoned,
+    which is what drives the numerics engine_check_result attribution
+    (non-float results pass through; there is no NaN to poison with)."""
+    if fault is None or fault.mode != "poison" or out.dtype.kind not in "fc":
+        return out
+    out = np.array(out)  # never scribble on a caller-shared buffer
+    out[...] = np.nan
+    return out
 
 
 class EngineError(RuntimeError):
@@ -149,6 +162,7 @@ class JaxExecutor:
     def allreduce(self, flat: np.ndarray, average: bool) -> np.ndarray:
         from horovod_tpu.ops import collectives as C
 
+        fault = flt.engine_exec("allreduce")  # stall sleeps, error raises
         n = flat.shape[0]
         out = np.empty_like(flat)
         stage_s = 0.0
@@ -170,19 +184,24 @@ class JaxExecutor:
                 out[off: off + take] = res[:take]
                 off += take
         self.last_stage_s = stage_s
-        return out
+        return _poison_result(fault, out)
 
     def allgather(self, tensor: np.ndarray) -> np.ndarray:
         from horovod_tpu.ops import collectives as C
 
+        fault = flt.engine_exec("allgather")
         with self._ctx(tensor):
-            return np.asarray(C.allgather(self._stage(tensor)))
+            return _poison_result(
+                fault, np.asarray(C.allgather(self._stage(tensor))))
 
     def broadcast(self, tensor: np.ndarray, root_rank: int) -> np.ndarray:
         from horovod_tpu.ops import collectives as C
 
+        fault = flt.engine_exec("broadcast")
         with self._ctx(tensor):
-            return np.asarray(C.broadcast(self._stage(tensor), root_rank))
+            return _poison_result(
+                fault,
+                np.asarray(C.broadcast(self._stage(tensor), root_rank)))
 
 
 def _multi_controller() -> bool:
@@ -378,6 +397,12 @@ class Engine:
     # operations.cc:2264-2380) ------------------------------------------------
 
     def _enqueue(self, entry: _Entry) -> int:
+        # Fault site engine.submit (core/faultline.py): a failed submit
+        # raises before any handle/queue state exists — same observable
+        # shape as an organic enqueue rejection.
+        injected = flt.engine_submit(entry.name)
+        if injected is not None:
+            raise EngineError(injected)
         with self._lock:
             if self._shutdown.is_set():
                 raise ShutdownError("engine is shut down")
@@ -847,6 +872,32 @@ class Engine:
             except Exception:
                 pass
 
+    def abandon(self):
+        """Elastic teardown of a WEDGED engine (core/elastic.py): the
+        coordination KV host died and blocked KV RPCs never return, so
+        :meth:`shutdown`'s thread join would hang forever. Fail the
+        outstanding handles, poison the coordinator WITHOUT publishing
+        (a tombstone set would wedge too), and leave the loop thread
+        parked inside the dead service — the caller parks this object
+        so nothing it references is ever destroyed."""
+        c = self._coordinator
+        if c is not None:
+            c.dead = c.dead or "engine abandoned (elastic reconfiguration)"
+            c._closed = True  # a blocked read aborts IF it ever returns
+        self._shutdown.set()
+        self._wake.set()
+        with self._lock:
+            handles = list(self._handles.values())
+            self._handles.clear()
+            self._pending_names.clear()
+        for h in handles:
+            if not h.event.is_set():
+                h.error = ShutdownError(
+                    "engine abandoned: coordination KV plane lost")
+                h.event.set()
+        self.timeline.close()
+        tl.uninstall_sigusr1(self._dump_flight)
+
     def shutdown(self):
         # Publish the shutdown tombstone first: peers blocked mid-round on
         # our next message discover it and surface ShutdownError instead
@@ -911,3 +962,22 @@ def shutdown_engine():
         if _engine is not None:
             _engine.shutdown()
             _engine = None
+
+
+def abandon_engine():
+    """Drop the engine singleton WITHOUT joining its threads — for
+    elastic reconfiguration after the coordination KV plane died, where
+    a blocked negotiation RPC never returns and a normal shutdown would
+    hang on the join. Returns the abandoned engine so the caller can
+    PARK it (its trampolines/threads must outlive the abandonment), or
+    None when no engine existed."""
+    global _engine
+    with _engine_lock:
+        e, _engine = _engine, None
+    if e is None:
+        return None
+    try:
+        e.abandon()
+    except Exception:
+        LOG.warning("engine abandon failed", exc_info=True)
+    return e

@@ -1,0 +1,408 @@
+"""Faultline — deterministic fault injection for the control plane.
+
+PR 9 made rank loss survivable, but the only fault the chaos tier could
+reproduce was a clean SIGKILL. At pod scale the common failures are
+*messy* — slow KV reads, dropped heartbeats, wedged submits, torn
+checkpoint writes (arxiv 1909.09756 operates at scales where partial
+failure is the steady state; the reference aborts the world on any of
+them, arxiv 1802.05799) — and none of the recovery ladder below the
+SIGKILL rung is tested unless those faults are injectable on demand.
+
+This module is the injection registry. Sites are named choke points
+threaded through the code base; each is one cheap guarded call that is a
+no-op (one module-global ``is None`` check) unless ``HVD_FAULTS`` armed
+it — no spec means zero overhead and byte-identical behavior (pinned by
+tests/test_faultline.py).
+
+Spec grammar (``HVD_FAULTS``, comma-separated)::
+
+    site:mode:count[:param]
+
+- ``site`` — a name from :data:`SITES` below.
+- ``mode`` — what to do when the site arms (see the per-site table).
+- ``count`` — how many consecutive armings fire: an integer ``N``, ``*``
+  (every arming), or ``P%`` (each arming fires with probability P/100,
+  drawn from the stream ``HVD_FAULTS_SEED`` seeds — deterministic per
+  seed, so a flaky-looking schedule is replayable). An ``@M`` suffix
+  (``N@M``, ``*@M``) delays the first firing to the M-th arming
+  (1-based) — e.g. ``hb.beat:skip:*@12`` beats healthily 11 times,
+  then goes silent forever (the frozen-process signature the lease
+  must distinguish from a startup no-show).
+- ``param`` — mode-specific (e.g. delay seconds). Everything after the
+  third ``:`` is the param, so params may contain colons.
+
+Per-rank scoping is the launcher's job: ``run.py --faults RANK:SPEC``
+sets ``HVD_FAULTS`` in that child only (repeatable; several specs for
+one rank join with commas).
+
+Sites and modes::
+
+    kv.get       delay(param=s) | error            coordination-KV blocking read
+    kv.set       delay(param=s) | error | torn     KV write (torn = half the value lands)
+    kv.try_get   delay(param=s) | vanish           KV probe (vanish = key reads absent)
+    hb.beat      skip | freeze | vanish            heartbeat publish (skip/freeze stop
+                                                   the counter; vanish deletes the key)
+    engine.submit  fail                            *_async enqueue raises
+    engine.exec    stall(param=s) | poison | error  executor call (poison = NaN result)
+    ckpt.write     torn                            checkpoint save dies mid-write
+
+Every firing increments ``fault.injected`` + ``fault.injected.<site>``,
+appends to a bounded record the flight dumps embed (``"faults"`` section
+— post-mortems distinguish injected from organic failures), and stamps a
+``FAULT_INJECTED`` instant into the live engine's flight-recorder ring
+when one exists.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import random
+import threading
+import time
+from dataclasses import dataclass
+from typing import Dict, List, Optional
+
+LOG = logging.getLogger("horovod_tpu.faultline")
+
+#: The valid injection sites (parse errors name this list).
+SITES = ("kv.get", "kv.set", "kv.try_get", "hb.beat",
+         "engine.submit", "engine.exec", "ckpt.write")
+
+_MODES = {
+    "kv.get": ("delay", "error"),
+    "kv.set": ("delay", "error", "torn"),
+    "kv.try_get": ("delay", "vanish"),
+    "hb.beat": ("skip", "freeze", "vanish"),
+    "engine.submit": ("fail",),
+    "engine.exec": ("stall", "poison", "error"),
+    "ckpt.write": ("torn",),
+}
+
+
+class FaultInjected(RuntimeError):
+    """An injected error fault fired. Sites that surface errors through
+    an existing exception taxonomy (KVError, EngineError) wrap or
+    re-raise it there; the message always carries the ``injected fault``
+    marker so post-mortems and tests can tell it from an organic
+    failure."""
+
+
+@dataclass
+class _Spec:
+    site: str
+    mode: str
+    remaining: Optional[int]  # None = unlimited ('*' or probabilistic)
+    prob: Optional[float]     # None = deterministic count
+    param: Optional[str]
+    skip_first: int = 0       # armings to pass through before firing
+    fired: int = 0
+
+    def describe(self) -> str:
+        p = f":{self.param}" if self.param is not None else ""
+        n = "*" if self.remaining is None and self.prob is None else (
+            f"{self.prob * 100:g}%" if self.prob is not None
+            else str(self.remaining))
+        at = f"@{self.skip_first + 1}" if self.skip_first else ""
+        return f"{self.site}:{self.mode}:{n}{at}{p}"
+
+
+@dataclass
+class Fault:
+    """One armed firing, handed back to the call site."""
+
+    site: str
+    mode: str
+    param: Optional[str]
+
+    def seconds(self, default: float = 0.05) -> float:
+        """The param as seconds (delay/stall modes)."""
+        try:
+            return max(0.0, float(self.param))
+        except (TypeError, ValueError):
+            return default
+
+    def describe(self) -> str:
+        p = f" param={self.param}" if self.param is not None else ""
+        return f"injected fault at {self.site}: {self.mode}{p}"
+
+
+# Armed specs by site. None = disarmed (the zero-overhead fast path: every
+# site guard is `if _SPECS is None: return None`). Populated once from
+# HVD_FAULTS at import; tests re-arm through configure().
+_SPECS: Optional[Dict[str, List[_Spec]]] = None
+_RNG = random.Random()
+_LOCK = threading.Lock()
+# Bounded record of fired faults — embedded in every flight dump.
+_RECORDS: List[dict] = []
+_RECORD_CAP = 256
+
+
+class FaultSpecError(ValueError):
+    """HVD_FAULTS did not parse. Loud by design: a chaos run with a
+    silently-dropped spec would 'pass' without testing anything."""
+
+
+def _parse(spec: str) -> Dict[str, List[_Spec]]:
+    out: Dict[str, List[_Spec]] = {}
+    for part in spec.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        fields = part.split(":", 3)
+        if len(fields) < 3:
+            raise FaultSpecError(
+                f"bad HVD_FAULTS entry {part!r}: want "
+                "site:mode:count[:param]")
+        site, mode, count = fields[0], fields[1], fields[2]
+        param = fields[3] if len(fields) > 3 else None
+        if site not in SITES:
+            raise FaultSpecError(
+                f"unknown fault site {site!r}; valid sites: "
+                f"{', '.join(SITES)}")
+        if mode not in _MODES[site]:
+            raise FaultSpecError(
+                f"site {site} has no mode {mode!r}; valid modes: "
+                f"{', '.join(_MODES[site])}")
+        remaining: Optional[int] = None
+        prob: Optional[float] = None
+        skip_first = 0
+        count, at, offset = count.partition("@")
+        if at:
+            try:
+                skip_first = int(offset) - 1  # '@M' = fire on the M-th
+            except ValueError:
+                raise FaultSpecError(
+                    f"bad '@' offset {offset!r} in {part!r}") from None
+            if skip_first < 0:
+                raise FaultSpecError(
+                    f"'@' offset is 1-based in {part!r}")
+        if count == "*":
+            pass
+        elif count.endswith("%"):
+            try:
+                prob = float(count[:-1]) / 100.0
+            except ValueError:
+                raise FaultSpecError(
+                    f"bad probability {count!r} in {part!r}") from None
+            if not 0.0 <= prob <= 1.0:
+                raise FaultSpecError(
+                    f"probability {count!r} outside 0-100% in {part!r}")
+        else:
+            try:
+                remaining = int(count)
+            except ValueError:
+                raise FaultSpecError(
+                    f"bad count {count!r} in {part!r}: want an integer, "
+                    "'*', or 'P%', each with an optional '@M' "
+                    "first-firing offset") from None
+            if remaining < 0:
+                raise FaultSpecError(f"negative count in {part!r}")
+        out.setdefault(site, []).append(
+            _Spec(site, mode, remaining, prob, param,
+                  skip_first=skip_first))
+    return out
+
+
+def configure(spec: Optional[str], seed: Optional[int] = None):
+    """(Re-)arm from a spec string (None/empty disarms). Import-time
+    arming reads HVD_FAULTS + HVD_FAULTS_SEED; tests drive this
+    directly."""
+    global _SPECS, _RNG
+    with _LOCK:
+        parsed = _parse(spec) if spec else None
+        _SPECS = parsed if parsed else None
+        _RNG = random.Random(seed)
+        _RECORDS.clear()
+
+
+def reset():
+    """Tests only: disarm and clear the fired-fault record."""
+    configure(None)
+
+
+def armed() -> bool:
+    return _SPECS is not None
+
+
+def snapshot() -> List[dict]:
+    """Fired-fault records (newest last) — the ``"faults"`` section of
+    flight dumps, and the supervisor's injected-vs-organic evidence."""
+    with _LOCK:
+        return list(_RECORDS)
+
+
+def active_spec() -> Optional[str]:
+    """The armed spec, re-serialized (None when disarmed) — what the
+    launcher prints next to a dead child that ran with injections."""
+    specs = _SPECS
+    if specs is None:
+        return None
+    return ",".join(s.describe() for group in specs.values()
+                    for s in group)
+
+
+def _stamp_engine_ring(fault: Fault, detail: str):
+    """Best-effort FAULT_INJECTED instant into the live engine's
+    flight-recorder ring (post-mortems then carry the fault next to the
+    rounds it broke). Lazy import — the engine imports this module."""
+    try:
+        from horovod_tpu.core import engine as _eng
+
+        e = _eng._engine
+        if e is None:
+            return
+        if hasattr(e, "_lib") and getattr(e, "_ptr", None):
+            e._lib.hvd_engine_timeline_instant(
+                e._ptr, b"fault", b"FAULT_INJECTED",
+                (f'"site":"{fault.site}","mode":"{fault.mode}"').encode())
+        elif hasattr(e, "timeline"):
+            e.timeline.instant("fault", "FAULT_INJECTED",
+                               {"site": fault.site, "mode": fault.mode,
+                                "detail": detail})
+    except Exception:
+        pass
+
+
+def _record(fault: Fault, detail: str):
+    try:
+        from horovod_tpu.core import telemetry as _tele
+
+        _tele.REGISTRY.counter("fault.injected").inc()
+        _tele.REGISTRY.counter(f"fault.injected.{fault.site}").inc()
+    except Exception:
+        pass
+    with _LOCK:
+        _RECORDS.append({"site": fault.site, "mode": fault.mode,
+                         "param": fault.param, "detail": detail,
+                         "wall": round(time.time(), 3)})
+        del _RECORDS[:-_RECORD_CAP]
+    LOG.warning("FAULT INJECTED %s (%s)", fault.describe(), detail)
+    _stamp_engine_ring(fault, detail)
+
+
+def check(site: str, detail: str = "") -> Optional[Fault]:
+    """The site guard: None on the (default) disarmed path, else the
+    Fault to act on. Firing is recorded here — call sites only enact the
+    mode."""
+    specs = _SPECS
+    if specs is None:
+        return None
+    group = specs.get(site)
+    if not group:
+        return None
+    with _LOCK:
+        for s in group:
+            if s.skip_first > 0:
+                s.skip_first -= 1
+                continue
+            if s.prob is not None:
+                if _RNG.random() >= s.prob:
+                    continue
+            elif s.remaining is not None:
+                if s.remaining <= 0:
+                    continue
+                s.remaining -= 1
+            s.fired += 1
+            fault = Fault(s.site, s.mode, s.param)
+            break
+        else:
+            return None
+    _record(fault, detail)
+    return fault
+
+
+# -- per-site helpers (keep call sites to one line) --------------------------
+
+
+def kv_get(key: str):
+    """kv.get site: may sleep (delay) or raise FaultInjected (error).
+    Call INSIDE the KV backend's existing error wrapping so an injected
+    error surfaces as a KVError like an organic one."""
+    f = check("kv.get", key)
+    if f is None:
+        return
+    if f.mode == "delay":
+        time.sleep(f.seconds())
+    elif f.mode == "error":
+        raise FaultInjected(f.describe() + f" key={key}")
+
+
+def kv_set(key: str, value: str) -> str:
+    """kv.set site: returns the value to actually write (torn = first
+    half only); may sleep or raise FaultInjected."""
+    f = check("kv.set", key)
+    if f is None:
+        return value
+    if f.mode == "delay":
+        time.sleep(f.seconds())
+        return value
+    if f.mode == "error":
+        raise FaultInjected(f.describe() + f" key={key}")
+    if f.mode == "torn":
+        return value[: len(value) // 2]
+    return value
+
+
+def kv_try_get(key: str) -> bool:
+    """kv.try_get site: True = pretend the key is absent (vanish); may
+    sleep (delay)."""
+    f = check("kv.try_get", key)
+    if f is None:
+        return False
+    if f.mode == "delay":
+        time.sleep(f.seconds())
+        return False
+    return f.mode == "vanish"
+
+
+def heartbeat() -> Optional[str]:
+    """hb.beat site: the mode to apply to this tick's publish
+    ('skip' | 'freeze' | 'vanish'), or None."""
+    f = check("hb.beat")
+    return None if f is None else f.mode
+
+
+def engine_submit(name: str) -> Optional[str]:
+    """engine.submit site: an error message to fail the enqueue with, or
+    None (call sites raise their own EngineError so handle/queue
+    semantics stay identical to an organic submit failure)."""
+    f = check("engine.submit", name)
+    if f is None or f.mode != "fail":
+        return None
+    return f.describe() + f" tensor={name}"
+
+
+def engine_exec(op: str) -> Optional[Fault]:
+    """engine.exec site: may sleep in place (stall); returns the Fault
+    for 'poison'/'error' so the executor can act on the result."""
+    f = check("engine.exec", op)
+    if f is None:
+        return None
+    if f.mode == "stall":
+        time.sleep(f.seconds())
+        return None
+    if f.mode == "error":
+        raise FaultInjected(f.describe() + f" op={op}")
+    return f  # poison: the executor NaN-fills its result
+
+
+def ckpt_write() -> Optional[Fault]:
+    """ckpt.write site: 'torn' — the saver writes half the payload then
+    raises, simulating a rank dying mid-save."""
+    return check("ckpt.write")
+
+
+# Arm from the environment once at import. A bad spec in a chaos run must
+# fail loudly, not silently test nothing.
+try:
+    _seed = os.environ.get("HVD_FAULTS_SEED")
+    configure(os.environ.get("HVD_FAULTS"),
+              int(_seed) if _seed else None)
+    if armed():
+        LOG.warning("fault injection ARMED: %s (HVD_FAULTS)",
+                    active_spec())
+except FaultSpecError:
+    raise
+except ValueError as exc:  # bad HVD_FAULTS_SEED
+    raise FaultSpecError(f"bad HVD_FAULTS_SEED: {exc}") from None

@@ -46,6 +46,37 @@ def build_library(force: bool = False) -> str:
         return _LIB
 
 
+_SHIELD_SRC = os.path.join(_DIR, "termshield.cc")
+_SHIELD_LIB = os.path.join(_DIR, "libtermshield.so")
+_shield_lib = None
+
+
+def load_termshield():
+    """Build + load the std::terminate parking shim (see termshield.cc)
+    and install it. Elastic-only callers; raises NativeBuildError when
+    the toolchain is unavailable. Cached + idempotent."""
+    global _shield_lib
+    with _lock:
+        if _shield_lib is not None:
+            return _shield_lib
+        if not (os.path.exists(_SHIELD_LIB)
+                and os.path.getmtime(_SHIELD_LIB)
+                >= os.path.getmtime(_SHIELD_SRC)):
+            tmp = f"{_SHIELD_LIB}.tmp.{os.getpid()}.so"
+            cmd = ["g++", "-O2", "-std=c++17", "-fPIC", "-shared",
+                   "-pthread", "-Wall", _SHIELD_SRC, "-o", tmp, "-ldl"]
+            proc = subprocess.run(cmd, capture_output=True, text=True)
+            if proc.returncode != 0:
+                raise NativeBuildError(
+                    f"failed to build termshield: {proc.stderr[-2000:]}")
+            os.replace(tmp, _SHIELD_LIB)
+        lib = ctypes.CDLL(_SHIELD_LIB)
+        lib.hvd_termshield_install.argtypes = []
+        lib.hvd_termshield_install()
+        _shield_lib = lib
+        return lib
+
+
 class HvdRequest(ctypes.Structure):
     _fields_ = [
         ("op", ctypes.c_int),

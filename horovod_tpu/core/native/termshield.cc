@@ -1,0 +1,55 @@
+// termshield — park std::terminate instead of dying (elastic worlds only).
+//
+// Why this exists: when the jax coordination-service HOST dies, every
+// surviving client's error-poll RPC fails within ~1 ms and the client
+// either LOG(FATAL)s the survivor (the stock callback) or — once
+// horovod_tpu replaces that callback to disarm the fatal — throws a
+// nanobind cast error that unwinds the agent's poll thread into
+// std::terminate (this jaxlib has no Python caster for absl::Status
+// callback arguments). Either way the SURVIVOR dies with the host,
+// which is the exact opposite of elastic semantics.
+//
+// The shield converts that terminate into a parked thread: elastic
+// worlds already *leak* resources wedged on dead peers (backends,
+// dispatch workers — see core/elastic.py) rather than run undefined
+// teardown; a parked agent thread is the same doctrine. The process
+// stays alive, the KV lease / file-plane failover attributes the real
+// casualty, and the world reconfigures.
+//
+// Installed ONLY under HVD_ELASTIC=1 (core/elastic.bring_up_distributed)
+// — a non-elastic run keeps fail-fast std::terminate semantics.
+
+#include <cstdio>
+#include <dlfcn.h>
+#include <exception>
+#include <unistd.h>
+
+extern "C" {
+
+typedef int (*hvd_gil_check_fn)(void);
+typedef void *(*hvd_gil_save_fn)(void);
+
+static void hvd_park_terminate() {
+  static const char msg[] =
+      "[hvd termshield] std::terminate intercepted; parking this thread "
+      "(elastic worlds leak wedged threads instead of dying — the "
+      "heartbeat lease attributes the real casualty)\n";
+  ssize_t ignored = write(2, msg, sizeof(msg) - 1);
+  (void)ignored;
+  // g++ reaches std::terminate for an unhandled exception WITHOUT
+  // unwinding: no destructor ran, so a scoped GIL acquisition in the
+  // throwing frame is still held by this thread. Parking while holding
+  // it would freeze the whole interpreter — release it first. Symbols
+  // resolved dynamically so the shim needs no Python headers and stays
+  // harmless in a non-Python process.
+  hvd_gil_check_fn gil_check =
+      (hvd_gil_check_fn)dlsym(RTLD_DEFAULT, "PyGILState_Check");
+  hvd_gil_save_fn gil_save =
+      (hvd_gil_save_fn)dlsym(RTLD_DEFAULT, "PyEval_SaveThread");
+  if (gil_check && gil_save && gil_check()) gil_save();
+  for (;;) pause();  // never return: a returning handler aborts
+}
+
+void hvd_termshield_install() { std::set_terminate(hvd_park_terminate); }
+
+}  // extern "C"

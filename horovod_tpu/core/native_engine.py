@@ -21,8 +21,8 @@ from typing import List, Optional
 
 import numpy as np
 
-from horovod_tpu.core import native, numerics as numx, telemetry as tele, \
-    timeline as tl
+from horovod_tpu.core import faultline as flt, native, numerics as numx, \
+    telemetry as tele, timeline as tl
 from horovod_tpu.core.engine import (
     STALL_WARNING_TIME_S,
     DuplicateNameError,
@@ -443,6 +443,12 @@ class NativeEngine:
     def _enqueue(self, op: str, name: str, tensor: np.ndarray,
                  average: bool = False, root_rank: int = 0,
                  prescale: float = 1.0) -> int:
+        # Fault site engine.submit (core/faultline.py) — in the python
+        # shim, BEFORE the C++ enqueue, so both engines fail a submit at
+        # the same point with the same observable shape.
+        injected = flt.engine_submit(name)
+        if injected is not None:
+            raise EngineError(injected)
         if self._ptr is None:
             raise ShutdownError("engine is shut down")
         tensor = np.ascontiguousarray(tensor)
@@ -559,6 +565,30 @@ class NativeEngine:
         self._lib.hvd_engine_get_params(
             self._ptr, ctypes.byref(cyc), ctypes.byref(fus))
         return float(cyc.value), int(fus.value)
+
+    def abandon(self):
+        """Elastic teardown of a WEDGED engine — the C++ loop thread is
+        blocked inside the negotiator trampoline's KV RPC against a dead
+        coordination service, so :meth:`shutdown`'s ``hvd_engine_join``
+        would never return. Signal shutdown WITHOUT joining (the loop is
+        parked forever — the caller parks this object so the trampolines
+        stay alive) and poison the coordinator without publishing."""
+        self._stall_stop.set()
+        tele.REGISTRY.unregister_sync(self._collect_stats)
+        if self._param_manager is not None:
+            try:
+                self._param_manager.close()
+            except Exception:
+                pass
+        c = self._coordinator
+        if c is not None:
+            c.dead = c.dead or "engine abandoned (elastic reconfiguration)"
+            c._closed = True
+        ptr, self._ptr = self._ptr, None
+        if ptr is not None:
+            self._lib.hvd_engine_shutdown(ptr)  # signal only — no join
+        self._meta.clear()
+        tl.uninstall_sigusr1(self._dump_flight)
 
     def shutdown(self):
         if self._ptr is None:

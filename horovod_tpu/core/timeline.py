@@ -361,6 +361,17 @@ def dump_flight_recorder(events: List[dict], reason: str,
         payload["report"] = tele.report()
     except Exception:
         pass  # telemetry is additive; the events are the dump's core
+    try:
+        # Injected faults (core/faultline.py): every post-mortem says
+        # whether the failure it records was provoked — a chaos run's
+        # dumps must never read as organic incidents.
+        from horovod_tpu.core import faultline as _flt
+
+        if _flt.armed() or _flt.snapshot():
+            payload["faults"] = {"spec": _flt.active_spec(),
+                                 "injected": _flt.snapshot()}
+    except Exception:
+        pass
     prune_dir = None
     if path is None:
         # Unique per dump (wall-µs suffix) so a run's post-mortem HISTORY

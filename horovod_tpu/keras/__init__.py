@@ -768,7 +768,7 @@ class Trainer:
         if isinstance(exc, _elastic.WorldChanged) or not elastic_on:
             return
         w = _elastic.get_world()
-        if w.world_changed() or w.await_verdict(2 * _elastic.lease_s()):
+        if w.world_changed() or w.await_verdict(_elastic.verdict_wait_s()):
             raise _elastic.WorldChanged() from exc
 
     def _elastic_epoch_logs(self, lazy) -> dict:
@@ -822,6 +822,17 @@ class Trainer:
             w.reconfigure()
         except _elastic.ElasticRestartRequired as exc:
             w.exit_for_restart(str(exc))  # no return
+        except Exception as exc:
+            # A blown rebuild must DEGRADE to the coordinated restart,
+            # never crash out of fit: an unhandled exception here would
+            # reach interpreter exit, whose jax atexit hook calls
+            # distributed.shutdown() — a barrier that wedges forever
+            # against a dead/partial world (measured) — and the
+            # supervisor would wait on the zombie instead of
+            # relaunching.
+            _ELASTIC_LOG.error("elastic reconfiguration failed",
+                               exc_info=True)
+            w.exit_for_restart(f"reconfiguration failed: {exc}")
         _ELASTIC_LOG.warning("elastic recovery: world reconfigured "
                              "(epoch %d); rebuilding steps and restoring "
                              "the newest checkpoint", w.epoch)

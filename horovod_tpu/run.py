@@ -120,6 +120,83 @@ def _run_failfast(args, spawn_world) -> int:
     return rc
 
 
+def _parse_faults(entries) -> dict:
+    """``--faults RANK:SPEC`` (repeatable) -> {rank: spec}. Several
+    entries for one rank join with commas (the HVD_FAULTS grammar).
+    Specs are validated HERE, before any child spawns: a typo'd site or
+    mode must fail the launch, not crash-loop every relaunched
+    generation through an import-time FaultSpecError in the child.
+    (core.faultline is stdlib-only — importing it does not drag jax
+    into the launcher process.)"""
+    from horovod_tpu.core import faultline as _faultline
+
+    out: dict = {}
+    for entry in entries or ():
+        rank_s, sep, spec = entry.partition(":")
+        try:
+            rank = int(rank_s)
+        except ValueError:
+            rank = -1
+        if not sep or rank < 0 or not spec:
+            raise SystemExit(
+                f"--faults {entry!r}: want RANK:SPEC (e.g. "
+                "1:hb.beat:skip:*)")
+        try:
+            _faultline._parse(spec)
+        except _faultline.FaultSpecError as exc:
+            raise SystemExit(f"--faults {entry!r}: {exc}") from None
+        out[rank] = (out[rank] + "," + spec) if rank in out else spec
+    return out
+
+
+def _prune_elastic_dir(edir: str, generation: int):
+    """Supervisor hygiene: consumed control files from generation N-2
+    and older are dropped at relaunch — death notes, rejoin requests,
+    restart votes and the fallback-KV namespace otherwise accumulate
+    forever across a long-lived elastic job. Checkpoints and the epoch
+    journal are never touched (they ARE the resume state)."""
+    floor = generation - 1  # keep the previous generation for forensics
+
+    def gen_of(path):
+        try:
+            with open(path) as fh:
+                return int(json.load(fh).get("generation", -1))
+        except (OSError, ValueError, TypeError):
+            return None
+
+    # (rejoin requests need no generation filter here: the supervisor
+    # loop already consumes the WHOLE rejoin dir right after this prune,
+    # every relaunch.)
+    d = os.path.join(edir, "death")
+    if os.path.isdir(d):
+        for name in os.listdir(d):
+            path = os.path.join(d, name)
+            g = gen_of(path)
+            if g is not None and g < floor:
+                try:
+                    os.unlink(path)
+                except OSError:
+                    pass
+    kv = os.path.join(edir, "kv")
+    if os.path.isdir(kv):
+        # Fallback-plane keys are namespaced hvd~elastic~g<gen>[~...]
+        # (core/elastic.py FileKV): prune whole dead generations.
+        for name in os.listdir(kv):
+            if not name.startswith("hvd~elastic~g"):
+                continue
+            head = name[len("hvd~elastic~g"):].split("~", 1)[0]
+            head = head.split(".", 1)[0]  # tmp suffixes
+            try:
+                g = int(head)
+            except ValueError:
+                continue
+            if g < floor:
+                try:
+                    os.unlink(os.path.join(kv, name))
+                except OSError:
+                    pass
+
+
 def _supervise_elastic(args, spawn_world) -> int:
     """Elastic supervisor (core/elastic.py): children survive peer
     death; this loop supplies the process-management half — death notes,
@@ -134,6 +211,7 @@ def _supervise_elastic(args, spawn_world) -> int:
                      f"min-np {args.min_np}, "
                      f"max-restarts {args.max_restarts}\n")
     restarts = {i: 0 for i in range(args.num_proc)}
+    faults_by_rank = getattr(args, "_faults_by_rank", {}) or {}
     world_relaunches = 0
     generation = 0
     interrupted = []
@@ -154,6 +232,11 @@ def _supervise_elastic(args, spawn_world) -> int:
         blacklist = 5.0
 
     while True:
+        # Hygiene: control files (death notes, rejoin requests, restart
+        # votes, fallback-KV keys) from generation N-2 and older are
+        # consumed — prune them so HVD_ELASTIC_DIR stays bounded across
+        # a long-lived job's relaunches.
+        _prune_elastic_dir(edir, generation)
         # Consume control files from the previous generation: a stale
         # rejoin request would bounce the fresh world straight back into
         # a restart loop.
@@ -192,20 +275,34 @@ def _supervise_elastic(args, spawn_world) -> int:
                     sys.stderr.write(f"[launcher] rank {i} (pid {p.pid}) "
                                      "completed\n")
                 else:
+                    # Injections are armed in generation 0 only: a
+                    # gen>0 crash is organic and must never be reported
+                    # as injected (the misattribution this PR exists to
+                    # prevent).
+                    injected = (faults_by_rank.get(i)
+                                if generation == 0 else None)
+                    if injected:
+                        # The death report must say the child ran with
+                        # ARMED injections: a chaos casualty must never
+                        # read as an organic incident in a post-mortem.
+                        desc += (f" (this rank had active fault "
+                                 f"injections: {injected})")
                     sys.stderr.write(
                         f"[launcher] {desc}; elastic world continues "
                         "degraded\n")
                     try:
                         os.makedirs(os.path.join(edir, "death"),
                                     exist_ok=True)
+                        note = {"process": i, "pid": p.pid,
+                                "status": code,
+                                "generation": generation,
+                                "wall": round(time.time(), 3)}
+                        if injected:
+                            note["faults"] = injected
                         with open(os.path.join(
                                 edir, "death",
                                 f"p{i}.supervisor.json"), "w") as fh:
-                            json.dump({"process": i, "pid": p.pid,
-                                       "status": code,
-                                       "generation": generation,
-                                       "wall": round(time.time(), 3)},
-                                      fh)
+                            json.dump(note, fh)
                     except OSError:
                         pass
                     if restarts[i] < args.max_restarts:
@@ -238,10 +335,15 @@ def _supervise_elastic(args, spawn_world) -> int:
                     except (OSError, ValueError):
                         continue
                     if rec.get("generation") == generation:
+                        injected = (faults_by_rank.get(i)
+                                    if generation == 0 else None)
+                        extra = (f" (this rank had active fault "
+                                 f"injections: {injected})"
+                                 if injected else "")
                         sys.stderr.write(
                             f"[launcher] rank {i} (pid {p.pid}) was "
                             "declared dead by its peers but is still "
-                            "running (wedged); killing it\n")
+                            f"running (wedged); killing it{extra}\n")
                         p.kill()
             now = time.monotonic()
             for i in [i for i, due in rejoin_due.items() if now >= due]:
@@ -337,6 +439,16 @@ def main(argv=None):
                     help="elastic: per-rank readmissions and full-world "
                          "relaunches allowed before giving up "
                          "(default 3)")
+    ap.add_argument("--faults", action="append", metavar="RANK:SPEC",
+                    default=None,
+                    help="fault injection (core/faultline.py): arm "
+                         "HVD_FAULTS=SPEC in rank RANK's child only "
+                         "(repeatable; e.g. --faults "
+                         "'1:hb.beat:skip:*' freezes rank 1's "
+                         "heartbeat). Scoped to generation 0 — "
+                         "relaunched generations run clean. The "
+                         "supervisor's death report names a dead "
+                         "child's active injections")
     ap.add_argument("--elastic-dir", default=None, metavar="DIR",
                     help="elastic: state directory shared with the "
                          "children (epoch journal, death notes, rejoin "
@@ -350,6 +462,11 @@ def main(argv=None):
     cmd = args.command
     if cmd[0] == "--":
         cmd = cmd[1:]
+    args._faults_by_rank = _parse_faults(args.faults)
+    for r in args._faults_by_rank:
+        if r >= args.num_proc:
+            ap.error(f"--faults rank {r} outside the -np "
+                     f"{args.num_proc} world")
 
     # Distributed tracing: --timeline DIR (or an inherited HVD_TIMELINE)
     # rides into every child; children resolve their own per-rank file
@@ -393,6 +510,15 @@ def main(argv=None):
             env["HVD_COORDINATOR_ADDRESS"] = f"127.0.0.1:{port}"
             env["HVD_NUM_PROCESSES"] = str(args.num_proc)
             env["HVD_PROCESS_ID"] = str(i)
+            if (i in args._faults_by_rank
+                    and extra_env.get("HVD_ELASTIC_GENERATION",
+                                      "0") == "0"):
+                # Per-rank fault scope: the spec reaches ONE child, and
+                # only the FIRST world — a relaunched generation exists
+                # to prove a clean resume, and re-arming the same fault
+                # there would crash-loop it through the whole restart
+                # budget.
+                env["HVD_FAULTS"] = args._faults_by_rank[i]
             env.update(extra_env)
             if timeline:
                 env["HVD_TIMELINE"] = timeline

@@ -26,6 +26,7 @@ from typing import Any, Optional
 import flax.serialization
 
 from horovod_tpu.common import topology as _topo
+from horovod_tpu.core import faultline as _flt
 
 
 def _ckpt_path(directory: str, step: int, prefix: str) -> str:
@@ -69,9 +70,35 @@ def save_checkpoint(directory: str, target: Any, step: int,
     os.makedirs(directory, exist_ok=True)
     path = _ckpt_path(directory, step, prefix)
     tmp = path + ".tmp"
+    data = flax.serialization.to_bytes(target)
+    # Crash-atomic: tmp + fsync + rename. The fsync matters — a rename
+    # can land on disk before the data it points at, so a host dying
+    # right after save could still resurrect a truncated "newest"
+    # checkpoint that elastic resume then loads. The fault site
+    # ckpt.write ('torn', core/faultline.py) simulates a rank dying
+    # mid-write: half the payload lands in the tmp, the rename never
+    # runs, and latest_checkpoint must keep pointing at the previous
+    # good file (pinned in tests/test_faultline.py).
+    fault = _flt.ckpt_write()
     with open(tmp, "wb") as f:
-        f.write(flax.serialization.to_bytes(target))
-    os.replace(tmp, path)  # atomic: no torn checkpoints on preemption
+        if fault is not None and fault.mode == "torn":
+            f.write(data[: len(data) // 2])
+            f.flush()
+            os.fsync(f.fileno())
+            raise _flt.FaultInjected(
+                fault.describe() + f" path={path}")
+        f.write(data)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+    try:  # persist the rename itself (directory entry), best-effort
+        dfd = os.open(directory, os.O_RDONLY)
+        try:
+            os.fsync(dfd)
+        finally:
+            os.close(dfd)
+    except OSError:
+        pass
     return path
 
 

@@ -2,16 +2,19 @@
 
     python -m horovod_tpu.run -np 2 --cpu --elastic -- python elastic_worker.py
 
-Generation 0: rank 1 SIGKILLs itself mid-epoch. The survivor must take a
-death verdict, shrink the world in place (epoch bump, local mesh,
-recompile), resume from the newest checkpoint and KEEP TRAINING with a
-continuous loss curve. The supervisor blacklists the dead rank, then
-files a rejoin request; the survivor checkpoints and votes a coordinated
-restart at its next epoch boundary.
+Generation 0: the chaos rank (``HVD_TEST_KILL_RANK``, default 1)
+SIGKILLs itself mid-epoch — unless ``HVD_TEST_KILL_MODE=none``, where
+the chaos comes from the launcher's ``--faults`` injection instead
+(e.g. a frozen heartbeat: the rank stays alive but stops beating). The
+survivors must take a death verdict, shrink the world in place (epoch
+bump, recompile — single- or multi-survivor), resume from the newest
+checkpoint and KEEP TRAINING with a continuous loss curve. Killing
+rank 0 takes the coordination KV with it: survivors must fail the
+lease plane over to the ``HVD_ELASTIC_DIR`` file KV for the verdict.
 
-Generation 1: the full world relaunches, resumes from the newest
-checkpoint, finishes the remaining epochs, and proves agreement with
-``hvd.check_consistency`` on the regrown mesh.
+A later generation (if the supervisor relaunches) resumes from the
+newest checkpoint, finishes the remaining epochs, and proves agreement
+with ``hvd.check_consistency`` on the regrown mesh.
 
 Per-epoch losses land in ``$HVD_ELASTIC_DIR/losses.rank<N>.jsonl`` so the
 pytest driver can assert the curve is continuous (no NaN, no
@@ -27,6 +30,8 @@ RANK = int(os.environ.get("HVD_PROCESS_ID", "0"))
 GEN = int(os.environ.get("HVD_ELASTIC_GENERATION", "0"))
 EDIR = os.environ["HVD_ELASTIC_DIR"]
 
+KILL_RANK = int(os.environ.get("HVD_TEST_KILL_RANK", "1"))
+KILL_MODE = os.environ.get("HVD_TEST_KILL_MODE", "sigkill")
 KILL_EPOCH = 1
 KILL_BATCH = 5
 EPOCHS = int(os.environ.get("HVD_TEST_EPOCHS", "30"))
@@ -74,7 +79,7 @@ class ChaosAndLog(hk.callbacks.Callback):
         if os.environ.get("HVD_TEST_DEBUG_TRACE"):
             print(f"BATCH gen={GEN} rank={RANK} "
                   f"e{self.trainer._epoch} b{batch}", flush=True)
-        if GEN == 0 and RANK == 1 \
+        if GEN == 0 and RANK == KILL_RANK and KILL_MODE == "sigkill" \
                 and self.trainer._epoch == KILL_EPOCH \
                 and batch == KILL_BATCH:
             print(f"CHAOS rank={RANK} dying at epoch "

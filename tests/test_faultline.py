@@ -1,0 +1,443 @@
+"""Faultline (ISSUE 11): deterministic fault injection — unit tier.
+
+Every injection site is exercised on the 8-device single-process mesh
+(no subprocess worlds): the spec grammar, the KV wrapper sites through
+LocalKV, the heartbeat sites through an ElasticWorld on a LocalKV, both
+engines' submit/exec sites, the checkpoint torn-write site (and the
+crash-atomic save it regresses), the KV-plane failover it makes
+testable, and the zero-overhead/no-spec pin the acceptance demands.
+"""
+
+import json
+import os
+import time
+
+import numpy as np
+import pytest
+
+from horovod_tpu.core import faultline as flt
+
+
+@pytest.fixture(autouse=True)
+def _disarm():
+    """Every test starts and ends disarmed — faultline state is
+    process-global and must never leak across tests."""
+    flt.reset()
+    yield
+    flt.reset()
+
+
+# ---------------------------------------------------------------------------
+# spec grammar
+# ---------------------------------------------------------------------------
+
+
+def test_spec_grammar_counts_offsets_and_errors():
+    flt.configure("kv.get:delay:2:0.5,hb.beat:skip:*@3,ckpt.write:torn:1")
+    assert flt.armed()
+    spec = flt.active_spec()
+    assert "kv.get:delay:2:0.5" in spec
+    assert "hb.beat:skip:*@3" in spec
+    assert "ckpt.write:torn:1" in spec
+    # '@M' delays the first firing to the M-th arming.
+    assert [flt.heartbeat() for _ in range(4)] == [None, None, "skip",
+                                                  "skip"]
+    # Counts exhaust.
+    assert flt.ckpt_write() is not None
+    assert flt.ckpt_write() is None
+    for bad in ("nosuchsite:delay:1", "kv.get:nosuchmode:1",
+                "kv.get:delay", "kv.get:delay:x", "kv.get:delay:-1",
+                "kv.get:delay:1@0", "kv.get:delay:200%"):
+        with pytest.raises(flt.FaultSpecError):
+            flt.configure(bad)
+
+
+def test_probabilistic_trigger_is_seed_deterministic():
+    flt.configure("kv.try_get:vanish:40%", seed=11)
+    a = [flt.kv_try_get("k") for _ in range(32)]
+    flt.configure("kv.try_get:vanish:40%", seed=11)
+    b = [flt.kv_try_get("k") for _ in range(32)]
+    assert a == b
+    assert any(a) and not all(a)  # it actually fires, and not always
+
+
+def test_every_firing_is_counted_and_recorded():
+    from horovod_tpu.core import telemetry as tele
+
+    total0 = tele.REGISTRY.counter("fault.injected").value
+    site0 = tele.REGISTRY.counter("fault.injected.kv.set").value
+    flt.configure("kv.set:torn:2")
+    assert flt.kv_set("k", "abcd") == "ab"
+    assert flt.kv_set("k", "abcd") == "ab"
+    assert flt.kv_set("k", "abcd") == "abcd"  # exhausted
+    assert tele.REGISTRY.counter("fault.injected").value == total0 + 2
+    assert tele.REGISTRY.counter("fault.injected.kv.set").value == site0 + 2
+    recs = flt.snapshot()
+    assert len(recs) == 2
+    assert all(r["site"] == "kv.set" and r["mode"] == "torn"
+               for r in recs)
+
+
+# ---------------------------------------------------------------------------
+# zero-overhead / no-spec pin (acceptance: byte-identical behavior)
+# ---------------------------------------------------------------------------
+
+
+def test_no_spec_is_inert_everywhere(hvd):
+    """Disarmed, every site helper is an identity/no-op, nothing is
+    recorded, and an engine round trip reduces exactly as without the
+    subsystem."""
+    assert not flt.armed()
+    assert flt.check("kv.get") is None
+    assert flt.kv_set("k", "value") == "value"
+    assert flt.kv_get("k") is None
+    assert flt.kv_try_get("k") is False
+    assert flt.heartbeat() is None
+    assert flt.engine_submit("t") is None
+    assert flt.engine_exec("allreduce") is None
+    assert flt.ckpt_write() is None
+    assert flt.snapshot() == []
+    assert flt.active_spec() is None
+    from horovod_tpu.core.engine import Engine
+
+    e = Engine(cycle_time_s=0.001)
+    try:
+        x = np.arange(8, dtype=np.float32)
+        h = e.allreduce_async("flt_inert", x, average=False)
+        out = e.synchronize(h)
+        np.testing.assert_array_equal(out, x * hvd.size())
+    finally:
+        e.shutdown()
+
+
+def test_bad_spec_fails_loudly():
+    """A chaos run with a silently-dropped spec would 'pass' while
+    testing nothing — misparse must raise, not warn."""
+    with pytest.raises(flt.FaultSpecError, match="unknown fault site"):
+        flt.configure("kv.gte:delay:1")
+
+
+# ---------------------------------------------------------------------------
+# KV wrapper sites (LocalKV — the same code path JaxKV wraps)
+# ---------------------------------------------------------------------------
+
+
+def test_kv_sites_delay_error_torn_vanish():
+    from horovod_tpu.core import coordinator as coord
+
+    kv = coord.LocalKV({})
+    flt.configure("kv.set:torn:1,kv.get:error:1,kv.try_get:vanish:1,"
+                  "kv.get:delay:1:0.15")
+    kv.set("a", "0123456789")
+    assert kv.try_get("a") is None          # vanish: reads absent once
+    assert kv.try_get("a") == "01234"       # the torn write landed
+    with pytest.raises(coord.KVError, match="injected fault"):
+        kv.get("a", 1.0)                    # error: KVError, like organic
+    t0 = time.monotonic()
+    assert kv.get("a", 1.0) == "01234"      # delay: slow KV read
+    assert time.monotonic() - t0 >= 0.14
+
+
+def test_kv_error_fault_poisons_a_negotiation_round():
+    """An injected KV error fails the round the way an organic KV
+    failure does: KVError out of negotiate(), tombstone published, NOT
+    rated as a clean shutdown (so the flight recorder dumps)."""
+    from horovod_tpu.core import coordinator as coord
+
+    store = {}
+    c = coord.Coordinator(coord.LocalKV(store), 2, 0, 0.005, 0,
+                          timeout_s=5.0)
+    # '*': the clock-anchor exchange swallows KV errors by design — the
+    # ROUND publish must hit the fault too.
+    flt.configure("kv.set:error:*")
+    with pytest.raises(coord.KVError, match="injected fault") as ei:
+        c.negotiate([])
+    assert not coord.is_shutdownish(ei.value)
+    assert c.dead is not None  # poisoned, like any failed round
+
+
+# ---------------------------------------------------------------------------
+# heartbeat sites (ElasticWorld on a LocalKV)
+# ---------------------------------------------------------------------------
+
+
+def _world(tmp_path, monkeypatch, pid=0, nproc=2, lease="0.2"):
+    monkeypatch.setenv("HVD_ELASTIC", "1")
+    monkeypatch.setenv("HVD_ELASTIC_LEASE_S", lease)
+    monkeypatch.setenv("HVD_ELASTIC_GRACE_S", "30")
+    monkeypatch.setenv("HVD_ELASTIC_DIR", str(tmp_path))
+    monkeypatch.setenv("HVD_FLIGHT_DIR", str(tmp_path / "flight"))
+    monkeypatch.setenv("HVD_FLIGHT_MIN_INTERVAL", "0")
+    from horovod_tpu.core import coordinator as coord, elastic
+
+    store = {}
+    w = elastic.ElasticWorld()
+    w.active = True
+    w.pid, w.nproc = pid, nproc
+    w.live = list(range(nproc))
+    w._kv = coord.LocalKV(store)
+    return w, store
+
+
+def test_heartbeat_fault_modes(tmp_path, monkeypatch):
+    w, store = _world(tmp_path, monkeypatch)
+    store["hvd/elastic/g0/hb/p1"] = "1"
+    w._beat_once()
+    assert store["hvd/elastic/g0/hb/p0"] == "1"
+    # skip: counter frozen (no write at all this tick)
+    flt.configure("hb.beat:skip:1")
+    w._beat_once()
+    assert store["hvd/elastic/g0/hb/p0"] == "1"
+    # freeze: key rewritten but the counter does not advance
+    flt.configure("hb.beat:freeze:1")
+    w._beat_once()
+    assert store["hvd/elastic/g0/hb/p0"] == "1"
+    # vanish: the key disappears outright
+    flt.configure("hb.beat:vanish:1")
+    w._beat_once()
+    assert "hvd/elastic/g0/hb/p0" not in store
+    # disarmed again: the beat resumes where the counter left off
+    flt.reset()
+    w._beat_once()
+    assert int(store["hvd/elastic/g0/hb/p0"]) >= 2
+
+
+def test_frozen_beats_yield_lease_expiry_not_noshow(tmp_path,
+                                                    monkeypatch):
+    """A peer whose beats FREEZE (process alive, counter stopped) gets
+    the 'lease expired' verdict — distinguishable from the startup
+    no-show ('grace') and from a vanished key: the attribution the
+    frozen-heartbeat chaos scenario pins end to end."""
+    w, store = _world(tmp_path, monkeypatch)
+    store["hvd/elastic/g0/hb/p1"] = "7"
+    w._beat_once()
+    time.sleep(0.25)  # counter never advances past the lease
+    w._beat_once()
+    assert 1 in w.dead
+    assert "lease expired" in w.dead[1]
+    assert "grace" not in w.dead[1] and "vanished" not in w.dead[1]
+
+
+def test_beats_are_mirrored_to_the_file_plane(tmp_path, monkeypatch):
+    w, store = _world(tmp_path, monkeypatch)
+    store["hvd/elastic/g0/hb/p1"] = "1"
+    w._beat_once()
+    fkv = w._get_file_kv()
+    assert fkv is not None
+    assert fkv.try_get("hvd/elastic/g0/hb/p0") == "1"
+    w._beat_once()
+    assert fkv.try_get("hvd/elastic/g0/hb/p0") == "2"
+
+
+# ---------------------------------------------------------------------------
+# KV-plane failover (rank-0 death becomes an attributed verdict)
+# ---------------------------------------------------------------------------
+
+
+class _DeadKV:
+    """A coordination service that stopped answering (its host died)."""
+
+    def _die(self, *a, **k):
+        from horovod_tpu.core.coordinator import KVError
+
+        raise KVError("injected-dead coordination service")
+
+    set = get = try_get = delete = _die
+
+
+def test_kv_failover_attributed_verdict(tmp_path, monkeypatch):
+    monkeypatch.setenv("HVD_ELASTIC_KV_FAILOVER_S", "0.2")
+    w, _ = _world(tmp_path, monkeypatch, pid=1)
+    fkv = w._get_file_kv()
+    # p0 beat (mirrored) before it died together with the service.
+    fkv.set("hvd/elastic/g0/hb/p0", "7")
+    w._beats[0] = ("7", time.monotonic())
+    w._kv = _DeadKV()
+    w._beat_once()  # first failure: the failover clock starts
+    assert not w._failed_over
+    time.sleep(0.25)
+    w._beat_once()  # past the window: cut over
+    assert w._failed_over
+    assert w.dead == {}  # fresh lease at cutover — no instant verdict
+    time.sleep(0.25)     # p0 stays silent on the file plane too
+    w._beat_once()
+    assert 0 in w.dead
+    assert "fallback file KV plane" in w.dead[0]
+    assert w.world_changed()
+    # Tombstone mirrored; our own beats continued through the cutover.
+    assert fkv.try_get("hvd/elastic/g0/dead/p0") is not None
+    assert int(fkv.try_get("hvd/elastic/g0/hb/p1")) >= 2
+    from horovod_tpu.core import telemetry as tele
+
+    assert tele.REGISTRY.counter("world.kv_failovers").value >= 1
+    assert w.summary()["kv_plane"] == "file"
+
+
+def test_no_file_plane_keeps_supervisor_territory(tmp_path,
+                                                  monkeypatch):
+    """Without HVD_ELASTIC_DIR there is nothing to fail over to — the
+    beat loop keeps returning to the supervisor-territory behavior
+    (no failover flag, no spurious verdicts)."""
+    w, _ = _world(tmp_path, monkeypatch, pid=1)
+    monkeypatch.delenv("HVD_ELASTIC_DIR")
+    monkeypatch.setenv("HVD_ELASTIC_KV_FAILOVER_S", "0.1")
+    w._file_kv = None
+    w._kv = _DeadKV()
+    w._beat_once()
+    time.sleep(0.15)
+    w._beat_once()
+    w._beat_once()
+    assert not w._failed_over and w.dead == {}
+
+
+def test_filekv_basics(tmp_path):
+    from horovod_tpu.core.elastic import FileKV
+
+    kv = FileKV(str(tmp_path / "kv"))
+    assert kv.try_get("a/b") is None
+    kv.set("a/b", "one")
+    kv.set("a/b", "two")  # overwrite-in-place (rename)
+    assert kv.try_get("a/b") == "two"
+    assert kv.get("a/b", 0.1) == "two"
+    t0 = time.monotonic()
+    assert kv.get("absent", 0.2) is None  # timeout -> None, no raise
+    assert time.monotonic() - t0 >= 0.19
+    kv.delete("a/b")
+    assert kv.try_get("a/b") is None
+    kv.delete("a/b")  # idempotent
+
+
+# ---------------------------------------------------------------------------
+# engine sites — both engines through the same shim
+# ---------------------------------------------------------------------------
+
+
+def _engines():
+    from horovod_tpu.core.engine import Engine
+
+    out = [("python", Engine)]
+    try:
+        from horovod_tpu.core.native_engine import NativeEngine
+
+        out.append(("native", NativeEngine))
+    except Exception:  # no toolchain: python twin still covers the shim
+        pass
+    return out
+
+
+@pytest.mark.parametrize("name,cls", _engines())
+def test_engine_submit_and_exec_faults(hvd, name, cls):
+    eng = cls(cycle_time_s=0.001)
+    try:
+        x = np.arange(8, dtype=np.float32)
+        # submit failure: raises at *_async, nothing enqueued.
+        flt.configure("engine.submit:fail:1")
+        from horovod_tpu.core.engine import EngineError
+
+        with pytest.raises(EngineError, match="injected fault"):
+            eng.allreduce_async("flt_sub", x, average=False)
+        h = eng.allreduce_async("flt_sub", x, average=False)
+        np.testing.assert_array_equal(eng.synchronize(h),
+                                      x * hvd.size())
+        # poisoned result: the reduced value comes back NaN.
+        flt.configure("engine.exec:poison:1")
+        h = eng.allreduce_async("flt_poison", x, average=False)
+        out = eng.synchronize(h)
+        assert np.isnan(out).all()
+        # stalled cycle: the executor call sleeps in place.
+        flt.configure("engine.exec:stall:1:0.3")
+        t0 = time.monotonic()
+        h = eng.allreduce_async("flt_stall", x, average=False)
+        eng.synchronize(h)
+        assert time.monotonic() - t0 >= 0.29
+        # injected executor error: surfaced at synchronize like any
+        # organic execution failure.
+        flt.configure("engine.exec:error:1")
+        h = eng.allreduce_async("flt_err", x, average=False)
+        with pytest.raises(EngineError, match="injected fault"):
+            eng.synchronize(h)
+    finally:
+        flt.reset()
+        eng.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# checkpoint site + crash-atomic save (satellite regression)
+# ---------------------------------------------------------------------------
+
+
+def test_torn_checkpoint_write_never_becomes_newest(hvd, tmp_path):
+    """A rank dying mid-save (ckpt.write:torn) leaves only a tmp file:
+    latest_checkpoint keeps pointing at the previous good checkpoint
+    and elastic resume loads it cleanly."""
+    from horovod_tpu.utils import checkpoint as ckpt
+
+    state = {"w": np.arange(16, dtype=np.float32), "step": 1}
+    d = str(tmp_path / "ck")
+    good = ckpt.save_checkpoint(d, state, step=1)
+    assert good and good.endswith("checkpoint_1.msgpack")
+
+    flt.configure("ckpt.write:torn:1")
+    state2 = {"w": np.arange(16, dtype=np.float32) * 2, "step": 2}
+    with pytest.raises(flt.FaultInjected, match="injected fault"):
+        ckpt.save_checkpoint(d, state2, step=2)
+    # The torn write is visible as a tmp — but never as a checkpoint.
+    assert os.path.exists(os.path.join(d, "checkpoint_2.msgpack.tmp"))
+    assert not os.path.exists(os.path.join(d, "checkpoint_2.msgpack"))
+    assert ckpt.latest_checkpoint(d) == good
+    restored = ckpt.load_checkpoint(good, dict(state), broadcast=False)
+    np.testing.assert_array_equal(restored["w"], state["w"])
+    # Disarmed, the interrupted save succeeds and becomes newest.
+    flt.reset()
+    ckpt.save_checkpoint(d, state2, step=2)
+    assert ckpt.latest_checkpoint(d).endswith("checkpoint_2.msgpack")
+
+
+# ---------------------------------------------------------------------------
+# post-mortem attribution: flight dumps carry the injected-fault record
+# ---------------------------------------------------------------------------
+
+
+def test_flight_dumps_attribute_injected_faults(tmp_path, monkeypatch):
+    import logging
+
+    from horovod_tpu.core import timeline as tl
+
+    monkeypatch.setenv("HVD_FLIGHT_DIR", str(tmp_path))
+    monkeypatch.setenv("HVD_FLIGHT_MIN_INTERVAL", "0")
+    flt.configure("kv.set:torn:1")
+    flt.kv_set("some/key", "0123456789")
+    path = tl.dump_and_warn([], "test: injected-fault dump", 0,
+                            logging.getLogger("test"))
+    assert path
+    payload = json.load(open(path))
+    faults = payload.get("faults")
+    assert faults, "dump is missing the faults section"
+    assert faults["spec"] and "kv.set:torn" in faults["spec"]
+    assert any(r["site"] == "kv.set" for r in faults["injected"])
+
+    # Disarmed AND nothing fired -> no faults section at all: an
+    # organic incident's post-mortem never hints at injection.
+    flt.reset()
+    path2 = tl.dump_and_warn([], "test: organic dump", 0,
+                             logging.getLogger("test"))
+    assert "faults" not in json.load(open(path2))
+
+
+# ---------------------------------------------------------------------------
+# launcher-side scoping (--faults RANK:SPEC parsing; no worlds spawned)
+# ---------------------------------------------------------------------------
+
+
+def test_launcher_faults_flag_parsing():
+    from horovod_tpu.run import _parse_faults
+
+    assert _parse_faults(None) == {}
+    assert _parse_faults(["1:hb.beat:skip:*"]) == {1: "hb.beat:skip:*"}
+    # Repeats for one rank join with commas (the HVD_FAULTS grammar).
+    assert _parse_faults(["0:kv.get:delay:2:0.5", "0:kv.set:torn:1"]) \
+        == {0: "kv.get:delay:2:0.5,kv.set:torn:1"}
+    with pytest.raises(SystemExit):
+        _parse_faults(["nope"])
+    with pytest.raises(SystemExit):
+        _parse_faults(["x:kv.get:delay:1"])
