@@ -69,6 +69,17 @@ def main():
                         "bs32: 2 is +4%%, 4-5 are +6%%)")
     p.add_argument("--fp16-allreduce", action="store_true",
                    help="bf16 gradient compression on the wire")
+    p.add_argument("--compression", default=None,
+                   choices=["none", "bf16", "fp16", "int8", "int8_ef",
+                            "fp8"],
+                   help="wire-compression policy for the gradient "
+                        "collectives (jax/quantize.py): 'int8'/'fp8' are "
+                        "block-scaled quantized formats (~4x fewer bytes "
+                        "on the wire, scales included); 'int8_ef' adds "
+                        "the error-feedback residual (needs "
+                        "--sharded-update: the residual rides the "
+                        "sharded optimizer state). Overrides "
+                        "--fp16-allreduce when given")
     p.add_argument("--sharded-update", action="store_true",
                    help="cross-replica sharded weight update (arxiv "
                         "2004.13336): reduce-scatter the gradient "
@@ -134,7 +145,7 @@ def main():
             "step_time_ms": None, "gflops_per_step": None, "mfu": None,
             "hbm_gb_per_step": None, "hbm_source": None,
             "membw_util": None, "spread_pct": None, "gate": None,
-            "state_dtype": None, "numerics": None,
+            "state_dtype": None, "compression": None, "numerics": None,
             "dry": True,
         }))
         return
@@ -165,8 +176,12 @@ def main():
 
     model_kw = {"stem": args.stem} if args.stem else {}
     model = models.get_model(args.model, **model_kw)
-    compression = (hvd_jax.Compression.fp16 if args.fp16_allreduce
-                   else hvd_jax.Compression.none)
+    # --compression (the quantized-collectives subsystem) wins over the
+    # legacy --fp16-allreduce spelling; argparse already vetted the
+    # name, resolve() threads the policy object through.
+    compression_name = (args.compression
+                        or ("fp16" if args.fp16_allreduce else "none"))
+    compression = hvd_jax.Compression.resolve(compression_name)
     # fused_update: the ~160 per-parameter update fusions collapse into
     # per-dtype flat buffers (horovod_tpu/jax/fused.py) — profiling shows
     # per-tensor updates + their HBM<->VMEM copies costing ~2.5 ms of an
@@ -480,6 +495,7 @@ def main():
         if per_chip else None,
         "gate": None,  # filled by --check below; present-but-null else
         "state_dtype": args.state_dtype,
+        "compression": compression_name,
         "numerics": None,  # filled post-window below; null under --dry
     }
     # Numerics summary (core/numerics.py): policy + anything the run

@@ -119,19 +119,47 @@ def _decompose_timeline(path, n_ops):
     }
 
 
+def _wire_split(compressed_bytes, policy_name):
+    """Decompose the MEASURED ``engine.wire_bytes.compressed`` counter
+    into (payload_bytes, scale_bytes). Exact regardless of how fusion
+    and chunk bucketing sliced the buffers: every scale block ships
+    ``block`` one-byte payload elements + one 4-byte f32 scale (int8
+    and fp8 payloads are both 1 byte), so the payload:scales ratio is
+    block:4 for every chunk uniformly."""
+    from horovod_tpu.jax.compression import Compression
+
+    pol = Compression.resolve(policy_name)
+    payload = compressed_bytes * pol.block // (pol.block + 4)
+    return payload, compressed_bytes - payload
+
+
 def run_engine(args, tl_path):
     """Engine-path sweep: bytes/µs through the async host engine.
     Tensor names are STABLE across iterations (``bench/{i}`` — the
     per-step-gradient pattern a training loop exhibits), so on a
     multi-process world steady-state negotiation rides the response
     cache's bitvector fast path; compare against HVD_CACHE_CAPACITY=0
-    for the measured control-plane win."""
+    for the measured control-plane win.
+
+    With ``--compression int8|fp8`` the engine wire policy is active and
+    ``--decompose`` additionally prints the bytes-on-wire split:
+    full-width submitted bytes vs what the mesh collectives actually
+    shipped (int8 payload + f32 scales, from the engine.wire_bytes
+    telemetry counters both engines feed identically), plus a sha256
+    digest of the reduced result — run once with HVD_ENGINE=python and
+    once with the default native engine to verify the reductions are
+    bit-identical under the same policy."""
+    import hashlib
+
     from horovod_tpu.core import engine as eng
+    from horovod_tpu.core import telemetry as _tele
 
     e = eng.get_engine()
     kind = type(e).__name__
+    policy = args.compression or "none"
     print(f"# engine path ({kind}), fusion_threshold="
-          f"{e.fusion_threshold}, tensors/iter={args.tensors}")
+          f"{e.fusion_threshold}, tensors/iter={args.tensors}, "
+          f"compression={policy}")
     print(f"# {'size/tensor':>12s} {'total':>10s} {'time':>10s} "
           f"{'bytes/us':>9s} {'host_bw':>9s}")
     rows = []
@@ -143,14 +171,15 @@ def run_engine(args, tl_path):
         tensors = [np.ones((elems,), np.float32) for _ in range(args.tensors)]
         total = sum(t.nbytes for t in tensors)
 
-        def one_iter():
+        def one_iter(collect=False):
             handles = [
                 e.allreduce_async(f"bench/{i}", t, average=False)
                 for i, t in enumerate(tensors)
             ]
-            for h in handles:
-                e.synchronize(h)
+            outs = [e.synchronize(h) for h in handles]
+            return outs if collect else None
 
+        wire_before = _tele.REGISTRY.flat_counters()
         for _ in range(args.warmup):
             one_iter()
         t0 = time.perf_counter()
@@ -158,11 +187,43 @@ def run_engine(args, tl_path):
             one_iter()
         wall = time.perf_counter() - t0
         dt = wall / args.iters
+        # One extra (untimed) iteration for the reduction digest — the
+        # cross-engine bit-identity check the quantized wire format is
+        # pinned by.
+        outs = one_iter(collect=True)
+        digest = hashlib.sha256(
+            b"".join(np.ascontiguousarray(o).tobytes()
+                     for o in outs)).hexdigest()
+        wire_after = _tele.REGISTRY.flat_counters()
         print(f"  {kb:10.1f}kB {total/1e6:8.2f}MB {dt*1e3:8.3f}ms "
               f"{total/dt/1e6:9.1f} {total/dt/1e9:7.2f}GB/s")
         row = {"size_kb": kb, "total_mb": round(total / 1e6, 3),
                "ms_per_iter": round(dt * 1e3, 4),
-               "bytes_per_us": round(total / dt / 1e6, 2)}
+               "bytes_per_us": round(total / dt / 1e6, 2),
+               "digest": digest}
+        niters = args.warmup + args.iters + 1
+
+        def _delta(key):
+            return wire_after.get(key, 0) - wire_before.get(key, 0)
+
+        wire = {"submitted": _delta("engine.submitted.bytes"),
+                "wire": _delta("engine.wire_bytes"),
+                "compressed": _delta("engine.wire_bytes.compressed")}
+        if policy != "none":
+            wire["payload"], wire["scales"] = _wire_split(
+                wire["compressed"], policy)
+        if wire["wire"]:
+            wire["ratio"] = round(wire["submitted"] / wire["wire"], 3)
+        row["wire_bytes"] = wire
+        if args.decompose and wire["wire"]:
+            parts = (f"payload={wire['payload']/1e6:.2f}MB "
+                     f"scales={wire['scales']/1e6:.3f}MB "
+                     if policy != "none" else "")
+            print(f"#   bytes on the wire ({policy}): "
+                  f"submitted={wire['submitted']/1e6:.2f}MB "
+                  f"shipped={wire['wire']/1e6:.2f}MB {parts}"
+                  f"-> {wire.get('ratio', 1.0):.2f}x fewer; "
+                  f"digest={digest[:16]}")
         if tl_path:
             from horovod_tpu.core import engine as _e
 
@@ -170,10 +231,10 @@ def run_engine(args, tl_path):
             # engine reopens the path with mode "w" and truncates it.
             _e.shutdown_engine()
             row["decompose"] = _decompose_timeline(
-                tl_path, (args.warmup + args.iters) * args.tensors)
+                tl_path, niters * args.tensors)
         rows.append(row)
     return {"mode": "engine", "engine": kind, "tensors": args.tensors,
-            "iters": args.iters, "rows": rows}
+            "iters": args.iters, "compression": policy, "rows": rows}
 
 
 def main():
@@ -200,6 +261,15 @@ def main():
                          "allreduce decomposes into — the collective "
                          "shape of the sharded weight update "
                          "(DistributedOptimizer(sharded_update=True))")
+    ap.add_argument("--compression", default=None,
+                    choices=["none", "int8", "fp8"],
+                    help="engine wire-compression policy (block-scaled "
+                         "quantization, jax/quantize.py): sets "
+                         "HVD_COMPRESSION for the run; with --decompose "
+                         "the per-size output gains the bytes-on-wire "
+                         "split (full-width vs int8 payload + f32 "
+                         "scales) and a reduction digest for the "
+                         "python-vs-C++ engine bit-identity check")
     ap.add_argument("--hierarchical", action="store_true",
                     help="route through reduce-scatter(ICI) -> psum(DCN) "
                          "-> all-gather(ICI) (reference: "
@@ -219,6 +289,10 @@ def main():
 
     if args.hierarchical:
         os.environ["HVD_HIERARCHICAL_ALLREDUCE"] = "1"
+    if args.compression and args.compression != "none":
+        # Before hvd.init(): multi-controller init eagerly creates the
+        # engine, which reads the wire policy at construction.
+        os.environ["HVD_COMPRESSION"] = args.compression
     tl_path = None
     if args.engine and args.decompose:
         # Must be in the env BEFORE hvd.init(): multi-controller init
@@ -249,6 +323,10 @@ def main():
                 pass
             print(_json.dumps(result))
         return
+    if args.compression and args.compression != "none":
+        print("# note: --compression measures the ENGINE wire format "
+              "(use --engine); the compiled-path policy rides "
+              "DistributedOptimizer / bench.py --compression")
     n = hvd.size()
     mesh = hvd.mesh()
     from horovod_tpu.ops.collectives import _hier_allreduce_active

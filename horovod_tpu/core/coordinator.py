@@ -377,18 +377,26 @@ class RequestMeta:
     prescale: float = 1.0
     age_s: float = 0.0
     nbytes: int = 0
+    # Engine wire policy ('none'/'int8'/'fp8' — core/engine.py). Part of
+    # the cross-process fingerprint: a world where processes disagree on
+    # a tensor's wire format would dequantize garbage, so mixed policies
+    # fail fast BY NAME at negotiation (the HVD_CACHE_CAPACITY
+    # precedent: misconfiguration surfaces on the first round).
+    compression: str = "none"
 
     def wire(self) -> list:
         return [self.name, self.op, self.dtype, self.itemsize,
                 list(self.shape), int(self.average), self.root_rank,
-                self.prescale, round(self.age_s, 3), self.nbytes]
+                self.prescale, round(self.age_s, 3), self.nbytes,
+                self.compression]
 
     @staticmethod
     def from_wire(w: list) -> "RequestMeta":
         return RequestMeta(name=w[0], op=w[1], dtype=w[2], itemsize=w[3],
                            shape=tuple(w[4]), average=bool(w[5]),
                            root_rank=w[6], prescale=w[7], age_s=w[8],
-                           nbytes=w[9])
+                           nbytes=w[9],
+                           compression=w[10] if len(w) > 10 else "none")
 
 
 @dataclass
@@ -450,7 +458,7 @@ class ResponseCache:
         first dim must renegotiate; everything except the submit-time
         ``age_s`` counts)."""
         return (m.op, m.dtype, m.itemsize, tuple(m.shape), m.average,
-                m.root_rank, m.prescale, m.nbytes)
+                m.root_rank, m.prescale, m.nbytes, m.compression)
 
     def lookup(self, m: RequestMeta) -> Optional[int]:
         """Bit of a cached identical request, or None (a changed shape/
@@ -469,11 +477,12 @@ class ResponseCache:
         if name is None:
             return None
         ident = self._slots[name][1]
-        op, dtype, itemsize, shape, average, root, prescale, nbytes = ident
+        (op, dtype, itemsize, shape, average, root, prescale, nbytes,
+         compression) = ident
         return RequestMeta(name=name, op=op, dtype=dtype,
                            itemsize=itemsize, shape=shape, average=average,
                            root_rank=root, prescale=prescale,
-                           nbytes=nbytes)
+                           nbytes=nbytes, compression=compression)
 
     def wire_len(self, bit: int) -> int:
         name = self._names.get(bit)
@@ -580,7 +589,7 @@ def _fingerprint(m: RequestMeta):
     shape = m.shape[1:] if m.op == "allgather" else m.shape
     dim0 = ("*",) if m.op == "allgather" else ()
     return (m.op, m.dtype, m.itemsize, dim0 + tuple(shape), m.average,
-            m.root_rank, m.prescale)
+            m.root_rank, m.prescale, m.compression)
 
 
 def _mismatch_message(name: str, metas: Dict[int, RequestMeta]) -> str:
@@ -598,6 +607,14 @@ def _mismatch_message(name: str, metas: Dict[int, RequestMeta]) -> str:
             field, va, vb = "data types", a.dtype, b.dtype
         elif a.root_rank != b.root_rank:
             field, va, vb = "root ranks", a.root_rank, b.root_rank
+        elif a.compression != b.compression:
+            # Mixed wire policies would dequantize garbage — the
+            # misconfiguration fails fast by name, like the
+            # HVD_CACHE_CAPACITY capacity handshake.
+            field, va, vb = ("wire compression policies (set "
+                             "HVD_COMPRESSION / the Compression policy "
+                             "identically on every process)",
+                             a.compression, b.compression)
         elif a.average != b.average or a.prescale != b.prescale:
             field, va, vb = ("reduction options",
                              (a.average, a.prescale), (b.average, b.prescale))
@@ -623,7 +640,7 @@ def _fuse_names(ready: Sequence[RequestMeta],
         if m.op != "allreduce" or fusion_threshold <= 0:
             name_groups.append([m.name])
             continue
-        key = (m.dtype, m.average, m.prescale)
+        key = (m.dtype, m.average, m.prescale, m.compression)
         g = open_groups.get(key)
         if g is not None and open_bytes[key] + m.nbytes <= fusion_threshold:
             g.append(m.name)

@@ -44,6 +44,52 @@ DEFAULT_CYCLE_TIME_S = 0.005  # reference: 5 ms, operations.cc:1747
 DEFAULT_FUSION_THRESHOLD = 64 * 1024 * 1024  # reference: 64 MB, operations.cc:1739
 STALL_WARNING_TIME_S = 60.0  # reference: operations.cc:253
 
+# Engine-side wire formats (the quantized-collectives subsystem,
+# jax/quantize.py): applied per execution CHUNK in the shared data plane
+# below, so the python and C++ engines produce bit-identical reductions
+# under the same policy by construction. Cast policies (bf16/fp16) stay
+# frontend-side — they ride compress()/decompress() around the submit.
+# Codes are the `wire` field of the C ABI (hvdcore.cc hvd_request).
+ENGINE_WIRE_POLICIES = ("none", "int8", "fp8")
+WIRE_CODES = {name: i for i, name in enumerate(ENGINE_WIRE_POLICIES)}
+WIRE_NAMES = {i: name for name, i in WIRE_CODES.items()}
+
+
+def _process_str() -> str:
+    try:
+        from horovod_tpu.common import topology as _topo
+
+        if _topo.is_initialized():
+            return f"process {_topo.process_index()}"
+    except Exception:
+        pass
+    return f"pid {os.getpid()}"
+
+
+def resolve_wire_policy(name: Optional[str]) -> str:
+    """Normalize an engine wire-policy spelling, failing FAST with rank
+    attribution on unknown names (the same contract the frontend
+    Compression surfaces enforce)."""
+    if name is None:
+        return "none"
+    val = str(name).lower()
+    if val in ("", "0", "false", "off"):
+        return "none"
+    if val not in ENGINE_WIRE_POLICIES:
+        raise EngineError(
+            f"unknown engine wire policy {name!r} on {_process_str()}: "
+            f"expected one of {list(ENGINE_WIRE_POLICIES)} (cast "
+            "policies bf16/fp16 are applied frontend-side)")
+    return val
+
+
+def wire_policy_from_env() -> str:
+    """HVD_COMPRESSION: the engine-wide default wire format for the
+    execution chunks (per-request policies override it). Misspellings
+    fail fast at engine construction."""
+    return resolve_wire_policy(os.environ.get("HVD_COMPRESSION")
+                               or os.environ.get("HOROVOD_COMPRESSION"))
+
 
 def _poison_result(fault, out: np.ndarray) -> np.ndarray:
     """engine.exec 'poison' fault: NaN-fill a float result AFTER the real
@@ -81,6 +127,7 @@ class _Entry:
     average: bool = False
     root_rank: int = 0
     prescale: float = 1.0
+    compression: str = "none"  # engine wire policy for this request
     enqueued_at: float = field(default_factory=time.monotonic)
     # Processes whose announcement of this tensor has been marked on the
     # timeline (RANK_READY instants inside the NEGOTIATE_* span).
@@ -110,6 +157,16 @@ class JaxExecutor:
 
     measure_staging = False
     last_stage_s = 0.0
+    # Wire policy of the CURRENT allreduce call (set by the engine from
+    # the request's `compression`/`wire` just before the call — an
+    # attribute, not a parameter, so test doubles with the historical
+    # allreduce(flat, average) signature keep working) and the bytes the
+    # call actually shipped (payload + scales under a quantized policy,
+    # full width otherwise). Both engines read these into the
+    # engine.wire_bytes{,.compressed} telemetry counters.
+    wire_policy = "none"
+    last_wire_bytes = 0
+    last_wire_compressed = 0
 
     @staticmethod
     def _ctx(arr: np.ndarray):
@@ -159,13 +216,55 @@ class JaxExecutor:
         elements): ≤11 distinct tail programs below CHUNK_ELEMS."""
         return max(1024, 1 << (n - 1).bit_length())
 
+    def _quantized_chunk(self, chunk: np.ndarray, pol, average: bool):
+        """One execution chunk under a quantized wire policy: quantize
+        HOST-side (the staged device buffers — the wire — already carry
+        the int8 payload + f32 scales), allgather both across the world
+        (each rank's hop ships the quantized bytes, the quantized
+        reduce-scatter's per-rank traffic), dequantize-accumulate in
+        f32. Returns (reduced chunk, wire bytes shipped)."""
+        from horovod_tpu.jax import quantize as Q
+        from horovod_tpu.ops import collectives as C
+
+        payload, scales, npad = Q.np_quantize(chunk, pol)
+        gp = np.asarray(C.allgather(self._stage(payload)))
+        stage_s = self.last_stage_s
+        gs = np.asarray(C.allgather(self._stage(scales)))
+        self.last_stage_s += stage_s
+        world = gp.shape[0] // npad
+        out = Q.np_dequantize_sum(gp.reshape(world, npad),
+                                  gs.reshape(world, -1), pol)
+        if average:
+            out /= world
+        return out[:chunk.shape[0]].astype(chunk.dtype), \
+            payload.nbytes + scales.nbytes
+
+    def _wire_quantizer(self, flat: np.ndarray):
+        """The quantized-policy object for this call, or None (policy
+        off, non-float payload, or a 1-rank world — where the compiled
+        path elides quantization too, so the engines match)."""
+        if self.wire_policy in ("", "none") or flat.dtype.kind not in "f":
+            return None
+        try:
+            from horovod_tpu.common import topology as _topo
+
+            if _topo._require_init().size <= 1:
+                return None
+        except Exception:
+            return None
+        from horovod_tpu.jax.compression import Compression
+
+        return Compression.resolve(self.wire_policy, where="engine wire")
+
     def allreduce(self, flat: np.ndarray, average: bool) -> np.ndarray:
         from horovod_tpu.ops import collectives as C
 
         fault = flt.engine_exec("allreduce")  # stall sleeps, error raises
+        pol = self._wire_quantizer(flat)
         n = flat.shape[0]
         out = np.empty_like(flat)
         stage_s = 0.0
+        wire = 0
         with self._ctx(flat):
             off = 0
             while off < n:
@@ -175,21 +274,32 @@ class JaxExecutor:
                           else self._bucket(take))
                 if bucket != take:
                     # Zero padding is reduction-neutral (sum of zeros;
-                    # average divides by world size only).
+                    # average divides by world size only — and zero
+                    # blocks quantize to zero payload).
                     chunk = np.concatenate(
                         [chunk, np.zeros((bucket - take,), flat.dtype)])
-                res = np.asarray(
-                    C.allreduce(self._stage(chunk), average=average))
+                if pol is not None:
+                    res, chunk_wire = self._quantized_chunk(chunk, pol,
+                                                            average)
+                    wire += chunk_wire
+                else:
+                    res = np.asarray(
+                        C.allreduce(self._stage(chunk), average=average))
+                    wire += chunk.nbytes
                 stage_s += self.last_stage_s
                 out[off: off + take] = res[:take]
                 off += take
         self.last_stage_s = stage_s
+        self.last_wire_bytes = wire
+        self.last_wire_compressed = wire if pol is not None else 0
         return _poison_result(fault, out)
 
     def allgather(self, tensor: np.ndarray) -> np.ndarray:
         from horovod_tpu.ops import collectives as C
 
         fault = flt.engine_exec("allgather")
+        self.last_wire_bytes = tensor.nbytes
+        self.last_wire_compressed = 0
         with self._ctx(tensor):
             return _poison_result(
                 fault, np.asarray(C.allgather(self._stage(tensor))))
@@ -198,6 +308,8 @@ class JaxExecutor:
         from horovod_tpu.ops import collectives as C
 
         fault = flt.engine_exec("broadcast")
+        self.last_wire_bytes = tensor.nbytes
+        self.last_wire_compressed = 0
         with self._ctx(tensor):
             return _poison_result(
                 fault,
@@ -307,6 +419,21 @@ def record_submit(op: str, nbytes: int, queue_depth: int):
     tele.REGISTRY.gauge("engine.queue_depth").set(queue_depth)
 
 
+def record_wire(executor):
+    """Wire-byte telemetry after one executor call: engine.wire_bytes =
+    bytes the mesh collective actually shipped (int8 payload + f32
+    scales under a quantized policy, full width otherwise);
+    engine.wire_bytes.compressed = the subset shipped under a policy.
+    The native engine feeds the SAME counters through its stats C API
+    (hvd_result.wire_bytes/wire_compressed -> hvd_engine_stats)."""
+    wire = int(getattr(executor, "last_wire_bytes", 0))
+    comp = int(getattr(executor, "last_wire_compressed", 0))
+    if wire:
+        tele.REGISTRY.counter("engine.wire_bytes").inc(wire)
+    if comp:
+        tele.REGISTRY.counter("engine.wire_bytes.compressed").inc(comp)
+
+
 def record_cycle(elapsed_s: float):
     """One engine cycle that executed work (idle ticks are not counted —
     both engines apply the same rule, so the counts are comparable)."""
@@ -354,6 +481,9 @@ class Engine:
         self.stall_warning_s = stall_warning_s or STALL_WARNING_TIME_S
         self.stall_check_disabled = stall_warning_s == 0.0
         self.executor = executor or JaxExecutor()
+        # Engine-wide default wire format (HVD_COMPRESSION); per-request
+        # policies override it at submit. Fails fast on misspellings.
+        self.wire_default = wire_policy_from_env()
         self.timeline = timeline if timeline is not None else tl.from_env()
         if self.timeline.enabled:
             # Staging time feeds the WAIT_FOR_DATA spans; only measured
@@ -433,10 +563,16 @@ class Engine:
     # the python twin owes the same observable semantics, and frontends
     # now hand over zero-copy views (torch .numpy()/bf16 reinterpret).
     def allreduce_async(self, name: str, tensor: np.ndarray, average: bool,
-                        prescale: float = 1.0) -> int:
+                        prescale: float = 1.0,
+                        compression: Optional[str] = None) -> int:
+        # `compression` is the per-request engine wire policy (frontend
+        # Compression objects carry it as .engine_wire); None defers to
+        # the HVD_COMPRESSION default.
+        wire = (resolve_wire_policy(compression)
+                if compression is not None else self.wire_default)
         return self._enqueue(
             _Entry(-1, name, "allreduce", np.array(tensor),
-                   average=average, prescale=prescale)
+                   average=average, prescale=prescale, compression=wire)
         )
 
     def allgather_async(self, name: str, tensor: np.ndarray) -> int:
@@ -599,7 +735,8 @@ class Engine:
                 itemsize=e.tensor.dtype.itemsize,
                 shape=tuple(e.tensor.shape), average=e.average,
                 root_rank=e.root_rank, prescale=e.prescale,
-                age_s=now - e.enqueued_at, nbytes=e.tensor.nbytes)
+                age_s=now - e.enqueued_at, nbytes=e.tensor.nbytes,
+                compression=e.compression)
             for e in self._negotiating
         ]
         t_neg = time.monotonic()
@@ -703,7 +840,7 @@ class Engine:
             batch_bytes = 0
             for e in entries:
                 if e.op == "allreduce":
-                    key = (e.tensor.dtype, e.average)
+                    key = (e.tensor.dtype, e.average, e.compression)
                     if batch and (key != batch_key or
                                   batch_bytes + e.tensor.nbytes > self.fusion_threshold):
                         self._exec_allreduce_batch(batch)
@@ -761,7 +898,13 @@ class Engine:
                 if batch[0].prescale != 1.0:
                     flat = flat * batch[0].prescale
             t0 = self.timeline.now_us()
+            # Wire policy rides an executor attribute, not a parameter,
+            # so custom test executors with the historical two-arg
+            # signature keep working (batches are policy-uniform — the
+            # fusion key and the coordinator's grouping include it).
+            self.executor.wire_policy = batch[0].compression
             out = self.executor.allreduce(flat, batch[0].average)
+            record_wire(self.executor)
             self._emit_exec_spans(batch, tl.ALLREDUCE, t0)
             off = 0
             for e in batch:
@@ -782,9 +925,11 @@ class Engine:
             t0 = self.timeline.now_us()
             if e.op == "allgather":
                 out = self.executor.allgather(e.tensor)
+                record_wire(self.executor)
                 self._emit_exec_spans([e], tl.ALLGATHER, t0)
             elif e.op == "broadcast":
                 out = self.executor.broadcast(e.tensor, e.root_rank)
+                record_wire(self.executor)
                 self._emit_exec_spans([e], tl.BROADCAST, t0)
             else:
                 raise EngineError(f"unknown op {e.op}")

@@ -84,6 +84,8 @@ class HvdRequest(ctypes.Structure):
         ("itemsize", ctypes.c_int),
         ("average", ctypes.c_int),
         ("root_rank", ctypes.c_int),
+        # Engine wire policy code (core/engine.py WIRE_CODES).
+        ("wire", ctypes.c_int),
         ("prescale", ctypes.c_double),
         ("names", ctypes.c_char_p),
         ("data", ctypes.c_void_p),
@@ -102,6 +104,10 @@ class HvdResult(ctypes.Structure):
         # Executor-measured host->device staging seconds; the engine turns
         # it into the WAIT_FOR_DATA timeline span.
         ("stage_s", ctypes.c_double),
+        # Bytes the mesh collective shipped (payload+scales under a
+        # quantized wire policy) and the compressed-policy subset.
+        ("wire_bytes", ctypes.c_longlong),
+        ("wire_compressed", ctypes.c_longlong),
         ("error", ctypes.c_char * 256),
     ]
 
@@ -121,6 +127,8 @@ class HvdStats(ctypes.Structure):
         ("cycles", ctypes.c_longlong),
         ("cycle_seconds", ctypes.c_double),
         ("queue_depth", ctypes.c_longlong),
+        ("wire_bytes", ctypes.c_longlong),
+        ("wire_bytes_compressed", ctypes.c_longlong),
     ]
 
 
@@ -164,7 +172,7 @@ def load_library():
         ctypes.c_void_p, ctypes.c_int, ctypes.c_char_p, ctypes.c_int,
         ctypes.c_int, ctypes.c_void_p, ctypes.POINTER(ctypes.c_longlong),
         ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_double,
-        ctypes.c_char_p]
+        ctypes.c_int, ctypes.c_char_p]
     lib.hvd_engine_poll.restype = ctypes.c_int
     lib.hvd_engine_poll.argtypes = [ctypes.c_void_p, ctypes.c_longlong]
     lib.hvd_engine_wait_meta.restype = ctypes.c_int

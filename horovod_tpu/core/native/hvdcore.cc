@@ -68,6 +68,12 @@ struct hvd_request {
   int itemsize;
   int average;
   int root_rank;
+  // Engine wire policy code (0 none, 1 int8, 2 fp8 — WIRE_CODES in
+  // core/engine.py). Opaque to C++ beyond fusion compatibility and
+  // timeline args: the quantization itself happens in the shared data
+  // plane behind the executor callback, which is what keeps the two
+  // engines' reductions bit-identical under the same policy.
+  int wire;
   double prescale;
   const char* names;  // ';'-joined tensor names of the fused batch
   void* data;         // fused input buffer
@@ -91,6 +97,13 @@ struct hvd_result {
   // a timeline is recording): the engine splits it out of the call span as
   // the WAIT_FOR_DATA phase (reference: operations.cc:783-807).
   double stage_s;
+  // Bytes the mesh collective actually shipped for this call (int8
+  // payload + f32 scales under a quantized wire policy, full width
+  // otherwise) and the compressed-policy subset — accumulated into
+  // hvd_engine_stats so both engines feed the same
+  // engine.wire_bytes{,.compressed} telemetry counters.
+  long long wire_bytes;
+  long long wire_compressed;
   char error[256];
 };
 
@@ -131,6 +144,8 @@ struct hvd_engine_stats {
   long long cycles;         // loop cycles that executed work
   double cycle_seconds;     // wall time inside those cycles
   long long queue_depth;    // in-flight tensors right now
+  long long wire_bytes;     // bytes the mesh collectives shipped
+  long long wire_bytes_compressed;  // subset under a quantized policy
 };
 
 void* hvd_alloc(long long nbytes) { return malloc((size_t)nbytes); }
@@ -362,9 +377,21 @@ const char* DtypeName(int dtype_num) {
   return "unknown";
 }
 
-// Pre-rendered args body for timeline events — dtype + shape, the detail
-// the reference writer records (timeline.cc:98-188).
-std::string TensorArgs(int dtype_num, const std::vector<long long>& shape) {
+// Engine wire-policy names by code — MUST stay in sync with WIRE_CODES
+// in core/engine.py (nullptr = full width, no arg emitted).
+const char* WireName(int wire) {
+  switch (wire) {
+    case 1: return "int8";
+    case 2: return "fp8";
+    default: return nullptr;
+  }
+}
+
+// Pre-rendered args body for timeline events — dtype + shape (+ the wire
+// policy when one applies), the detail the reference writer records
+// (timeline.cc:98-188).
+std::string TensorArgs(int dtype_num, const std::vector<long long>& shape,
+                       int wire = 0) {
   std::string out = "\"dtype\": \"";
   out += DtypeName(dtype_num);
   out += "\", \"shape\": [";
@@ -373,6 +400,11 @@ std::string TensorArgs(int dtype_num, const std::vector<long long>& shape) {
     out += std::to_string(shape[i]);
   }
   out += "]";
+  if (const char* w = WireName(wire)) {
+    out += ", \"wire\": \"";
+    out += w;
+    out += "\"";
+  }
   return out;
 }
 
@@ -388,6 +420,7 @@ struct Entry {
   int itemsize;
   int average;
   int root_rank;
+  int wire;  // engine wire policy code (hvd_request.wire)
   double prescale;
   std::vector<char> data;
   std::vector<long long> shape;
@@ -467,7 +500,8 @@ class Engine {
 
   long long Enqueue(int op, const char* name, int dtype_num, int itemsize,
                     const void* data, const long long* shape, int ndim,
-                    int average, int root_rank, double prescale, char* err) {
+                    int average, int root_rank, double prescale, int wire,
+                    char* err) {
     std::unique_lock<std::mutex> lk(mu_);
     if (shutdown_) {
       snprintf(err, 256, "Horovod engine has been shut down");
@@ -490,6 +524,7 @@ class Engine {
     e.itemsize = itemsize;
     e.average = average;
     e.root_rank = root_rank;
+    e.wire = wire;
     e.prescale = prescale;
     long long count = 1;
     for (int i = 0; i < ndim; ++i) count *= shape[i];
@@ -716,7 +751,8 @@ class Engine {
       table += ",\"p\":";
       table += pbuf;
       table += ",\"t\":" + std::to_string(SecondsSince(e.enqueued));
-      table += ",\"b\":" + std::to_string((long long)e.data.size()) + "}";
+      table += ",\"b\":" + std::to_string((long long)e.data.size());
+      table += ",\"w\":" + std::to_string(e.wire) + "}";
     }
     table += "]";
     hvd_negotiate_fn fn;
@@ -865,6 +901,7 @@ class Engine {
             (fuse[0]->dtype_num == e.dtype_num &&
              fuse[0]->average == e.average &&
              fuse[0]->prescale == e.prescale &&
+             fuse[0]->wire == e.wire &&
              fuse_bytes + (long long)e.data.size() <= fusion_limit);
         if (!compatible) flush();
         fuse.push_back(&e);
@@ -936,6 +973,7 @@ class Engine {
     req.dtype_num = batch[0]->dtype_num;
     req.itemsize = itemsize;
     req.average = batch[0]->average;
+    req.wire = batch[0]->wire;  // batch is policy-uniform (fusion key)
     req.prescale = batch[0]->prescale;
     req.names = names.c_str();
     req.data = fused.data();
@@ -945,6 +983,14 @@ class Engine {
     hvd_result res{};
     long long t0 = timeline_.NowUs();
     int rc = CallExecutor(&req, &res);
+    {
+      // Wire-byte accounting (engine.wire_bytes{,.compressed} parity
+      // with the python twin's record_wire): the executor measured what
+      // the mesh collective actually shipped.
+      std::lock_guard<std::mutex> g(mu_);
+      stats_.wire_bytes += res.wire_bytes;
+      stats_.wire_bytes_compressed += res.wire_compressed;
+    }
     {
       // WAIT_FOR_DATA = the host->device staging slice the executor
       // measured; the rest of the round-trip is the collective proper
@@ -956,7 +1002,7 @@ class Engine {
         timeline_.BeginAt(e->name, "WAIT_FOR_DATA", t0);
         timeline_.EndAt(e->name, "WAIT_FOR_DATA", split);
         timeline_.BeginAt(e->name, "ALLREDUCE", split,
-                          TensorArgs(e->dtype_num, e->shape));
+                          TensorArgs(e->dtype_num, e->shape, e->wire));
         timeline_.EndAt(e->name, "ALLREDUCE", t1);
       }
     }
@@ -987,6 +1033,7 @@ class Engine {
     req.itemsize = e.itemsize;
     req.average = e.average;
     req.root_rank = e.root_rank;
+    req.wire = e.wire;
     req.prescale = e.prescale;
     req.names = e.name.c_str();
     req.data = e.data.data();
@@ -998,6 +1045,11 @@ class Engine {
     hvd_result res{};
     long long t0 = timeline_.NowUs();
     int rc = CallExecutor(&req, &res);
+    {
+      std::lock_guard<std::mutex> g(mu_);
+      stats_.wire_bytes += res.wire_bytes;
+      stats_.wire_bytes_compressed += res.wire_compressed;
+    }
     {
       long long t1 = timeline_.NowUs();
       long long split = t0 + (long long)(res.stage_s * 1e6);
@@ -1159,10 +1211,11 @@ void hvd_engine_set_negotiation_active(void* e, int on) {
 long long hvd_engine_enqueue(void* e, int op, const char* name, int dtype_num,
                              int itemsize, const void* data,
                              const long long* shape, int ndim, int average,
-                             int root_rank, double prescale, char* err) {
+                             int root_rank, double prescale, int wire,
+                             char* err) {
   return static_cast<Engine*>(e)->Enqueue(op, name, dtype_num, itemsize, data,
                                           shape, ndim, average, root_rank,
-                                          prescale, err);
+                                          prescale, wire, err);
 }
 
 int hvd_engine_poll(void* e, long long handle) {

@@ -25,6 +25,8 @@ from horovod_tpu.core import faultline as flt, native, numerics as numx, \
     telemetry as tele, timeline as tl
 from horovod_tpu.core.engine import (
     STALL_WARNING_TIME_S,
+    WIRE_CODES,
+    WIRE_NAMES,
     DuplicateNameError,
     EngineError,
     JaxExecutor,
@@ -35,6 +37,8 @@ from horovod_tpu.core.engine import (
     make_autotuner,
     record_cache_config,
     record_submit,
+    resolve_wire_policy,
+    wire_policy_from_env,
 )
 
 # Engine wire dtypes (the role MPIDataType plays in the reference,
@@ -97,7 +101,8 @@ def _make_negotiator(engine):
                     dtype=str(_DTYPES[r["d"]]), itemsize=r["i"],
                     shape=tuple(r["s"]), average=bool(r["a"]),
                     root_rank=r["r"], prescale=r["p"], age_s=r["t"],
-                    nbytes=r["b"])
+                    nbytes=r["b"],
+                    compression=WIRE_NAMES.get(r.get("w", 0), "none"))
                 for r in rows
             ]
             t_neg = time.monotonic()
@@ -185,9 +190,16 @@ def _make_callback(executor):
                 (ctypes.c_char * nbytes).from_address(req.data),
                 dtype=dtype).copy()
             executor.last_stage_s = 0.0
+            executor.last_wire_bytes = 0
+            executor.last_wire_compressed = 0
             if req.op == 0:  # allreduce (possibly fused)
                 if req.prescale != 1.0:
                     buf = buf * req.prescale
+                # Wire policy from the request (the C++ loop's fusion
+                # key keeps batches policy-uniform); the shared data
+                # plane applies the quantized format per chunk, which is
+                # what makes the two engines' digests bit-identical.
+                executor.wire_policy = WIRE_NAMES.get(req.wire, "none")
                 out = executor.allreduce(buf, bool(req.average))
                 out = np.ascontiguousarray(out, dtype=dtype)
                 ctypes.memmove(req.data, out.ctypes.data, nbytes)
@@ -218,6 +230,12 @@ def _make_callback(executor):
                 raise ValueError(f"unknown op {req.op}")
             # Staging time the executor measured (WAIT_FOR_DATA span).
             res.stage_s = float(getattr(executor, "last_stage_s", 0.0))
+            # Wire bytes the call shipped — the engine folds them into
+            # hvd_engine_stats (parity with the python twin's
+            # record_wire counters).
+            res.wire_bytes = int(getattr(executor, "last_wire_bytes", 0))
+            res.wire_compressed = int(
+                getattr(executor, "last_wire_compressed", 0))
             return 0
         except Exception as exc:  # surfaced at synchronize()
             msg = str(exc).encode()[:255]
@@ -244,6 +262,9 @@ class NativeEngine:
 
         self._lib = native.load_library()
         self._executor = executor or JaxExecutor()
+        # Engine-wide default wire format (HVD_COMPRESSION) — same rule
+        # and fail-fast as the python twin.
+        self.wire_default = wire_policy_from_env()
         self._ready_marked: dict = {}  # name -> processes marked RANK_READY
         if timeline_path:
             # Staging time feeds the WAIT_FOR_DATA spans; only measured
@@ -313,6 +334,8 @@ class NativeEngine:
         ("engine.fused.bytes", "fused_bytes"),
         ("engine.cycles", "cycles"),
         ("engine.cycle_seconds_total", "cycle_seconds"),
+        ("engine.wire_bytes", "wire_bytes"),
+        ("engine.wire_bytes.compressed", "wire_bytes_compressed"),
     )
 
     def _collect_stats(self):
@@ -442,7 +465,8 @@ class NativeEngine:
 
     def _enqueue(self, op: str, name: str, tensor: np.ndarray,
                  average: bool = False, root_rank: int = 0,
-                 prescale: float = 1.0) -> int:
+                 prescale: float = 1.0,
+                 compression: Optional[str] = None) -> int:
         # Fault site engine.submit (core/faultline.py) — in the python
         # shim, BEFORE the C++ enqueue, so both engines fail a submit at
         # the same point with the same observable shape.
@@ -456,12 +480,22 @@ class NativeEngine:
             raise EngineError(f"unsupported dtype {tensor.dtype}")
         if tensor.ndim > 8:
             raise EngineError("tensors with >8 dims are not supported")
+        # Only allreduce has a quantized reduction; allgather/broadcast
+        # always ship full width — pin 'none' so the negotiated identity
+        # matches the python twin's (whose _Entry default does the same)
+        # and the timeline never stamps a wire policy on them.
+        if op != "allreduce":
+            wire = "none"
+        else:
+            wire = (resolve_wire_policy(compression)
+                    if compression is not None else self.wire_default)
         err = ctypes.create_string_buffer(256)
         shape = (ctypes.c_longlong * max(tensor.ndim, 1))(*tensor.shape)
         h = self._lib.hvd_engine_enqueue(
             self._ptr, _OPS[op], name.encode(), _DTYPE_CODE[tensor.dtype],
             tensor.dtype.itemsize, tensor.ctypes.data, shape, tensor.ndim,
-            int(average), int(root_rank), float(prescale), err)
+            int(average), int(root_rank), float(prescale),
+            int(WIRE_CODES[wire]), err)
         if h < 0:
             msg = err.value.decode()
             if "already pending" in msg:
@@ -477,9 +511,10 @@ class NativeEngine:
         return int(h)
 
     def allreduce_async(self, name: str, tensor: np.ndarray, average: bool,
-                        prescale: float = 1.0) -> int:
+                        prescale: float = 1.0,
+                        compression: Optional[str] = None) -> int:
         return self._enqueue("allreduce", name, tensor, average=average,
-                             prescale=prescale)
+                             prescale=prescale, compression=compression)
 
     def allgather_async(self, name: str, tensor: np.ndarray) -> int:
         return self._enqueue("allgather", name, tensor)

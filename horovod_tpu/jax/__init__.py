@@ -48,6 +48,8 @@ from horovod_tpu.ops.collectives import (  # noqa: F401
     fetch,
     grouped_allreduce,
 )
+from horovod_tpu.jax import compression as _compression
+from horovod_tpu.jax import quantize as _quantize
 from horovod_tpu.jax.compression import Compression, Compressor  # noqa: F401
 from horovod_tpu.jax.fused import (  # noqa: F401
     canonical_state_dtype,
@@ -60,6 +62,7 @@ from horovod_tpu.jax.sharded import (  # noqa: F401
     resident_from_masters,
     shard_update,
     sharded_state_specs,
+    unwrap_error_feedback,
 )
 
 from horovod_tpu.common.compat import shard_map as _shard_map
@@ -99,7 +102,16 @@ def allreduce(
     IndexedSlices→allgather strategy (reference:
     horovod/tensorflow/__init__.py:73-84). ``sparse_as_dense`` densifies
     first (reference: :184-203).
+
+    ``compression`` accepts cast compressors (wrap the psum), quantized
+    block-scaled policies (``Compression.int8``/``fp8`` — the collective
+    itself changes shape: quantize → int8 reduce-scatter phase →
+    dequantize-accumulate → requantize → int8 all-gather, see
+    :mod:`horovod_tpu.jax.quantize`; this stateless surface carries no
+    error-feedback residual), and ``Compression.select(...)`` per-tensor
+    containers resolved by ``name``.
     """
+    compression = _compression.for_tensor(compression, name)
     if _is_sparse(tensor):
         if sparse_as_dense:
             return allreduce(tensor.todense(), average, name, compression)
@@ -110,12 +122,28 @@ def allreduce(
         return _BCOO((data, indices), shape=tensor.shape)
     if _C._topo._require_init().size == 1:
         # Single-rank world: the reduction is identity; skip the wire
-        # compression round trip too (it would be a lossy cast for
-        # nothing — the reference likewise short-circuits size 1).
+        # compression round trip too (it would be a lossy cast — or a
+        # lossy quantize/dequantize — for nothing; the reference
+        # likewise short-circuits size 1).
         out = jnp.asarray(tensor)
         if not _C.in_spmd(out):  # tracers: trace-time, not per-step
             _C._record_eager("allreduce", out, elided=True)
         return out
+    if getattr(compression, "quantized", False):
+        if jnp.issubdtype(jnp.result_type(tensor), jnp.floating):
+            if _C.in_spmd(tensor):
+                ax = _C.rank_axes()
+                if ax is None:
+                    _C._require_axis("allreduce")
+                return _quantize.spmd_allreduce(tensor, ax, average,
+                                                compression)
+            _C._record_eager("allreduce", jnp.asarray(tensor))
+            return _quantize.eager_allreduce(tensor, average, compression)
+        # Non-float payloads have no quantized form: ship full width
+        # (the engine data plane makes the same call) instead of
+        # tripping the quantized compressor's deliberate
+        # NotImplementedError.
+        return _C.allreduce(tensor, average=average, name=name)
     tensor, ctx = compression.compress(tensor)
     out = _C.allreduce(tensor, average=average, name=name)
     return compression.decompress(out, ctx)
@@ -148,7 +176,16 @@ def allreduce_pytree(tree, average: bool = True, compression=Compression.none,
     for i, l in enumerate(leaves):
         (sparse_idx if _is_sparse(l) else dense_idx).append(i)
     out = list(leaves)
-    if dense_idx:
+    if dense_idx and getattr(compression, "quantized", False):
+        # Quantized policy: fuse per dtype as usual, then run the
+        # quantized collective pipeline on each flat buffer (the policy
+        # replaces the collective, it does not wrap it).
+        reduced = _C._grouped_apply(
+            lambda flat: allreduce(flat, average, None, compression),
+            [leaves[i] for i in dense_idx])
+        for i, r in zip(dense_idx, reduced):
+            out[i] = r
+    elif dense_idx:
         comp = [compression.compress(leaves[i]) for i in dense_idx]
         reduced = _C.grouped_allreduce([c[0] for c in comp], average=average)
         for i, r, (_, ctx) in zip(dense_idx, reduced, comp):
@@ -295,8 +332,25 @@ def DistributedOptimizer(
     optimizer state is *stored* reduced and *computed* f32
     (:func:`horovod_tpu.jax.state_storage` — no masters: see
     docs/troubleshooting.md on drift). Cast your resident params to the
-    policy dtype before ``init`` (the Trainer and bench wiring do)."""
+    policy dtype before ``init`` (the Trainer and bench wiring do).
+
+    ``compression`` accepts a registry name (``'int8'``, ``'int8_ef'``,
+    ``'fp8'``, ``'bf16'``, ...) or a compressor; unknown spellings fail
+    FAST here, naming the rank (a bad object used to surface as an
+    attribute error mid-step). Quantized policies change the collective
+    shape (quantize → int8 reduce-scatter phase → dequantize-accumulate
+    → requantize → int8 all-gather); ``int8_ef``'s error-feedback
+    residual needs the optimizer-state carrier, so it requires
+    ``sharded_update=True`` (the stateless paths run ``int8``/``fp8``
+    without a residual)."""
+    compression = Compression.resolve(compression)
     _sdt = canonical_state_dtype(state_dtype)
+    if (getattr(compression, "quantized", False)
+            and compression.error_feedback and not sharded_update):
+        raise ValueError(
+            "Compression.int8_ef needs an optimizer-state carrier for "
+            "its error-feedback residual: use sharded_update=True, or "
+            "pick Compression.int8 (no residual) for the plain path")
     if sharded_update:
         if backward_passes_per_step > 1:
             # The accumulation wrapper's state ({'inner', 'acc', 'count'})

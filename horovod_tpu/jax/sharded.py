@@ -59,6 +59,7 @@ from horovod_tpu.common import topology as _topo
 from horovod_tpu.common.topology import HVD_AXIS
 from horovod_tpu.core import numerics as _num
 from horovod_tpu.jax import numerics as _jnum
+from horovod_tpu.jax import quantize as _Q
 from horovod_tpu.jax.compression import Compression
 from horovod_tpu.jax.fused import (
     _layout_of,
@@ -119,8 +120,39 @@ def shard_update(
     warmup/schedule mechanisms, which cannot scale the returned
     resident delta post-hoc (the masters have already advanced; the
     next step's re-anchor would undo a caller-side scale).
+
+    ``compression`` may be a cast compressor (bf16/fp16 — wraps the
+    collective as before) or a block-scaled quantized policy
+    (``Compression.int8`` / ``int8_ef`` / ``fp8`` —
+    :mod:`horovod_tpu.jax.quantize`): the compiled step then lowers to
+    quantize → int8 all-to-all (the reduce-scatter phase) →
+    dequantize-accumulate in f32 → [1/N update] → requantize → int8
+    all-gather → dequantize, every wire hop at ~1/4 of the f32 bytes
+    (scales included). Buffers pad to a multiple of ``world * block`` so
+    each rank's chunk is scale-block-aligned (zero blocks quantize to
+    zero payload — padding stays reduction-neutral). ``int8_ef`` adds an
+    error-feedback residual carried in optimizer state (the state
+    becomes ``{"qres": ..., "base": <normal state>}``, riding
+    :func:`sharded_state_specs` as each rank's own rows): the
+    un-transmitted quantization error of this rank's gradient (and of
+    its update shard on the gather side) is added back before the next
+    quantization, keeping the long-run trajectory unbiased
+    (docs/troubleshooting.md "int8 quantization convergence"). At world
+    size 1 everything including quantize/dequantize elides. Under the
+    ``state_dtype`` policy the two compose: the delta all-gather's
+    quantization error lands in the residents and is corrected by the
+    next step's master re-anchor.
     """
     sdt = canonical_state_dtype(state_dtype)
+    if getattr(compression, "for_tensor", None) is not None:
+        raise ValueError(
+            "shard_update packs the whole tree into per-dtype buffers, "
+            "so a per-tensor Compression.select(...) policy cannot "
+            "apply — pass one uniform policy (per-tensor overrides live "
+            "on the name-carrying surfaces: eager allreduce and the "
+            "TF/torch frontends)")
+    qpol = compression if getattr(compression, "quantized", False) else None
+    ef = qpol is not None and qpol.error_feedback
     optimizer = optax.with_extra_args_support(optimizer)
     # Layout cache keyed like fuse(): init()'s param-dtype layout must
     # serve update() calls that omit params (grads share treedef/shapes).
@@ -137,10 +169,18 @@ def shard_update(
             layout = layouts[key] = _layout_of(tree, _PACK_ALL)
         return layout
 
-    def _pack_padded(tree, layout, world, cast_small=False):
+    def _pad_multiple(world: int) -> int:
+        # Quantized policies additionally align every rank's chunk to
+        # the scale-block size (each shard's scales must split cleanly
+        # in the all_to_all exchange). Zero padding stays reduction-
+        # neutral: zero blocks quantize to zero payload.
+        return world * qpol.block if qpol is not None else world
+
+    def _pack_padded(tree, layout, multiple, cast_small=False):
         packed = _pack(tree, layout, cast_small=cast_small)
         # Same zero-pad-to-multiple contract as reducescatter's.
-        return {k: _C._pad_dim0(v, world) for k, v in packed["buf"].items()}
+        return {k: _C._pad_dim0(v, multiple)
+                for k, v in packed["buf"].items()}
 
     def _unpack_padded(bufs, layout):
         # _unpack indexes [off:off+n] per leaf, so trailing padding is
@@ -150,16 +190,33 @@ def shard_update(
     def init(params):
         world = _world()
         layout = _remember(params)
-        pbufs = _pack_padded(params, layout, world)
+        pbufs = _pack_padded(params, layout, _pad_multiple(world))
         if sdt is None:
-            return optimizer.init({"buf": pbufs, "big": []})
-        # Mixed layout: the f32 master copy of every resident buffer
-        # (the ONLY f32 copy — it shards to 1/N per chip under
-        # sharded_state_specs), plus the inner state init'd over the
-        # masters (m/v derive from f32) then downcast to storage dtype.
-        master = {k: v.astype(jnp.float32) for k, v in pbufs.items()}
-        inner = optimizer.init({"buf": master, "big": []})
-        return {"master": master, "inner": store_state(inner, sdt)}
+            base = optimizer.init({"buf": pbufs, "big": []})
+        else:
+            # Mixed layout: the f32 master copy of every resident buffer
+            # (the ONLY f32 copy — it shards to 1/N per chip under
+            # sharded_state_specs), plus the inner state init'd over the
+            # masters (m/v derive from f32) then downcast to storage
+            # dtype.
+            master = {k: v.astype(jnp.float32) for k, v in pbufs.items()}
+            inner = optimizer.init({"buf": master, "big": []})
+            base = {"master": master, "inner": store_state(inner, sdt)}
+        if not ef:
+            return base
+        # Error-feedback residuals: per-RANK rows (rank r's row is its
+        # own un-transmitted quantization error), so the global (world,
+        # n) arrays ride sharded_state_specs as P('hvd') and each chip
+        # holds exactly its row inside the compiled step. "g" carries
+        # the gradient (scatter-phase) residual over the full padded
+        # buffer, "u" the update-shard (gather-phase) residual.
+        qres = {
+            "g": {k: jnp.zeros((world, v.shape[0]), jnp.float32)
+                  for k, v in pbufs.items()},
+            "u": {k: jnp.zeros((world, v.shape[0] // world), jnp.float32)
+                  for k, v in pbufs.items()},
+        }
+        return {"qres": qres, "base": base}
 
     def _master_step(g32, state, resbufs, extra_args):
         """Fused mixed-precision epilogue on one block (the 1/N shard in
@@ -194,19 +251,31 @@ def shard_update(
 
     def update(grads, state, params=None, **extra_args):
         world = _world()
+        mult = _pad_multiple(world)
         if sdt is not None and params is None:
             raise ValueError(
                 "shard_update(state_dtype=...) needs params on every "
                 "update call: the resident-parameter delta re-anchors "
                 "on the actual resident values")
+        if ef:
+            qres, state = state["qres"], state["base"]
+        else:
+            qres = None
+        new_qres = ({"g": dict(qres["g"]), "u": dict(qres["u"])}
+                    if ef else None)
+
+        def wrap(new_state):
+            return ({"qres": new_qres, "base": new_state} if ef
+                    else new_state)
+
         if params is not None:
             layout = _remember(params)
         else:
             layout = (layouts.get(_layout_key(grads))
                       or _layout_of(grads, _PACK_ALL))
-        gbufs = _pack_padded(grads, layout, world, cast_small=True)
+        gbufs = _pack_padded(grads, layout, mult, cast_small=True)
         pbufs = (None if params is None
-                 else _pack_padded(params, layout, world))
+                 else _pack_padded(params, layout, mult))
 
         leaf0 = next(iter(gbufs.values()))
         traced = _C.in_spmd(leaf0)
@@ -229,9 +298,12 @@ def shard_update(
                 ax is not None and lax.psum(1, ax) == 1):
             # Degenerate 1-rank world: scatter and gather are identity
             # and the wire carries nothing (skip the lossy compression
-            # round trip). What remains is whole-tree packing — fuse()
-            # semantics, a measured NEGATIVE on one chip (module
-            # docstring); kept so the flag is runnable anywhere.
+            # round trip — quantize/dequantize included, so the int8
+            # policies elide bit-exactly too; error-feedback residuals
+            # pass through untouched as zeros). What remains is
+            # whole-tree packing — fuse() semantics, a measured NEGATIVE
+            # on one chip (module docstring); kept so the flag is
+            # runnable anywhere.
             stats = (_jnum.bucket_stats(gbufs) if pol != "off" else None)
             if sdt is not None:
                 g32 = {k: v.astype(jnp.float32) for k, v in gbufs.items()}
@@ -250,19 +322,36 @@ def shard_update(
                     new_state = _jnum.guard_state(finite, new_state,
                                                   state)
                 _observe(stats)
-            return _unpack_padded(ures, layout), new_state
+            return _unpack_padded(ures, layout), wrap(new_state)
         if ax is not None:
             # --- compiled SPMD path: scatter, update 1/N, gather -------
             n_axis = lax.psum(1, ax)  # static axis size
             idx = lax.axis_index(ax)
 
-            def scatter(flat):
-                wire, ctx = compression.compress(flat)
-                shard = lax.psum_scatter(wire, ax, scatter_dimension=0,
-                                         tiled=True)
-                shard = compression.decompress(shard, ctx)
+            def scatter(k, flat):
+                if qpol is not None:
+                    # Quantized reduce-scatter phase: quantize (with the
+                    # error-feedback residual added first), exchange the
+                    # int8 payload + f32 scales via all_to_all, and
+                    # dequantize-accumulate in f32 (jax/quantize.py).
+                    # The residual is this rank's un-transmitted error,
+                    # recorded for the NEXT step.
+                    x = flat.astype(jnp.float32)
+                    if ef:
+                        x = x + qres["g"][k][0]
+                    payload, scales = _Q.quantize(x, qpol)
+                    if ef:
+                        new_qres["g"][k] = (
+                            x - _Q.dequantize(payload, scales, qpol))[None]
+                    shard = _Q.spmd_exchange_accumulate(payload, scales,
+                                                        ax, qpol)
+                else:
+                    wire, ctx = compression.compress(flat)
+                    shard = lax.psum_scatter(wire, ax, scatter_dimension=0,
+                                             tiled=True)
+                    shard = compression.decompress(shard, ctx)
                 if sdt is not None:
-                    # Fused epilogue: the collective runs at the resident
+                    # Fused epilogue: the collective runs at the wire
                     # (reduced) width; ONLY the 1/N shard upcasts to f32
                     # — averaging included — so no full-width f32
                     # gradient buffer exists between the reduce-scatter
@@ -271,9 +360,11 @@ def shard_update(
                     return shard / n_axis if average else shard
                 if average:
                     shard = (shard / n_axis).astype(flat.dtype)
+                elif qpol is not None:
+                    shard = shard.astype(flat.dtype)
                 return shard
 
-            gshard = {k: scatter(v) for k, v in gbufs.items()}
+            gshard = {k: scatter(k, v) for k, v in gbufs.items()}
             # Health on the REDUCED 1/N shards (psum'd = whole-buffer
             # figures; NaN from any rank survives the reduction) plus
             # the pre-scatter local counts for per-rank attribution.
@@ -303,26 +394,67 @@ def shard_update(
                     new_state = _jnum.guard_state(finite, new_state,
                                                   state)
                 _observe(stats, _jnum.per_rank_nonfinite(gbufs, ax))
-            ubufs = {k: lax.all_gather(v, ax, axis=0, tiled=True)
-                     for k, v in ures.items()}
-            return _unpack_padded(ubufs, layout), new_state
+
+            def gather(k, ushard):
+                if qpol is None:
+                    return lax.all_gather(ushard, ax, axis=0, tiled=True)
+                # Requantize → quantized all-gather: the update delta
+                # ships at the wire width too; everyone (owner included)
+                # applies the dequantized values so state stays
+                # identical. Gather-side error feedback carries the
+                # shard's un-transmitted delta error to next step.
+                y = ushard.astype(jnp.float32)
+                if ef:
+                    y = y + qres["u"][k][0]
+                payload, scales = _Q.quantize(y, qpol)
+                if ef:
+                    new_qres["u"][k] = (
+                        y - _Q.dequantize(payload, scales, qpol))[None]
+                return _Q.spmd_gather_dequantize(payload, scales, ax,
+                                                 qpol, ushard.dtype)
+
+            ubufs = {k: gather(k, v) for k, v in ures.items()}
+            return _unpack_padded(ubufs, layout), wrap(new_state)
 
         # --- eager path: allreduce + full-buffer update ---------------
         # (single-controller host calls, and tests). Elementwise inner
         # transforms make this the concatenation of the per-shard
         # updates, so the state structure is shared with the SPMD path.
-        def reduce_full(flat):
-            wire, ctx = compression.compress(flat)
-            out = _C.allreduce(wire, average=False)
-            out = compression.decompress(out, ctx)
+        def reduce_full(k, flat):
+            if qpol is not None:
+                # Quantized eager reduction: same wire format as the
+                # SPMD exchange (allgather of payload + scales, f32
+                # accumulation) — and bit-identical trajectories when
+                # per-rank contributions agree, because blockwise
+                # quantization of the full buffer equals the
+                # concatenation of the per-shard quantizations
+                # (buffers pad to world*block). The residual row 0 is
+                # this controller's error; rows are kept identical so
+                # the state structure matches the SPMD layout.
+                x = flat.astype(jnp.float32)
+                if ef:
+                    x = x + qres["g"][k][0]
+                payload, scales = _Q.quantize(x, qpol)
+                if ef:
+                    r = x - _Q.dequantize(payload, scales, qpol)
+                    new_qres["g"][k] = jnp.broadcast_to(
+                        r, (world, r.shape[0]))
+                out = _Q.eager_exchange_accumulate(payload, scales, qpol,
+                                                   world)
+            else:
+                wire, ctx = compression.compress(flat)
+                out = _C.allreduce(wire, average=False)
+                out = compression.decompress(out, ctx)
             if sdt is not None:
                 out = out.astype(jnp.float32)
                 return out / world if average else out
             if average:
                 out = (out / world).astype(flat.dtype)
+            elif qpol is not None:
+                out = out.astype(flat.dtype)
             return out
 
-        gfull = {k: reduce_full(v) for k, v in gbufs.items()}
+        gfull = {k: reduce_full(k, v) for k, v in gbufs.items()}
         stats = _jnum.bucket_stats(gfull) if pol != "off" else None
         if sdt is not None:
             ures, new_state = _master_step(gfull, state, pbufs, extra_args)
@@ -338,15 +470,46 @@ def shard_update(
                 ures = _jnum.guard_updates(finite, ures)
                 new_state = _jnum.guard_state(finite, new_state, state)
             _observe(stats)
-        return _unpack_padded(ures, layout), new_state
+        if qpol is not None:
+            # Mirror the SPMD gather phase: blockwise-quantize the full
+            # update buffer (== the concatenation of the per-shard
+            # quantizations) so eager and SPMD trajectories agree; no
+            # collective is needed — the dequantized value IS what every
+            # rank applies.
+            def requant(k, u):
+                y = u.astype(jnp.float32)
+                if ef:
+                    y = y + qres["u"][k].reshape(-1)
+                payload, scales = _Q.quantize(y, qpol)
+                sent = _Q.dequantize(payload, scales, qpol)
+                if ef:
+                    new_qres["u"][k] = (y - sent).reshape(world, -1)
+                return sent.astype(u.dtype)
+
+            ures = {k: requant(k, v) for k, v in ures.items()}
+        return _unpack_padded(ures, layout), wrap(new_state)
 
     return optax.GradientTransformationExtraArgs(init, update)
+
+
+def unwrap_error_feedback(opt_state):
+    """Strip the error-feedback residual wrapper a quantized ``int8_ef``
+    :func:`shard_update` adds (``{"qres": ..., "base": <state>}``) —
+    returns the base state unchanged for every other layout. The state
+    helpers below route through here so they keep working under the
+    composed quantized + mixed-precision layout."""
+    if (isinstance(opt_state, dict) and set(opt_state) == {"qres", "base"}
+            and isinstance(opt_state["qres"], dict)):
+        return opt_state["base"]
+    return opt_state
 
 
 def has_master_shards(opt_state) -> bool:
     """True when ``opt_state`` is a :func:`shard_update`
     ``state_dtype=...`` mixed-layout state (f32 master buffers +
-    storage-dtype inner state)."""
+    storage-dtype inner state), with or without the error-feedback
+    wrapper."""
+    opt_state = unwrap_error_feedback(opt_state)
     return (isinstance(opt_state, dict)
             and set(opt_state) == {"master", "inner"}
             and isinstance(opt_state["master"], dict))
@@ -364,6 +527,7 @@ def resident_from_masters(opt_state, params_like):
     if not has_master_shards(opt_state):
         raise ValueError("opt_state carries no master shards (was the "
                          "optimizer built with state_dtype=...?)")
+    opt_state = unwrap_error_feedback(opt_state)
     layout = _layout_of(params_like, _PACK_ALL)
     bufs = {k: jnp.asarray(v).astype(k)
             for k, v in opt_state["master"].items()}
@@ -404,12 +568,16 @@ def drift_ulp(opt_state, params) -> dict:
     if not has_master_shards(opt_state):
         raise ValueError("opt_state carries no master shards (was the "
                          "optimizer built with state_dtype=...?)")
-    world = _world()
+    opt_state = unwrap_error_feedback(opt_state)
     layout = _layout_of(params, _PACK_ALL)
     packed = _pack(params, layout)
     out = {}
     for k, master in opt_state["master"].items():
-        res = jnp.asarray(_C.fetch(_C._pad_dim0(packed["buf"][k], world)))
+        # Pad to the MASTER's length, not a recomputed multiple: a
+        # quantized policy's block alignment makes the padding larger
+        # than the plain world multiple.
+        res = jnp.asarray(_C.fetch(
+            _C._pad_dim0(packed["buf"][k], int(master.shape[0]))))
         m64 = np.asarray(_C.fetch(master), np.float64)
         cast64 = np.asarray(jnp.asarray(_C.fetch(master))
                             .astype(res.dtype), np.float64)

@@ -48,8 +48,12 @@ def allreduce(tensor, average: bool = True, device_dense: str = "",
             values = tf.math.divide(values, float(size()))
         return tf.IndexedSlices(values, indices,
                                 dense_shape=tensor.dense_shape)
+    from horovod_tpu.jax.compression import for_tensor as _for_tensor
+
+    compression = _for_tensor(Compression.resolve(compression), name)
     t, ctx = compression.compress(tensor)
-    summed = _allreduce(t, average=False, name=name)
+    summed = _allreduce(t, average=False, name=name,
+                        wire=getattr(compression, "engine_wire", None))
     out = compression.decompress(summed, ctx)
     if average:
         out = tf.math.divide(out, float(size()))
@@ -158,7 +162,7 @@ class DistributedGradientTape(tf.GradientTape):
                  sparse_as_dense: bool = False, **kwargs):
         super().__init__(*args, **kwargs)
         self._hvd_average = average
-        self._hvd_compression = compression
+        self._hvd_compression = Compression.resolve(compression)
         self._hvd_sparse_as_dense = sparse_as_dense
 
     def gradient(self, target, sources, output_gradients=None, **kw):
@@ -181,7 +185,13 @@ def DistributedOptimizer(optimizer, name: Optional[str] = None,
     overrides compute_gradients; TF2's integration point is
     apply_gradients). Session-era ``tf.compat.v1.train`` optimizers are
     wrapped at compute_gradients exactly like the reference, so v1 graph
-    scripts (e.g. the reference's tensorflow_mnist.py) run unmodified."""
+    scripts (e.g. the reference's tensorflow_mnist.py) run unmodified.
+
+    ``compression`` accepts a registry name (``'int8'``/``'fp8'`` engine
+    wire formats, ``'fp16'`` cast) or a compressor; unknown spellings
+    fail fast HERE, naming the rank (a bad object used to surface as an
+    attribute error mid-step)."""
+    compression = Compression.resolve(compression)
     if isinstance(optimizer, tf.compat.v1.train.Optimizer):
         return _distributed_v1_optimizer(optimizer, average, compression,
                                          sparse_as_dense)
@@ -301,12 +311,14 @@ def _group_reduce_grads(grads_and_vars, average, compression,
     horovod/tensorflow/__init__.py:48-94) INSIDE the same group — a
     separate sparse py_function would re-create the cross-rank wedge
     the grouping exists to prevent."""
+    from horovod_tpu.jax.compression import for_tensor as _for_tensor
     from horovod_tpu.tensorflow import mpi_ops as _ops
 
+    compression = Compression.resolve(compression)
     gv = [(tf.convert_to_tensor(g), v)
           if isinstance(g, tf.IndexedSlices) and sparse_as_dense else (g, v)
           for g, v in grads_and_vars]
-    kinds, tensors, labels, roles = [], [], [], []
+    kinds, tensors, labels, roles, wires = [], [], [], [], []
     for i, (g, v) in enumerate(gv):
         # Position index keeps labels unique (keras-3 variable names are
         # bare "kernel"/"bias"); positions are rank-consistent because
@@ -320,22 +332,29 @@ def _group_reduce_grads(grads_and_vars, average, compression,
             labels += [f"DistributedOptimizer.{i}.{vname}.values",
                        f"DistributedOptimizer.{i}.{vname}.indices"]
             roles += [("sparse_values", i), ("sparse_indices", i)]
+            wires += [None, None]
         else:
-            t, ctx = compression.compress(g)
+            # Per-tensor policy resolution by variable name (the
+            # Compression.select overrides); the engine wire format
+            # rides the request, cast compressors wrap it here.
+            comp = _for_tensor(compression, vname)
+            t, ctx = comp.compress(g)
             kinds.append("allreduce")
             tensors.append(t)
             labels.append(f"DistributedOptimizer.{i}.{vname}")
-            roles.append(("dense", i, ctx))
+            roles.append(("dense", i, ctx, comp))
+            wires.append(getattr(comp, "engine_wire", None))
     out = [(g, v) for g, v in gv]
     if not tensors:
         return out
     names = _ops._group_names("allreduce", labels)
-    results = _ops._bridge_group(kinds, tensors, names, average=False)
+    results = _ops._bridge_group(kinds, tensors, names, average=False,
+                                 wires=wires)
     sparse_parts = {}
     for role, res in zip(roles, results):
         if role[0] == "dense":
-            _, i, ctx = role
-            g = compression.decompress(res, ctx)
+            _, i, ctx, comp = role
+            g = comp.decompress(res, ctx)
             if average:
                 g = tf.math.divide(g, float(size()))
             out[i] = (g, gv[i][1])

@@ -1,13 +1,27 @@
 """Gradient compression for the TF frontend (reference:
-horovod/tensorflow/compression.py)."""
+horovod/tensorflow/compression.py).
+
+Cast policies (``fp16`` — bf16 on the wire, TPU-native) wrap the
+collective with compress/decompress as in the reference. The quantized
+block-scaled policies (``int8``/``fp8`` — jax/quantize.py) are applied
+inside the ENGINE's execution chunks instead: their TF compressors are
+identity pass-throughs that tag the request with ``engine_wire`` so the
+shared data plane quantizes per chunk (summing int8 payloads through a
+plain allreduce would saturate). ``Compression.resolve`` fails fast with
+rank attribution on unknown spellings — a bad compressor used to
+surface as an attribute error mid-step."""
 
 from __future__ import annotations
 
 import tensorflow as tf
 
+from horovod_tpu.jax.compression import resolve_in, select_in
+
 
 class Compressor:
     """Interface (reference: tensorflow/compression.py:23-34)."""
+
+    engine_wire = None
 
     @staticmethod
     def compress(tensor):
@@ -47,8 +61,37 @@ class FP16Compressor(Compressor):
         return tensor
 
 
+class Int8Compressor(NoneCompressor):
+    """Block-scaled int8 on the engine wire (jax/quantize.py): identity
+    at the TF layer, quantized per execution chunk in the data plane."""
+
+    engine_wire = "int8"
+
+
+class FP8Compressor(NoneCompressor):
+    """Block-scaled fp8 (e4m3) on the engine wire."""
+
+    engine_wire = "fp8"
+
+
 class Compression:
     """Reference: tensorflow/compression.py:67-74."""
 
     none = NoneCompressor
     fp16 = FP16Compressor
+    int8 = Int8Compressor
+    fp8 = FP8Compressor
+
+    _registry = {"none": NoneCompressor, "fp16": FP16Compressor,
+                 "int8": Int8Compressor, "fp8": FP8Compressor}
+
+    @classmethod
+    def resolve(cls, spec, where: str = "compression"):
+        return resolve_in(cls._registry, spec, where)
+
+    @classmethod
+    def select(cls, default="none", **overrides):
+        """Name-based per-tensor policy (fnmatch on the variable name;
+        first keyword match wins). Members are explicit: a ``'none'``
+        entry pins full width even under an HVD_COMPRESSION default."""
+        return select_in(cls.resolve, default, overrides)

@@ -24,7 +24,7 @@ from horovod_tpu.ops import collectives as _C
 
 
 def _np_collective(kind: str, t: np.ndarray, *, name: str,
-                   average=False, root=0):
+                   average=False, root=0, wire=None):
     """Execute through the ENGINE, not the eager compiled collectives.
 
     TF's graph executor runs independent py_function nodes concurrently
@@ -42,7 +42,9 @@ def _np_collective(kind: str, t: np.ndarray, *, name: str,
     e = _eng.get_engine()
     if kind == "allreduce":
         # The engine wire format is >=1-d; restore scalar shape after.
-        h = e.allreduce_async(name, np.atleast_1d(t), average)
+        # `wire` is the per-request engine wire policy ('int8'/'fp8').
+        h = e.allreduce_async(name, np.atleast_1d(t), average,
+                              compression=wire)
         return e.synchronize(h).reshape(np.shape(t))
     if kind == "allgather":
         # Scalars ride the >=1-d wire as one gathered row apiece.
@@ -81,7 +83,8 @@ def _seq_next(key: str) -> int:
     return seq
 
 
-def _bridge_group(kind: str, tensors, names, *, average=False, root=0):
+def _bridge_group(kind: str, tensors, names, *, average=False, root=0,
+                  wires=None):
     """Run N same-kind collectives through ONE py_function, submitting
     every engine request before waiting on any.
 
@@ -100,16 +103,20 @@ def _bridge_group(kind: str, tensors, names, *, average=False, root=0):
     tensors = list(tensors)
     names = list(names)
     kinds = [kind] * len(tensors) if isinstance(kind, str) else list(kind)
+    # Per-member engine wire policy ('int8'/'fp8'/None), aligned with
+    # `tensors` — the per-tensor Compression overrides ride here.
+    wires = list(wires) if wires is not None else [None] * len(tensors)
 
     def fn(*ts):
         from horovod_tpu.core import engine as _eng
 
         e = _eng.get_engine()
         handles = []
-        for k, name, t in zip(kinds, names, ts):
+        for k, name, t, w in zip(kinds, names, ts, wires):
             a = np.atleast_1d(np.asarray(t.numpy()))
             if k == "allreduce":
-                handles.append(e.allreduce_async(name, a, average))
+                handles.append(e.allreduce_async(name, a, average,
+                                                 compression=w))
             elif k == "broadcast":
                 handles.append(e.broadcast_async(name, a, root))
             elif k == "allgather":
@@ -199,16 +206,17 @@ def rank() -> int:
 
 
 def _allreduce(tensor: tf.Tensor, average: bool = False,
-               name: Optional[str] = None) -> tf.Tensor:
+               name: Optional[str] = None, wire=None) -> tf.Tensor:
     @tf.custom_gradient
     def op(x):
-        y = _bridge("allreduce", x, name=name, average=average)
+        y = _bridge("allreduce", x, name=name, average=average, wire=wire)
 
         def grad(dy):
             # Reference: allreduce's gradient is an allreduce
             # (tensorflow/mpi_ops.py:94-105).
             gname = f"{name}.grad" if name else None
-            return _bridge("allreduce", dy, name=gname, average=average)
+            return _bridge("allreduce", dy, name=gname, average=average,
+                           wire=wire)
 
         return y, grad
 

@@ -111,10 +111,15 @@ class _DistributedOptimizer(torch.optim.Optimizer):
         return hook
 
     def _allreduce_grad_async(self, p):
+        from horovod_tpu.jax.compression import for_tensor as _for_tensor
+
         name = self._parameter_names[id(p)]
-        compressed, ctx = self._compression.compress(p.grad)
-        handle = allreduce_async_(compressed, average=True, name=name)
-        return handle, compressed, ctx
+        comp = _for_tensor(self._compression, name)
+        compressed, ctx = comp.compress(p.grad)
+        handle = allreduce_async_(
+            compressed, average=True, name=name,
+            compression=getattr(comp, "engine_wire", None))
+        return handle, comp, compressed, ctx
 
     def synchronize(self):
         """Drain outstanding gradient reductions (reference:
@@ -126,10 +131,11 @@ class _DistributedOptimizer(torch.optim.Optimizer):
                     # Parameter whose hook did not fire this step (e.g. after
                     # manual backward wiring): reduce it now.
                     self._handles[id(p)] = (p, self._allreduce_grad_async(p))
-        for pid, (p, (handle, compressed, ctx)) in list(self._handles.items()):
+        for pid, (p, (handle, comp, compressed, ctx)) in list(
+                self._handles.items()):
             out = synchronize(handle)
             self._allreduce_delay[pid] = self.backward_passes_per_step
-            p.grad.copy_(self._compression.decompress(out, ctx).to(p.grad.dtype))
+            p.grad.copy_(comp.decompress(out, ctx).to(p.grad.dtype))
         self._handles.clear()
 
     def step(self, closure=None):
@@ -143,7 +149,12 @@ def DistributedOptimizer(optimizer: torch.optim.Optimizer,
                          backward_passes_per_step: int = 1):
     """Wrap a torch optimizer with distributed gradient averaging
     (reference: horovod/torch/__init__.py:139-182 — same dynamic-subclass
-    construction so isinstance(user_optimizer_cls) keeps working)."""
+    construction so isinstance(user_optimizer_cls) keeps working).
+
+    ``compression`` accepts a registry name (``'int8'``/``'fp8'`` engine
+    wire formats, ``'bf16'``/``'fp16'`` casts) or a compressor; unknown
+    spellings fail fast HERE, naming the rank."""
+    compression = Compression.resolve(compression)
     cls = type(optimizer.__class__.__name__, (optimizer.__class__,),
                dict(_DistributedOptimizer.__dict__))
     return cls(optimizer.param_groups, named_parameters, compression,

@@ -1,12 +1,24 @@
 """Gradient compression for torch tensors (reference:
-horovod/torch/compression.py — same surface, plus TPU-native bf16)."""
+horovod/torch/compression.py — same surface, plus TPU-native bf16 and
+the block-scaled quantized engine-wire policies).
+
+Cast policies (fp16/bf16) wrap the collective as in the reference. The
+quantized policies (``int8``/``fp8`` — jax/quantize.py) are identity at
+the torch layer and tag the request with ``engine_wire``: the engine's
+shared data plane quantizes per execution chunk (summing int8 payloads
+through a plain allreduce would saturate). ``Compression.resolve`` fails
+fast with rank attribution on unknown spellings."""
 
 from __future__ import annotations
 
 import torch
 
+from horovod_tpu.jax.compression import resolve_in, select_in
+
 
 class Compressor:
+    engine_wire = None
+
     @staticmethod
     def compress(tensor):
         raise NotImplementedError
@@ -48,7 +60,38 @@ class BF16Compressor(_CastCompressor):
     wire_dtype = torch.bfloat16
 
 
+class Int8Compressor(NoneCompressor):
+    """Block-scaled int8 on the engine wire (jax/quantize.py): identity
+    at the torch layer, quantized per execution chunk in the data
+    plane."""
+
+    engine_wire = "int8"
+
+
+class FP8Compressor(NoneCompressor):
+    """Block-scaled fp8 (e4m3) on the engine wire."""
+
+    engine_wire = "fp8"
+
+
 class Compression:
     none = NoneCompressor
     fp16 = FP16Compressor
     bf16 = BF16Compressor
+    int8 = Int8Compressor
+    fp8 = FP8Compressor
+
+    _registry = {"none": NoneCompressor, "fp16": FP16Compressor,
+                 "bf16": BF16Compressor, "int8": Int8Compressor,
+                 "fp8": FP8Compressor}
+
+    @classmethod
+    def resolve(cls, spec, where: str = "compression"):
+        return resolve_in(cls._registry, spec, where)
+
+    @classmethod
+    def select(cls, default="none", **overrides):
+        """Name-based per-tensor policy (fnmatch on the parameter name;
+        first keyword match wins). Members are explicit: a ``'none'``
+        entry pins full width even under an HVD_COMPRESSION default."""
+        return select_in(cls.resolve, default, overrides)

@@ -108,19 +108,26 @@ def synchronize(handle: int) -> torch.Tensor:
 # ---------------------------------------------------------------------------
 
 def allreduce_async(tensor: torch.Tensor, average: bool = True,
-                    name: Optional[str] = None) -> int:
+                    name: Optional[str] = None,
+                    compression: Optional[str] = None) -> int:
+    # `compression` here is the per-request ENGINE wire-format name
+    # ('int8'/'fp8' — a Compressor's .engine_wire); cast compressors are
+    # applied by the caller around the collective as in the reference.
     out = torch.empty_like(tensor)
     h = get_engine().allreduce_async(
-        _auto_name("allreduce", name), _np_of(tensor), average
+        _auto_name("allreduce", name), _np_of(tensor), average,
+        compression=compression
     )
     _register(h, tensor, out)
     return h
 
 
 def allreduce_async_(tensor: torch.Tensor, average: bool = True,
-                     name: Optional[str] = None) -> int:
+                     name: Optional[str] = None,
+                     compression: Optional[str] = None) -> int:
     h = get_engine().allreduce_async(
-        _auto_name("allreduce", name), _np_of(tensor), average
+        _auto_name("allreduce", name), _np_of(tensor), average,
+        compression=compression
     )
     _register(h, tensor, tensor)
     return h
@@ -128,19 +135,23 @@ def allreduce_async_(tensor: torch.Tensor, average: bool = True,
 
 class HorovodAllreduce(torch.autograd.Function):
     @staticmethod
-    def forward(ctx, tensor, average, name):
+    def forward(ctx, tensor, average, name, wire=None):
         ctx.average = average
-        return synchronize(allreduce_async(tensor, average, name))
+        return synchronize(allreduce_async(tensor, average, name, wire))
 
     @staticmethod
     def backward(ctx, grad_output):
-        return allreduce(grad_output, ctx.average), None, None
+        return allreduce(grad_output, ctx.average), None, None, None
 
 
 def allreduce(tensor: torch.Tensor, average: bool = True,
               name: Optional[str] = None, compression=Compression.none) -> torch.Tensor:
+    from horovod_tpu.jax.compression import for_tensor as _for_tensor
+
+    compression = _for_tensor(Compression.resolve(compression), name)
     compressed, ctx = compression.compress(tensor)
-    out = HorovodAllreduce.apply(compressed, average, name)
+    out = HorovodAllreduce.apply(compressed, average, name,
+                                 getattr(compression, "engine_wire", None))
     return compression.decompress(out, ctx)
 
 

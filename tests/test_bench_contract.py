@@ -66,7 +66,7 @@ def test_bench_dry_one_json_line_contract(poisoned_env):
     for key in ("metric", "value", "unit", "vs_baseline", "step_time_ms",
                 "gflops_per_step", "mfu", "hbm_gb_per_step", "hbm_source",
                 "membw_util", "spread_pct", "gate", "state_dtype",
-                "numerics", "dry"):
+                "compression", "numerics", "dry"):
         assert key in rec, (key, rec)
     assert rec["metric"] == "resnet50_train_images_per_sec_per_chip_bs32"
     assert rec["unit"] == "images/sec/chip"
@@ -118,6 +118,30 @@ def test_bench_dry_state_dtype_keeps_contract(poisoned_env):
     assert "must not import jax" not in proc.stderr
 
 
+def test_bench_dry_compression_keeps_contract(poisoned_env):
+    """`--compression int8 --dry` (quantized collectives, ISSUE 12):
+    still import-free, still one JSON line, the compression field
+    present-but-null (the policy only means something on a real run).
+    A bad spelling is an argparse error (exit 2), still import-free."""
+    proc = subprocess.run([sys.executable, BENCH, "--dry",
+                           "--compression", "int8"],
+                          capture_output=True, text=True, timeout=60,
+                          env=poisoned_env, cwd=REPO)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    assert "must not import jax" not in proc.stderr
+    lines = [ln for ln in proc.stdout.splitlines() if ln.strip()]
+    assert len(lines) == 1, proc.stdout
+    rec = json.loads(lines[0])
+    assert rec["compression"] is None
+    assert rec["dry"] is True
+    proc = subprocess.run([sys.executable, BENCH, "--dry",
+                           "--compression", "int9"],
+                          capture_output=True, text=True, timeout=60,
+                          env=poisoned_env, cwd=REPO)
+    assert proc.returncode == 2
+    assert "must not import jax" not in proc.stderr
+
+
 def test_bench_check_flag_documented():
     proc = subprocess.run([sys.executable, BENCH, "--help"],
                           capture_output=True, text=True, timeout=60,
@@ -126,6 +150,7 @@ def test_bench_check_flag_documented():
     assert "--check" in proc.stdout
     assert "--profile" in proc.stdout
     assert "--state-dtype" in proc.stdout
+    assert "--compression" in proc.stdout
 
 
 def test_allreduce_benchmark_has_json_flag():
@@ -139,3 +164,4 @@ def test_allreduce_benchmark_has_json_flag():
     assert proc.returncode == 0, proc.stderr[-2000:]
     assert "--json" in proc.stdout
     assert "--decompose" in proc.stdout
+    assert "--compression" in proc.stdout

@@ -98,20 +98,37 @@ def test_duplicate_name_rejected():
 
 
 def test_fusion_batches_same_dtype():
-    ex = RecordingExecutor()
-    e = _mk(ex, cycle_time_s=0.05)
+    """Deterministic fusion pin (this assertion used to race the loop
+    thread: with a zero-delay executor the cycle drains entries one by
+    one exactly as fast as the test enqueues them, so whether ANY two
+    landed in a cycle together was a coin flip under scheduler jitter).
+    Gating the FIRST execution until every handle is submitted forces
+    the remaining entries into one drained cycle — they MUST fuse."""
+    import threading
+
+    gate = threading.Event()
+
+    class GatedExecutor(RecordingExecutor):
+        def allreduce(self, flat, average):
+            if not self.calls:
+                gate.wait(timeout=10)
+            return super().allreduce(flat, average)
+
+    ex = GatedExecutor()
+    e = _mk(ex, cycle_time_s=0.002)
     try:
-        time.sleep(0.06)
         handles = [
             e.allreduce_async(f"t{i}", np.full((8,), float(i), np.float32),
                               False)
             for i in range(16)
         ]
+        gate.set()
         for i, h in enumerate(handles):
             np.testing.assert_allclose(e.synchronize(h),
                                        np.full((8,), 8.0 * i))
         ar = [c for c in ex.calls if c[0] == "allreduce"]
         assert len(ar) < 16, f"no fusion: {len(ar)} calls"
+        assert max(n for _, n, _ in ar) > 8, f"no fused batch: {ar}"
     finally:
         e.shutdown()
 

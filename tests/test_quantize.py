@@ -1,0 +1,482 @@
+"""Quantized collectives (ISSUE 12 — EQuARX-style block-scaled int8 on
+the compiled hot path and in the engine chunks): quantizer math, the
+compiled shard_update pipeline (HLO-pinned at the StableHLO level per
+the PR 7 CPU-legalization caveat), the non-divisible padding contract,
+error-feedback convergence against the f32 oracle, cross-engine
+bit-identical digests with the wire-byte counters, and the negotiation
+mixed-policy fail-fast."""
+
+import hashlib
+import re
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+from jax.sharding import PartitionSpec as P
+
+import horovod_tpu.jax as hj
+from horovod_tpu.jax import quantize as Q
+from horovod_tpu.jax.compression import Compression
+from horovod_tpu.ops.collectives import HVD_AXIS
+
+
+@pytest.fixture(autouse=True)
+def _init(hvd):
+    pass
+
+
+class SmallInt8(Compression.int8):
+    """Test-sized scale blocks: world*block padding stays tiny."""
+
+    block = 8
+
+
+class SmallInt8EF(SmallInt8):
+    error_feedback = True
+
+
+def test_quantize_roundtrip_error_bound():
+    rng = np.random.RandomState(0)
+    for pol in (Compression.int8, SmallInt8, Compression.fp8):
+        n = pol.block * 5
+        x = jnp.asarray(rng.randn(n).astype(np.float32) * 3.0)
+        payload, scales = Q.quantize(x, pol)
+        assert payload.shape == (n,) and scales.shape == (n // pol.block,)
+        y = np.asarray(Q.dequantize(payload, scales, pol))
+        # Worst-case per-element error: half a quantization step of the
+        # block's scale (uniform int8 grid); fp8's grid is relative —
+        # half an e4m3 ulp (2^-4) of the VALUE, with a subnormal floor.
+        if pol.round_to_int:
+            bound = np.repeat(np.asarray(scales), pol.block) * 0.5
+        else:
+            bound = (np.abs(np.asarray(x)) * 2.0 ** -4
+                     + np.repeat(np.asarray(scales), pol.block) * 2.0 ** -9)
+        assert np.all(np.abs(y - np.asarray(x)) <= bound * 1.0001)
+    # Zero blocks: scale 1.0, payload zeros, exact round trip — the
+    # padding-neutrality the scatter contract relies on.
+    z = jnp.zeros((SmallInt8.block * 2,), jnp.float32)
+    payload, scales = Q.quantize(z, SmallInt8)
+    np.testing.assert_array_equal(np.asarray(payload), 0)
+    np.testing.assert_array_equal(np.asarray(scales), 1.0)
+
+
+def test_np_twin_matches_jnp():
+    """The engines' host-side quantizer must agree with the compiled
+    path's math (same rounding: ties to even)."""
+    rng = np.random.RandomState(1)
+    x = rng.randn(Compression.int8.block * 3).astype(np.float32)
+    pj, sj = Q.quantize(jnp.asarray(x), Compression.int8)
+    pn, sn, npad = Q.np_quantize(x, Compression.int8)
+    assert npad == x.size
+    np.testing.assert_array_equal(np.asarray(pj), pn)
+    np.testing.assert_array_equal(np.asarray(sj), sn)
+
+
+def test_eager_quantized_allreduce(hvd):
+    """Eager semantics: every local chip contributes this controller's
+    value, so the quantized sum is world * dequant(quant(x)) — and the
+    non-divisible tail pads with reduction-neutral zero blocks."""
+    world = hvd.size()
+    x = jnp.asarray(np.random.RandomState(2).randn(33).astype(np.float32))
+    out = hj.allreduce(x, average=False, compression=SmallInt8)
+    xp = np.zeros((Q.padded_len(33, SmallInt8.block),), np.float32)
+    xp[:33] = np.asarray(x)
+    expect = world * np.asarray(
+        Q.dequantize(*Q.quantize(jnp.asarray(xp), SmallInt8), SmallInt8))
+    np.testing.assert_allclose(np.asarray(out), expect[:33], rtol=1e-6)
+    avg = hj.allreduce(x, average=True, compression=SmallInt8)
+    np.testing.assert_allclose(np.asarray(avg), expect[:33] / world,
+                               rtol=1e-6)
+
+
+def _tree():
+    """Flat size 10+3+20 = 33 — NOT divisible by 8 (the padding-contract
+    precedent tree): the quantized policy pads to world*block."""
+    return {
+        "w": jnp.arange(10.0),
+        "b": jnp.full((3,), 0.5),
+        "k": jnp.linspace(-1.0, 1.0, 20).reshape(4, 5),
+    }
+
+
+def _spmd_step(opt, state):
+    ospec = hj.sharded_state_specs(state)
+
+    @hj.jit(in_specs=(P(), ospec, P(HVD_AXIS)), out_specs=(P(), ospec))
+    def step(p, s, gstack):
+        g = jax.tree_util.tree_map(lambda l: l[0], gstack)
+        u, s2 = opt.update(g, s, p)
+        return optax.apply_updates(p, u), s2
+
+    return step
+
+
+def _stack(tree, world, distinct=True):
+    """Rank-stacked gradients: row r is rank r's gradient."""
+
+    def one(i, l):
+        base = np.asarray(l, np.float32) * 0.01 + 0.05
+        rows = np.stack([base * (1 + (0.13 * r if distinct else 0.0))
+                         for r in range(world)])
+        return jnp.asarray(rows)
+
+    leaves, treedef = jax.tree_util.tree_flatten(tree)
+    return jax.tree_util.tree_unflatten(
+        treedef, [one(i, l) for i, l in enumerate(leaves)])
+
+
+def test_nondivisible_tree_quantized_roundtrip_vs_oracle(hvd):
+    """33-element tree over 8 ranks with DISTINCT per-rank gradients:
+    the compiled quantize → int8 all-to-all → dequantize-accumulate →
+    update → requantize → int8 all-gather pipeline must match the
+    quantizer-math oracle computed leaf-by-leaf on the host (pad to
+    world*block → per-rank quantize → sum dequants → average → SGD →
+    blockwise-quantized delta), and round-trip the tree's shapes."""
+    world = hvd.size()
+    params = _tree()
+    opt = hj.shard_update(optax.sgd(0.1), compression=SmallInt8)
+    state = opt.init(params)
+    gstack = _stack(params, world)
+    new_p, _ = _spmd_step(opt, state)(params, state, gstack)
+
+    # Host oracle over the packed f32 buffer (one dtype group here).
+    mult = world * SmallInt8.block
+    flat = np.concatenate([np.asarray(l, np.float32).ravel()
+                           for l in jax.tree_util.tree_leaves(params)])
+    npad = Q.padded_len(flat.size, mult)
+    gflat = np.zeros((world, npad), np.float32)
+    for r in range(world):
+        row = np.concatenate(
+            [np.asarray(l, np.float32)[r].ravel()
+             for l in jax.tree_util.tree_leaves(gstack)])
+        gflat[r, :row.size] = row
+    total = np.zeros((npad,), np.float32)
+    for r in range(world):
+        total += np.asarray(Q.dequantize(
+            *Q.quantize(jnp.asarray(gflat[r]), SmallInt8), SmallInt8))
+    mean = total / world
+    delta = np.asarray(Q.dequantize(
+        *Q.quantize(jnp.asarray(-0.1 * mean), SmallInt8), SmallInt8))
+    expect = np.zeros((npad,), np.float32)
+    expect[:flat.size] = flat
+    expect += delta
+    got = np.concatenate([np.asarray(l, np.float32).ravel()
+                          for l in jax.tree_util.tree_leaves(new_p)])
+    for a, b in zip(jax.tree_util.tree_leaves(new_p),
+                    jax.tree_util.tree_leaves(params)):
+        assert a.shape == b.shape and a.dtype == b.dtype
+    np.testing.assert_allclose(got, expect[:flat.size], atol=1e-5)
+
+
+def test_eager_spmd_trajectory_parity(hvd):
+    """With identical per-rank gradients the eager quantized path (full
+    buffers, no collectives needed) and the compiled pipeline take the
+    same trajectory — blockwise quantization of the full buffer equals
+    the concatenation of the per-shard quantizations."""
+    world = hvd.size()
+    params = _tree()
+    for comp in (SmallInt8, SmallInt8EF):
+        opt = hj.shard_update(optax.sgd(0.1), compression=comp)
+        se = opt.init(params)
+        ss = opt.init(params)
+        step = _spmd_step(opt, ss)
+        pe, ps = params, params
+        gstack = _stack(params, world, distinct=False)
+        g = jax.tree_util.tree_map(lambda l: l[0], gstack)
+        for _ in range(3):
+            ue, se = opt.update(g, se, pe)
+            pe = optax.apply_updates(pe, ue)
+            ps, ss = step(ps, ss, gstack)
+        for a, b in zip(jax.tree_util.tree_leaves(pe),
+                        jax.tree_util.tree_leaves(ps)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       atol=1e-6)
+
+
+def test_hlo_pins_int8_wire(hvd):
+    """Program-level (StableHLO) pin, per the PR 7 caveat (XLA:CPU's
+    compiled text legalizes collectives — the pin is the program): under
+    the int8 policy the payload-sized cross-rank collectives (the
+    all_to_all reduce-scatter phase and the tiled all_gather) run at i8,
+    and NO payload-sized float collective survives — only the small f32
+    scale exchanges (n/block elements)."""
+    params = _tree()
+    opt = hj.DistributedOptimizer(optax.sgd(0.1, momentum=0.9),
+                                  sharded_update=True,
+                                  compression=SmallInt8)
+    state = opt.init(params)
+    ospec = hj.sharded_state_specs(state)
+
+    @hj.jit(in_specs=(P(), ospec, P()), out_specs=(P(), ospec))
+    def step(p, s, g):
+        u, s2 = opt.update(g, s, p)
+        return optax.apply_updates(p, u), s2
+
+    txt = step.lower(params, state, params).as_text()
+    sigs = re.findall(
+        r'stablehlo\.(all_to_all|all_gather|reduce_scatter)"'
+        r'.*?:\s*\(tensor<([^>]*)>\)',
+        txt, re.S)
+    assert sigs, "expected collectives in the 8-device program"
+
+    def elems_dtype(sig):
+        parts = sig.split("x")
+        dims = [int(d) for d in parts[:-1]] or [1]
+        n = 1
+        for d in dims:
+            n *= d
+        return n, parts[-1]
+
+    npad = Q.padded_len(33, 8 * SmallInt8.block)  # world * block
+    payload_i8 = [s for op, s in sigs if elems_dtype(s)[1] == "i8"]
+    assert payload_i8, txt[:2000]
+    assert {op for op, s in sigs if elems_dtype(s)[1] == "i8"} >= {
+        "all_to_all", "all_gather"}
+    for op, s in sigs:
+        n, dt = elems_dtype(s)
+        if dt != "i8":
+            # Scales only: n/block f32 values per exchange, never the
+            # payload-sized buffer.
+            assert n <= npad // SmallInt8.block, (op, s)
+
+
+def test_error_feedback_convergence_and_noef_drift(hvd):
+    """The convergence guardrail: SGD under int8-with-residual tracks
+    the f32 oracle; without the residual, coordinates whose gradients
+    are small against the block amax are crushed to zero payload every
+    step and the trajectory stalls (the documented no-residual drift —
+    docs/troubleshooting.md 'int8 quantization convergence')."""
+    # One dominant coordinate per block pins the scale; the small
+    # gradients elsewhere are ~1/40 of a quantization step.
+    n = 64
+    target = np.zeros((n,), np.float32)
+    w0 = np.ones((n,), np.float32)
+    w0[:: SmallInt8.block] = 100.0  # block-scale drivers
+
+    def grads(w):
+        return {"w": jnp.asarray(0.002 * (w - target), jnp.float32)}
+
+    def run(comp, steps=60):
+        params = {"w": jnp.asarray(w0)}
+        opt = hj.shard_update(optax.sgd(1.0), compression=comp)
+        state = opt.init(params)
+        for _ in range(steps):
+            u, state = opt.update(grads(np.asarray(params["w"])), state,
+                                  params)
+            params = optax.apply_updates(params, u)
+        return np.asarray(params["w"], np.float32)
+
+    def oracle(steps=60):
+        w = w0.copy()
+        for _ in range(steps):
+            w = w - 1.0 * 0.002 * (w - target)
+        return w
+
+    w_ef = run(SmallInt8EF)
+    w_noef = run(SmallInt8)
+    w_f32 = oracle()
+    small = np.ones(n, bool)
+    small[:: SmallInt8.block] = False
+    err_ef = np.max(np.abs(w_ef[small] - w_f32[small]))
+    err_noef = np.max(np.abs(w_noef[small] - w_f32[small]))
+    # EF tracks the oracle within a couple of quantization steps of the
+    # FINAL scale; no-EF loses essentially the whole descent on the
+    # small coordinates.
+    assert err_ef < 0.05, (err_ef, err_noef)
+    assert err_noef > 10 * err_ef, (err_ef, err_noef)
+
+
+def test_engine_digest_parity_and_wire_counters(hvd, monkeypatch):
+    """Acceptance pin: same input through the python and C++ engines
+    under HVD_COMPRESSION=int8 reduces to BIT-IDENTICAL bytes (the
+    shared data plane quantizes per chunk), both feed the same
+    engine.wire_bytes{,.compressed} counters, and the shipped bytes are
+    >= 3.9x below full width (f32 -> int8 payload + f32 scales)."""
+    from horovod_tpu.core import engine as eng
+    from horovod_tpu.core import telemetry as tele
+    from horovod_tpu.core.native_engine import NativeEngine
+
+    monkeypatch.setenv("HVD_COMPRESSION", "int8")
+    data = np.random.RandomState(3).randn(1 << 18).astype(np.float32)
+
+    digests, wires = [], []
+    for cls in (eng.Engine, NativeEngine):
+        before = tele.REGISTRY.flat_counters()
+        e = cls()
+        try:
+            out = e.synchronize(
+                e.allreduce_async("q/parity", data, average=False))
+        finally:
+            e.shutdown()
+        after = tele.REGISTRY.flat_counters()
+        digests.append(hashlib.sha256(out.tobytes()).hexdigest())
+        wires.append((
+            after.get("engine.wire_bytes", 0)
+            - before.get("engine.wire_bytes", 0),
+            after.get("engine.wire_bytes.compressed", 0)
+            - before.get("engine.wire_bytes.compressed", 0)))
+    assert digests[0] == digests[1], digests
+    assert wires[0] == wires[1], wires
+    wire, compressed = wires[0]
+    assert compressed == wire > 0
+    assert data.nbytes / wire >= 3.9, (data.nbytes, wire)
+
+
+def test_engine_env_policy_fail_fast(hvd, monkeypatch):
+    from horovod_tpu.core import engine as eng
+
+    monkeypatch.setenv("HVD_COMPRESSION", "int9")
+    with pytest.raises(eng.EngineError, match="int9"):
+        eng.Engine()
+
+
+def test_negotiation_mixed_policy_fails_by_name():
+    """Mixed wire policies across processes fail fast at negotiation,
+    naming the tensor and both policies (the HVD_CACHE_CAPACITY
+    precedent: a misconfiguration, not a hang)."""
+    from horovod_tpu.core import coordinator as coord
+
+    def meta(compression):
+        return coord.RequestMeta(name="grad/0", op="allreduce",
+                                 dtype="float32", itemsize=4, shape=(8,),
+                                 compression=compression)
+
+    groups = coord.decide({0: [meta("int8")], 1: [meta("none")]},
+                          [meta("int8")], 1 << 20)
+    errs = [g for g in groups if g.error]
+    assert errs, groups
+    assert "wire compression policies" in errs[0].error
+    assert "grad/0" in errs[0].error and "int8" in errs[0].error
+
+
+def test_fusion_groups_split_by_policy():
+    """Fused batches must be policy-uniform: the fusion key (both
+    engines and the coordinator's _fuse_names) includes the wire
+    policy."""
+    from horovod_tpu.core import coordinator as coord
+
+    metas = [coord.RequestMeta(name=f"g/{i}", op="allreduce",
+                               dtype="float32", itemsize=4, shape=(8,),
+                               nbytes=32,
+                               compression="int8" if i % 2 else "none")
+             for i in range(4)]
+    groups = coord._fuse_names(metas, 1 << 20)
+    for g in groups:
+        pols = {m.compression for m in metas if m.name in g}
+        assert len(pols) == 1, groups
+
+
+def test_xplane_dtype_split_attributes_payload_and_scales():
+    """Telemetry satellite: the xplane --hbm per-dtype accounting splits
+    the int8 payload from the f32 scales (s8 vs f32 columns) — the
+    compiled-path equivalent of the engine.wire_bytes counters."""
+    from horovod_tpu.utils import xplane
+
+    name = ("%fusion.1 = s8[4096]{0} fusion(s8[4096]{0} %a), "
+            "f32[8]{0} %scales")
+    by = xplane._hbm_shape_bytes_by_dtype(name)
+    assert by["s8"] == 2 * 4096 and by["f32"] == 32
+
+
+def test_quantized_policy_ships_nonfloat_full_width(hvd):
+    """Integer payloads have no quantized form: a quantized policy must
+    ship them full width (exact), not trip the quantized compressor's
+    deliberate NotImplementedError."""
+    x = jnp.arange(8, dtype=jnp.int32)
+    out = hj.allreduce(x, average=False, compression=Compression.int8)
+    np.testing.assert_array_equal(np.asarray(out),
+                                  np.arange(8) * hvd.size())
+    tree = {"f": jnp.ones((600,), jnp.float32), "i": x}
+    red = hj.allreduce_pytree(tree, average=False,
+                              compression=Compression.int8)
+    np.testing.assert_array_equal(np.asarray(red["i"]),
+                                  np.arange(8) * hvd.size())
+
+
+def test_select_none_pins_engine_wire():
+    """select() members are explicit: a 'none' entry pins the engine
+    wire to full width even under an HVD_COMPRESSION default, while the
+    implicit Compression.none keeps deferring to the env knob."""
+    sel = Compression.select("int8", **{"bn*": "none"})
+    assert sel.for_tensor("bn.gamma").engine_wire == "none"
+    assert sel.for_tensor("conv.w").engine_wire == "int8"
+    assert Compression.none.engine_wire is None
+
+
+def test_allgather_broadcast_exact_under_wire_policy(hvd, monkeypatch):
+    """Only allreduce has a quantized reduction: with HVD_COMPRESSION
+    set, allgather/broadcast stay full width and bit-exact on BOTH
+    engines (and negotiate as 'none', matching the python twin)."""
+    from horovod_tpu.core import engine as eng
+    from horovod_tpu.core.native_engine import NativeEngine
+
+    monkeypatch.setenv("HVD_COMPRESSION", "int8")
+    data = np.linspace(-1.0, 1.0, 100).astype(np.float32)
+    for cls in (eng.Engine, NativeEngine):
+        e = cls()
+        try:
+            g = e.synchronize(e.allgather_async("ag/x", data))
+            b = e.synchronize(e.broadcast_async("bc/x", data, 0))
+        finally:
+            e.shutdown()
+        assert g.shape == (hvd.size() * 100,)
+        np.testing.assert_array_equal(b, data)
+
+
+def test_shard_update_rejects_per_tensor_policy(hvd):
+    with pytest.raises(ValueError, match="per-tensor"):
+        hj.shard_update(optax.sgd(0.1),
+                        compression=Compression.select("int8"))
+
+
+def test_world_size_one_eager_elides_quantize(hvd, monkeypatch):
+    """Eager degenerate branch: at world size 1 the quantized policy's
+    update equals the uncompressed one BITWISE (no quantize round trip)
+    and the error-feedback residuals pass through untouched."""
+    from horovod_tpu.jax import sharded as _sh
+
+    monkeypatch.setattr(_sh, "_world", lambda: 1)
+    params = _tree()
+    g = jax.tree_util.tree_map(lambda l: l * 0.01 + 0.05, params)
+    outs = {}
+    for nm, comp in (("none", Compression.none), ("int8", SmallInt8EF)):
+        opt = hj.shard_update(optax.sgd(0.1), compression=comp)
+        state = opt.init(params)
+        u, s2 = opt.update(g, state, params)
+        outs[nm] = u
+        if nm == "int8":
+            for k in s2["qres"]["g"]:
+                np.testing.assert_array_equal(
+                    np.asarray(s2["qres"]["g"][k]), 0.0)
+    for a, b in zip(jax.tree_util.tree_leaves(outs["none"]),
+                    jax.tree_util.tree_leaves(outs["int8"])):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_state_dtype_composes_with_int8(hvd):
+    """The composed layout (quantized + bf16 residents + f32 master
+    shards): state is {"qres", "base"={"master","inner"}}, the helpers
+    unwrap it, and a compiled step runs."""
+    params = hj.cast_resident_params(_tree(), "bf16")
+    opt = hj.DistributedOptimizer(optax.sgd(0.1, momentum=0.9),
+                                  sharded_update=True, state_dtype="bf16",
+                                  compression=SmallInt8EF)
+    state = opt.init(params)
+    assert set(state) == {"qres", "base"}
+    assert hj.has_master_shards(state)
+    rebuilt = hj.resident_from_masters(state, params)
+    for a, b in zip(jax.tree_util.tree_leaves(rebuilt),
+                    jax.tree_util.tree_leaves(params)):
+        assert a.dtype == b.dtype
+        np.testing.assert_array_equal(
+            np.asarray(a, np.float32), np.asarray(b, np.float32))
+    gstack = _stack(params, hj.size())
+    new_p, new_s = _spmd_step(opt, state)(params, state, gstack)
+    assert set(new_s) == {"qres", "base"}
+    for a, b in zip(jax.tree_util.tree_leaves(new_p),
+                    jax.tree_util.tree_leaves(params)):
+        assert a.dtype == b.dtype
+        assert not np.array_equal(np.asarray(a, np.float32),
+                                  np.asarray(b, np.float32))

@@ -657,6 +657,31 @@ f2 = hj.jit(step2, in_specs=(P(), P(), P()), out_specs=(P(), P()))
 txt2 = f2.lower(p2, s2, p2).compile().as_text()
 for op in ("all-reduce", "reduce-scatter", "all-gather"):
     assert op not in txt2, op + " must be elided at world size 1"
+# Quantized policy (ISSUE 12): world size 1 elides EVERYTHING including
+# quantize/dequantize — the int8 step's program carries no s8 payload,
+# no all-to-all, and its numbers match the uncompressed step BITWISE
+# (a surviving quantize would be a lossy round trip for nothing).
+p3 = {"a": jnp.linspace(0.1, 1.7, 96).reshape(8, 12),
+      "b": jnp.full((7,), 0.123)}
+g3 = jax.tree_util.tree_map(lambda l: l * 0.01, p3)
+outs = {}
+for nm, comp in (("none", hj.Compression.none),
+                 ("int8", hj.Compression.int8_ef)):
+    opt3 = hj.DistributedOptimizer(optax.sgd(0.1), sharded_update=True,
+                                   compression=comp)
+    s3 = opt3.init(p3)
+    def step3(p, s, g, _opt=opt3):
+        u, s4 = _opt.update(g, s, p)
+        return optax.apply_updates(p, u), s4
+    f3 = hj.jit(step3, in_specs=(P(), P(), P()), out_specs=(P(), P()))
+    if nm == "int8":
+        txt3 = f3.lower(p3, s3, g3).compile().as_text()
+        for tok in ("all-to-all", "all-gather", "s8["):
+            assert tok not in txt3, tok + " must be elided at size 1"
+    outs[nm], _ = f3(p3, s3, g3)
+for ka, kb in zip(jax.tree_util.tree_leaves(outs["none"]),
+                  jax.tree_util.tree_leaves(outs["int8"])):
+    np.testing.assert_array_equal(np.asarray(ka), np.asarray(kb))
 print("ELIDED-OK")
 """
     env = dict(os.environ)

@@ -37,6 +37,28 @@ def test_allreduce_fp16_compression():
     np.testing.assert_allclose(out.numpy(), x.numpy(), rtol=1e-2)
 
 
+def test_allreduce_int8_engine_wire():
+    """Quantized policy (ISSUE 12): block-scaled int8 applied in the
+    ENGINE's execution chunks — the TF surface accepts the name/class
+    and the reduced value tracks the input within one quantization
+    step."""
+    x = tf.constant(np.linspace(-2.0, 2.0, 600), tf.float32)
+    out = hvd_tf.allreduce(x, average=True,
+                           compression=hvd_tf.Compression.int8)
+    assert out.dtype == tf.float32
+    np.testing.assert_allclose(out.numpy(), x.numpy(), atol=2.0 / 127)
+
+
+def test_compression_unknown_name_fails_fast_naming_rank():
+    """Satellite pin: a bad compressor fails at resolution with rank
+    attribution, not as an attribute error mid-step."""
+    with pytest.raises(ValueError, match="rank|pid"):
+        hvd_tf.Compression.resolve("int9")
+    with pytest.raises(ValueError, match="rank|pid"):
+        hvd_tf.DistributedOptimizer(
+            tf.keras.optimizers.SGD(0.1), compression="bogus")
+
+
 def test_allgather():
     x = tf.constant([[1.0, 2.0]])
     g = hvd_tf.allgather(x)

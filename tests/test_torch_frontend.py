@@ -52,6 +52,32 @@ def test_allreduce_fp16_compression():
     np.testing.assert_allclose(out.numpy(), x.numpy(), atol=1e-3)
 
 
+def test_allreduce_int8_engine_wire():
+    """Quantized policy (ISSUE 12): block-scaled int8 in the engine's
+    execution chunks; the torch surface accepts the class/name, and the
+    per-tensor select() container routes by name."""
+    x = torch.linspace(-2.0, 2.0, 600)
+    out = hvt.allreduce(x, average=True, compression=Compression.int8)
+    assert out.dtype == torch.float32
+    np.testing.assert_allclose(out.numpy(), x.numpy(), atol=2.0 / 127)
+    # Name-based override: 'bn*' stays full-width, everything else int8.
+    sel = Compression.select("int8", **{"bn*": "none"})
+    exact = hvt.allreduce(x, average=True, name="bn.gamma",
+                          compression=sel)
+    np.testing.assert_allclose(exact.numpy(), x.numpy(), atol=1e-6)
+
+
+def test_compression_unknown_name_fails_fast_naming_rank():
+    """Satellite pin: a bad compressor fails at resolution with rank
+    attribution, not as an attribute error mid-step."""
+    with pytest.raises(ValueError, match="rank|pid"):
+        Compression.resolve("int9")
+    with pytest.raises(ValueError, match="rank|pid"):
+        hvt.DistributedOptimizer(
+            torch.optim.SGD([torch.nn.Parameter(torch.ones(3))], lr=0.1),
+            compression="bogus")
+
+
 def test_allreduce_bf16_tensor():
     x = torch.ones(8, dtype=torch.bfloat16)
     out = hvt.allreduce(x, average=False)
