@@ -75,6 +75,7 @@ def _decompose_timeline(path, n_ops):
 
     stack = {}
     totals = collections.defaultdict(float)
+    spans = collections.defaultdict(list)  # activity -> [duration_s]
     neg_durs = {"cached": [], "full": []}
     for ev in json.load(open(path)):
         if not ev or ev.get("ph") not in ("B", "E"):
@@ -86,6 +87,7 @@ def _decompose_timeline(path, n_ops):
             name, ts0 = stack[key].pop()
             dur_s = (ev["ts"] - ts0) / 1e6
             totals[name] += dur_s
+            spans[name].append(dur_s)
             if str(name).startswith("NEGOTIATE_"):
                 cached = ev.get("args", {}).get("cached")
                 if cached is not None:
@@ -112,9 +114,31 @@ def _decompose_timeline(path, n_ops):
         print(f"#   negotiate rounds (HVD_CACHE_CAPACITY="
               f"{os.environ.get('HVD_CACHE_CAPACITY', 'default')}): "
               + " | ".join(parts))
+
+    # Per-SPAN medians over the canonical engine-path phases, for
+    # perfwatch/perf.jsonl trending (QUEUE/NEGOTIATE/MEMCPY/ALLREDUCE/
+    # MEMCPY_OUT — MEMCPY folds the submit snapshot and the fusion
+    # copy-in together: the copy-in cost a tensor pays on the way to the
+    # wire; the zero-copy pool/donation work moves exactly these two).
+    def _median(names):
+        durs = sorted(d for n in names for d in spans.get(n, ()))
+        return round(durs[len(durs) // 2] * 1e3, 4) if durs else None
+
+    phase_medians = {
+        "QUEUE": _median(["QUEUE"]),
+        "NEGOTIATE": _median([n for n in spans
+                              if str(n).startswith("NEGOTIATE_")]),
+        "MEMCPY": _median(["MEMCPY", "MEMCPY_IN_FUSION_BUFFER"]),
+        "ALLREDUCE": _median(["ALLREDUCE"]),
+        "MEMCPY_OUT": _median(["MEMCPY_OUT_FUSION_BUFFER"]),
+    }
+    parts = [f"{k}={v:.4f}" for k, v in phase_medians.items()
+             if v is not None]
+    print("#   phase medians (ms/span): " + " ".join(parts))
     return {
         "phases_ms_per_op": {k: round(v / n_ops * 1e3, 4)
                              for k, v in totals.items()},
+        "phase_medians": phase_medians,
         "negotiate": negotiate or None,
     }
 
@@ -154,12 +178,16 @@ def run_engine(args, tl_path):
     from horovod_tpu.core import engine as eng
     from horovod_tpu.core import telemetry as _tele
 
+    import os as _os
+
     e = eng.get_engine()
     kind = type(e).__name__
     policy = args.compression or "none"
     print(f"# engine path ({kind}), fusion_threshold="
           f"{e.fusion_threshold}, tensors/iter={args.tensors}, "
-          f"compression={policy}")
+          f"compression={policy}, donate={args.donate}, "
+          f"HVD_POOL_MAX_BYTES="
+          f"{_os.environ.get('HVD_POOL_MAX_BYTES', 'default')}")
     print(f"# {'size/tensor':>12s} {'total':>10s} {'time':>10s} "
           f"{'bytes/us':>9s} {'host_bw':>9s}")
     rows = []
@@ -171,10 +199,14 @@ def run_engine(args, tl_path):
         tensors = [np.ones((elems,), np.float32) for _ in range(args.tensors)]
         total = sum(t.nbytes for t in tensors)
 
-        def one_iter(collect=False):
+        def one_iter(collect=False, bufs=None):
+            # --donate: ownership handoff — the engine references the
+            # buffers in place (read-only) instead of snapshotting,
+            # the MEMCPY phase the pool already cheapened goes to ~0.
             handles = [
-                e.allreduce_async(f"bench/{i}", t, average=False)
-                for i, t in enumerate(tensors)
+                e.allreduce_async(f"bench/{i}", t, average=False,
+                                  donate=args.donate)
+                for i, t in enumerate(bufs if bufs is not None else tensors)
             ]
             outs = [e.synchronize(h) for h in handles]
             return outs if collect else None
@@ -189,8 +221,12 @@ def run_engine(args, tl_path):
         dt = wall / args.iters
         # One extra (untimed) iteration for the reduction digest — the
         # cross-engine bit-identity check the quantized wire format is
-        # pinned by.
-        outs = one_iter(collect=True)
+        # pinned by. Fresh buffers: under --donate the timed tensors were
+        # handed to the engine, and the digest must stay comparable
+        # across engines and modes.
+        outs = one_iter(collect=True,
+                        bufs=[np.ones((elems,), np.float32)
+                              for _ in range(args.tensors)])
         digest = hashlib.sha256(
             b"".join(np.ascontiguousarray(o).tobytes()
                      for o in outs)).hexdigest()
@@ -234,7 +270,11 @@ def run_engine(args, tl_path):
                 tl_path, niters * args.tensors)
         rows.append(row)
     return {"mode": "engine", "engine": kind, "tensors": args.tensors,
-            "iters": args.iters, "compression": policy, "rows": rows}
+            "iters": args.iters, "compression": policy,
+            "donate": args.donate,
+            "pool_max_bytes": _os.environ.get("HVD_POOL_MAX_BYTES",
+                                              "default"),
+            "rows": rows}
 
 
 def main():
@@ -252,6 +292,13 @@ def main():
     ap.add_argument("--tensors", type=int, default=1,
                     help="tensors submitted together per iteration "
                          "(--engine; exercises runtime fusion)")
+    ap.add_argument("--donate", action="store_true",
+                    help="with --engine: submit with donate=True — the "
+                         "zero-copy ownership handoff that skips the "
+                         "submit snapshot entirely (compare the MEMCPY "
+                         "phase median against a run without it, and "
+                         "against HVD_POOL_MAX_BYTES=0 for the pooled "
+                         "vs unpooled copy split)")
     ap.add_argument("--decompose", action="store_true",
                     help="with --engine: print the per-phase share table "
                          "of the round trip (queue / stage / collective "

@@ -1,0 +1,213 @@
+"""Pooled host-buffer allocator — the engines' zero-copy data plane.
+
+The reference keeps one persistent fusion buffer per (device, framework)
+and reuses it forever (PersistentBuffer, SURVEY C8; FusionBufferManager,
+operations.cc:2035-2074) — buffer reuse, not faster memcpy, is what makes
+its small-tensor path cheap (arxiv 1802.05799; arxiv 1810.11112 measures
+the copy-in/copy-out phases as the dominant non-network cost). This module
+is that seat for the host engines: per-dtype slabs in power-of-two size
+classes, checked out for submit snapshots, fusion buffers, wire-staging
+and result buffers, and reused across cycles so a steady-state training
+loop allocates nothing after warmup (pinned by tests/test_zero_copy.py).
+
+Lifecycle is reference-count driven, not checkin-driven: ``checkout``
+returns a numpy VIEW of a pool-owned slab, and a slab becomes reusable
+when no view of it remains alive (numpy collapses view chains onto the
+owning array, so one ``sys.getrefcount`` probe is exact). That makes
+pooling safe by construction — a result view handed to a caller pins its
+slab for exactly as long as the caller can observe it, and an executor
+returning its input aliased as output can never cause a reuse scribble.
+
+The C++ engine keeps its own twin of this pool inside libhvdcore
+(hvdcore.cc BufferPool — explicit Get/Put there, since the C++ loop owns
+every buffer lifetime precisely); both feed the same telemetry counters:
+``engine.pool.{hits,misses,checkouts}`` and the ``engine.pool.
+bytes_resident`` gauge.
+
+Knobs: ``HVD_POOL_MAX_BYTES`` caps the resident slab bytes per pool
+(default 1 GiB; ``0`` disables pooling entirely — every checkout is a
+plain allocation, the measured "before" of docs/benchmarks.md).
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import threading
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from horovod_tpu.core import faultline as flt
+from horovod_tpu.core import telemetry as tele
+
+DEFAULT_MAX_BYTES = 1 << 30
+# Slabs below this round up to it: tiny classes would fragment the pool
+# into hundreds of buckets, and CPython routes >=4 KiB allocations to
+# malloc, whose blocks are comfortably aligned for every wire dtype.
+MIN_CLASS_BYTES = 4096
+
+
+def max_bytes_from_env() -> int:
+    """HVD_POOL_MAX_BYTES (bytes; 0 disables pooling)."""
+    v = os.environ.get("HVD_POOL_MAX_BYTES")
+    if not v:
+        return DEFAULT_MAX_BYTES
+    try:
+        return max(0, int(v))
+    except ValueError:
+        return DEFAULT_MAX_BYTES
+
+
+def class_bytes(nbytes: int) -> int:
+    """Size class: next power of two, floored at MIN_CLASS_BYTES.
+    Checkouts match their exact class only — a steady-state loop with a
+    fixed working set re-hits the same buckets forever, and a 4 KiB
+    request can never steal (and force the realloc of) a 256 MiB slab."""
+    return max(MIN_CLASS_BYTES, 1 << (max(int(nbytes), 1) - 1).bit_length())
+
+
+class BufferPool:
+    """Per-dtype pooled slabs. Thread-safe; one instance per engine (so
+    elastic teardown can poison exactly the dying engine's pool)."""
+
+    def __init__(self, max_bytes: Optional[int] = None,
+                 own_gauge: bool = True):
+        self.max_bytes = (max_bytes_from_env() if max_bytes is None
+                          else int(max_bytes))
+        self.enabled = self.max_bytes > 0
+        # Whether this pool writes the engine.pool.bytes_resident gauge
+        # directly. The native engine's python-side pool does NOT — its
+        # stats sync owns the gauge (C++ + python residency combined),
+        # and a per-checkout write here would clobber that with the
+        # python share alone.
+        self._own_gauge = own_gauge
+        self._lock = threading.Lock()
+        # (dtype, class bytes) -> slabs. Every slab the pool ever retained
+        # stays listed; a slab is FREE exactly when only the list holds it.
+        self._slabs: Dict[Tuple[np.dtype, int], List[np.ndarray]] = {}
+        self._poisoned = False
+        self.hits = 0
+        self.misses = 0
+        self.checkouts = 0
+        self.bytes_resident = 0
+        # Registry objects cached once: the checkout path must not pay a
+        # name lookup per call (both engines feed these same names — the
+        # native engine folds its C++ pool's counts in via its stats
+        # sync, see native_engine._STAT_COUNTERS).
+        self._c_hits = tele.REGISTRY.counter("engine.pool.hits")
+        self._c_misses = tele.REGISTRY.counter("engine.pool.misses")
+        self._c_checkouts = tele.REGISTRY.counter("engine.pool.checkouts")
+        self._g_resident = tele.REGISTRY.gauge("engine.pool.bytes_resident")
+
+    def checkout(self, count: int, dtype) -> np.ndarray:
+        """A 1-d array of ``count`` elements, backed by a pooled slab when
+        one of the right (dtype, class) is free. The returned view (and
+        anything derived from it) pins the slab; dropping every view
+        returns the slab to the pool implicitly."""
+        return self.checkout_tracked(count, dtype)[0]
+
+    def checkout_tracked(self, count: int, dtype):
+        """:meth:`checkout` plus whether the buffer is actually
+        pool-tracked (hit, or a retained miss) — the honest value of the
+        trace spans' ``pooled`` arg: a cap-exceeded, fault-exhausted or
+        poisoned checkout must attribute as plain, not pooled."""
+        dtype = np.dtype(dtype)
+        count = int(count)
+        nbytes = max(count, 1) * dtype.itemsize
+        # Fault site engine.pool (core/faultline.py): 'exhausted' forces
+        # the cap-reached path — fresh allocation, counted as a miss,
+        # nothing retained.
+        exhausted = flt.pool_exhausted()
+        self.checkouts += 1  # benign data race: monotonic event tally
+        self._c_checkouts.inc()
+        if not self.enabled or self._poisoned or exhausted:
+            self.misses += 1
+            self._c_misses.inc()
+            return np.empty((count,), dtype), False
+        cls = class_bytes(nbytes)
+        # The lock covers only the bucket scan/registration: allocation
+        # happens outside it — the submit thread and the engine loop
+        # share this pool, and a fat critical section would turn every
+        # checkout into a GIL/lock handoff between them.
+        with self._lock:
+            bucket = self._slabs.get((dtype, cls))
+            if bucket:
+                for slab in bucket:
+                    # Free slab: referenced only by the bucket entry, the
+                    # loop variable and getrefcount's argument. Any live
+                    # view (numpy collapses view chains onto the owning
+                    # array) raises the count and skips it.
+                    if sys.getrefcount(slab) == 3:
+                        self.hits += 1
+                        self._c_hits.inc()
+                        return slab[:count], True
+        self.misses += 1
+        self._c_misses.inc()
+        with self._lock:
+            retain = (not self._poisoned
+                      and self.bytes_resident + cls <= self.max_bytes)
+        if not retain:
+            # Cap reached (or racing a poison): a plain allocation of
+            # EXACTLY count elements — class rounding here would double
+            # the memory of every over-cap tensor for no reuse benefit.
+            return np.empty((count,), dtype), False
+        slab = np.empty((cls // dtype.itemsize,), dtype)
+        tracked = False
+        with self._lock:
+            if (not self._poisoned
+                    and self.bytes_resident + cls <= self.max_bytes):
+                self._slabs.setdefault((dtype, cls), []).append(slab)
+                self.bytes_resident += cls
+                if self._own_gauge:
+                    self._g_resident.set(self.bytes_resident)
+                tracked = True
+        return slab[:count], tracked
+
+    def snapshot(self, arr):
+        """Pool-backed copy of ``arr`` (any layout), shaped like it — the
+        submit-time snapshot — plus the tracked flag of
+        :meth:`checkout_tracked`. Falls back to a plain copy when
+        disabled."""
+        a = np.asarray(arr)
+        out, tracked = self.checkout_tracked(a.size, a.dtype)
+        out = out.reshape(a.shape)
+        np.copyto(out, a)
+        return out, tracked
+
+    def poison(self):
+        """Elastic teardown (Engine.abandon): drop every slab reference so
+        nothing checked out by the dying engine can ever be handed to a
+        later checkout — a wedged thread parked inside the old backend may
+        still be reading its views. Outstanding views keep their slabs
+        alive independently; the memory dies with the last view."""
+        with self._lock:
+            self._poisoned = True
+            self._slabs.clear()
+            self.bytes_resident = 0
+            if self._own_gauge:
+                self._g_resident.set(0)
+
+    @property
+    def poisoned(self) -> bool:
+        return self._poisoned
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {"hits": self.hits, "misses": self.misses,
+                    "checkouts": self.checkouts,
+                    "bytes_resident": self.bytes_resident}
+
+
+_default: Optional[BufferPool] = None
+_default_lock = threading.Lock()
+
+
+def get_default() -> BufferPool:
+    """Process-wide pool for pool users without an engine (a standalone
+    JaxExecutor). Engines construct their own instances."""
+    global _default
+    with _default_lock:
+        if _default is None:
+            _default = BufferPool()
+        return _default

@@ -33,6 +33,7 @@ from typing import Callable, Dict, Optional
 
 import numpy as np
 
+from horovod_tpu.core import bufferpool as bpool
 from horovod_tpu.core import faultline as flt
 from horovod_tpu.core import numerics as numx
 from horovod_tpu.core import telemetry as tele
@@ -91,16 +92,43 @@ def wire_policy_from_env() -> str:
                                or os.environ.get("HOROVOD_COMPRESSION"))
 
 
-def _poison_result(fault, out: np.ndarray) -> np.ndarray:
+def _poison_result(fault, out: np.ndarray, private: bool = False) -> np.ndarray:
     """engine.exec 'poison' fault: NaN-fill a float result AFTER the real
     collective ran — the reduced value every rank hands back is poisoned,
     which is what drives the numerics engine_check_result attribution
-    (non-float results pass through; there is no NaN to poison with)."""
+    (non-float results pass through; there is no NaN to poison with).
+
+    ``private=True`` says the reduction already produced a buffer nothing
+    else can alias (the executor's pool-checked-out output), so the
+    defensive copy is the double copy on the result path — poison in
+    place instead."""
     if fault is None or fault.mode != "poison" or out.dtype.kind not in "fc":
         return out
-    out = np.array(out)  # never scribble on a caller-shared buffer
+    if not private:
+        out = np.array(out)  # never scribble on a caller-shared buffer
     out[...] = np.nan
     return out
+
+
+# Placeholder a completed entry's tensor is swapped to (releases the
+# snapshot slab's last engine-side reference before the waiter wakes).
+_RETIRED = np.empty((0,), np.uint8)
+
+
+def _freeze_donated(a: np.ndarray) -> bool:
+    """Flag a donated buffer unwriteable so a donate-then-mutate raises
+    (runtime-owned buffers — jax/TF — are read-only already). Returns
+    whether the flag was actually flipped: a REJECTED donated submit
+    (duplicate name, shutdown, injected fault) must flip it back — the
+    engine never took ownership, and the caller's buffer must not stay
+    read-only forever."""
+    if not a.flags.writeable:
+        return False
+    try:
+        a.flags.writeable = False
+        return True
+    except ValueError:  # pragma: no cover — writeable arrays flip fine
+        return False
 
 
 class EngineError(RuntimeError):
@@ -128,6 +156,12 @@ class _Entry:
     root_rank: int = 0
     prescale: float = 1.0
     compression: str = "none"  # engine wire policy for this request
+    # Ownership-handoff submit (allreduce_async(..., donate=True)): the
+    # entry references the caller's buffer in place — no snapshot copy
+    # was taken, and the engine only ever READS it (results land in
+    # separate pool buffers), so frontends may donate runtime-owned
+    # immutable buffers (jax arrays, TF eager tensors).
+    donated: bool = False
     enqueued_at: float = field(default_factory=time.monotonic)
     # Processes whose announcement of this tensor has been marked on the
     # timeline (RANK_READY instants inside the NEGOTIATE_* span).
@@ -157,6 +191,12 @@ class JaxExecutor:
 
     measure_staging = False
     last_stage_s = 0.0
+    # Buffer pool for output/staging buffers (engines hand their own pool
+    # over at construction; a standalone executor rides the process-wide
+    # default). Output buffers are checked out per call and recycle when
+    # the caller drops the result views — the allocation-free
+    # steady-state contract of core/bufferpool.py.
+    pool = None
     # Wire policy of the CURRENT allreduce call (set by the engine from
     # the request's `compression`/`wire` just before the call — an
     # attribute, not a parameter, so test doubles with the historical
@@ -216,17 +256,30 @@ class JaxExecutor:
         elements): ≤11 distinct tail programs below CHUNK_ELEMS."""
         return max(1024, 1 << (n - 1).bit_length())
 
+    def _checkout(self, count: int, dtype) -> np.ndarray:
+        pool = self.pool
+        if pool is None:
+            pool = self.pool = bpool.get_default()
+        return pool.checkout(count, dtype)
+
     def _quantized_chunk(self, chunk: np.ndarray, pol, average: bool):
         """One execution chunk under a quantized wire policy: quantize
         HOST-side (the staged device buffers — the wire — already carry
         the int8 payload + f32 scales), allgather both across the world
         (each rank's hop ships the quantized bytes, the quantized
         reduce-scatter's per-rank traffic), dequantize-accumulate in
-        f32. Returns (reduced chunk, wire bytes shipped)."""
+        f32. The quantize step stages into pool-checked-out wire slabs
+        (payload, scales, f32 scratch) — no fresh arrays in the
+        steady-state wire path. Returns (reduced chunk (f32), wire bytes
+        shipped)."""
         from horovod_tpu.jax import quantize as Q
         from horovod_tpu.ops import collectives as C
 
-        payload, scales, npad = Q.np_quantize(chunk, pol)
+        npad = Q.padded_len(max(chunk.shape[0], 1), pol.block)
+        payload = self._checkout(npad, Q.np_wire_dtype(pol))
+        scales = self._checkout(npad // pol.block, np.float32)
+        work = self._checkout(npad, np.float32)
+        Q.np_quantize_into(chunk, pol, payload, scales, work)
         gp = np.asarray(C.allgather(self._stage(payload)))
         stage_s = self.last_stage_s
         gs = np.asarray(C.allgather(self._stage(scales)))
@@ -236,8 +289,7 @@ class JaxExecutor:
                                   gs.reshape(world, -1), pol)
         if average:
             out /= world
-        return out[:chunk.shape[0]].astype(chunk.dtype), \
-            payload.nbytes + scales.nbytes
+        return out[:chunk.shape[0]], payload.nbytes + scales.nbytes
 
     def _wire_quantizer(self, flat: np.ndarray):
         """The quantized-policy object for this call, or None (policy
@@ -262,7 +314,10 @@ class JaxExecutor:
         fault = flt.engine_exec("allreduce")  # stall sleeps, error raises
         pol = self._wire_quantizer(flat)
         n = flat.shape[0]
-        out = np.empty_like(flat)
+        # Pool-checked-out result buffer: private by construction (nothing
+        # else holds a view), handed to callers as slices and recycled by
+        # the pool once they drop it.
+        out = self._checkout(n, flat.dtype)
         stage_s = 0.0
         wire = 0
         with self._ctx(flat):
@@ -275,9 +330,12 @@ class JaxExecutor:
                 if bucket != take:
                     # Zero padding is reduction-neutral (sum of zeros;
                     # average divides by world size only — and zero
-                    # blocks quantize to zero payload).
-                    chunk = np.concatenate(
-                        [chunk, np.zeros((bucket - take,), flat.dtype)])
+                    # blocks quantize to zero payload). Padded into a
+                    # pooled slab, not a fresh concatenation.
+                    padded = self._checkout(bucket, flat.dtype)
+                    padded[:take] = chunk
+                    padded[take:] = 0
+                    chunk = padded
                 if pol is not None:
                     res, chunk_wire = self._quantized_chunk(chunk, pol,
                                                             average)
@@ -292,7 +350,7 @@ class JaxExecutor:
         self.last_stage_s = stage_s
         self.last_wire_bytes = wire
         self.last_wire_compressed = wire if pol is not None else 0
-        return _poison_result(fault, out)
+        return _poison_result(fault, out, private=True)
 
     def allgather(self, tensor: np.ndarray) -> np.ndarray:
         from horovod_tpu.ops import collectives as C
@@ -481,6 +539,13 @@ class Engine:
         self.stall_warning_s = stall_warning_s or STALL_WARNING_TIME_S
         self.stall_check_disabled = stall_warning_s == 0.0
         self.executor = executor or JaxExecutor()
+        # Per-engine buffer pool (core/bufferpool.py): submit snapshots,
+        # fusion buffers and executor outputs ride reused slabs. Per
+        # ENGINE, not process-wide, so elastic teardown can poison
+        # exactly the dying engine's pool (abandon below).
+        self.pool = bpool.BufferPool()
+        if getattr(self.executor, "pool", None) is None:
+            self.executor.pool = self.pool
         # Engine-wide default wire format (HVD_COMPRESSION); per-request
         # policies override it at submit. Fails fast on misspellings.
         self.wire_default = wire_policy_from_env()
@@ -526,7 +591,7 @@ class Engine:
     # -- enqueue API (reference: EnqueueTensorAllreduce/Allgather/Broadcast,
     # operations.cc:2264-2380) ------------------------------------------------
 
-    def _enqueue(self, entry: _Entry) -> int:
+    def _enqueue(self, entry: _Entry, mem_span=None) -> int:
         # Fault site engine.submit (core/faultline.py): a failed submit
         # raises before any handle/queue state exists — same observable
         # shape as an organic enqueue rejection.
@@ -552,37 +617,81 @@ class Engine:
         # SNAPSHOT is the attribution side of the synchronize-time check
         # — a poisoned reduced result names the submitting process.
         numx.engine_note_submit(entry.name, entry.tensor)
-        self.timeline.start(entry.name, tl.QUEUE)
+        if mem_span is not None:
+            # The submit-time snapshot as a retro MEMCPY span at the head
+            # of the QUEUE span; the END args carry the zero-copy
+            # attribution ({"pooled": bool} / {"donated": true}) the
+            # trace CLI splits copy-phase medians by.
+            t0, t1, args = mem_span
+            self.timeline.start(entry.name, tl.QUEUE, ts_us=t0)
+            self.timeline.start(entry.name, tl.MEMCPY, ts_us=t0)
+            self.timeline.end(entry.name, tl.MEMCPY, args, ts_us=t1)
+        else:
+            self.timeline.start(entry.name, tl.QUEUE)
         self._queue.put(entry)
         self._wake.set()
         return entry.handle
 
-    # Submit-time SNAPSHOT (np.array, not ascontiguousarray): the C++
-    # engine memcpys at enqueue (hvdcore.cc), so a caller mutating its
-    # buffer after an *_async call must not change what gets reduced —
-    # the python twin owes the same observable semantics, and frontends
-    # now hand over zero-copy views (torch .numpy()/bf16 reinterpret).
+    # Submit-time SNAPSHOT (pool-slab copy — np.array before the pool):
+    # the C++ engine memcpys at enqueue (hvdcore.cc), so a caller
+    # mutating its buffer after an *_async call must not change what gets
+    # reduced — the python twin owes the same observable semantics, and
+    # frontends hand over zero-copy views (torch .numpy()/bf16
+    # reinterpret). ``donate=True`` skips the copy: the engine takes
+    # ownership and references the buffer in place (read-only — results
+    # land in separate pool buffers), so the caller must not touch it
+    # again; the numpy view is flagged unwriteable so an in-process
+    # mutation raises rather than corrupting the reduction.
+    def _snapshot(self, tensor, donate: bool):
+        """(array, donated, flipped-read-only, (t0, t1, span_args))."""
+        t0 = self.timeline.now_us()
+        a = np.asarray(tensor)
+        if donate and a.flags["C_CONTIGUOUS"]:
+            flipped = _freeze_donated(a)
+            return a, True, flipped, (t0, self.timeline.now_us(),
+                                      {"donated": True})
+        snap, tracked = self.pool.snapshot(a)
+        return snap, False, False, (t0, self.timeline.now_us(),
+                                    {"pooled": tracked})
+
+    def _submit(self, entry: _Entry, span, flipped: bool) -> int:
+        try:
+            return self._enqueue(entry, span)
+        except Exception:
+            # Rejected submit: the engine never took ownership — a
+            # donated buffer we froze must become writable again.
+            if flipped:
+                entry.tensor.flags.writeable = True
+            raise
+
     def allreduce_async(self, name: str, tensor: np.ndarray, average: bool,
                         prescale: float = 1.0,
-                        compression: Optional[str] = None) -> int:
+                        compression: Optional[str] = None,
+                        donate: bool = False) -> int:
         # `compression` is the per-request engine wire policy (frontend
         # Compression objects carry it as .engine_wire); None defers to
         # the HVD_COMPRESSION default.
         wire = (resolve_wire_policy(compression)
                 if compression is not None else self.wire_default)
-        return self._enqueue(
-            _Entry(-1, name, "allreduce", np.array(tensor),
-                   average=average, prescale=prescale, compression=wire)
-        )
+        snap, donated, flipped, span = self._snapshot(tensor, donate)
+        return self._submit(
+            _Entry(-1, name, "allreduce", snap, average=average,
+                   prescale=prescale, compression=wire, donated=donated),
+            span, flipped)
 
-    def allgather_async(self, name: str, tensor: np.ndarray) -> int:
-        return self._enqueue(_Entry(-1, name, "allgather", np.array(tensor)))
+    def allgather_async(self, name: str, tensor: np.ndarray,
+                        donate: bool = False) -> int:
+        snap, donated, flipped, span = self._snapshot(tensor, donate)
+        return self._submit(
+            _Entry(-1, name, "allgather", snap, donated=donated),
+            span, flipped)
 
-    def broadcast_async(self, name: str, tensor: np.ndarray, root_rank: int) -> int:
-        return self._enqueue(
-            _Entry(-1, name, "broadcast", np.array(tensor),
-                   root_rank=root_rank)
-        )
+    def broadcast_async(self, name: str, tensor: np.ndarray, root_rank: int,
+                        donate: bool = False) -> int:
+        snap, donated, flipped, span = self._snapshot(tensor, donate)
+        return self._submit(
+            _Entry(-1, name, "broadcast", snap, root_rank=root_rank,
+                   donated=donated), span, flipped)
 
     # -- completion API (reference: handle_manager.cc + mpi_ops_v2.cc poll/
     # wait_and_clear:228-338) -------------------------------------------------
@@ -887,12 +996,37 @@ class Engine:
             if fused:
                 for n in names:
                     self.timeline.start(n, tl.MEMCPY_IN_FUSION_BUFFER)
-                flat = np.concatenate(
-                    [(e.tensor.reshape(-1) * e.prescale if e.prescale != 1.0
-                      else e.tensor.reshape(-1)) for e in batch]
-                )
+                dtype = batch[0].tensor.dtype
+                if any(e.prescale != 1.0 for e in batch) \
+                        and dtype.kind not in "fc":
+                    # Degenerate corner (non-unit prescale on an integer
+                    # batch): preserve the historical float-promoting
+                    # concatenation semantics instead of pooling.
+                    flat = np.concatenate(
+                        [(e.tensor.reshape(-1) * e.prescale
+                          if e.prescale != 1.0 else e.tensor.reshape(-1))
+                         for e in batch])
+                    pooled_fusion = False
+                else:
+                    # Pool-checked-out fusion buffer, reused across
+                    # cycles (the reference's persistent fusion buffer,
+                    # operations.cc:2035-2074).
+                    flat, pooled_fusion = self.pool.checkout_tracked(
+                        sum(e.tensor.size for e in batch), dtype)
+                    off = 0
+                    for e in batch:
+                        n_ = e.tensor.size
+                        src = e.tensor.reshape(-1)
+                        if e.prescale != 1.0:
+                            np.multiply(src, e.prescale,
+                                        out=flat[off: off + n_])
+                        else:
+                            flat[off: off + n_] = src
+                        off += n_
+                pool_args = {"pooled": pooled_fusion}
                 for n in names:
-                    self.timeline.end(n, tl.MEMCPY_IN_FUSION_BUFFER)
+                    self.timeline.end(n, tl.MEMCPY_IN_FUSION_BUFFER,
+                                      pool_args)
             else:
                 flat = batch[0].tensor.reshape(-1)
                 if batch[0].prescale != 1.0:
@@ -904,6 +1038,11 @@ class Engine:
             # fusion key and the coordinator's grouping include it).
             self.executor.wire_policy = batch[0].compression
             out = self.executor.allreduce(flat, batch[0].average)
+            # Release the fusion input before any completion wakes a
+            # waiter: the caller's next cycle must find the slab free
+            # (unless a test executor returned the input aliased as
+            # output, in which case `out` legitimately pins it).
+            flat = None
             record_wire(self.executor)
             self._emit_exec_spans(batch, tl.ALLREDUCE, t0)
             off = 0
@@ -913,7 +1052,8 @@ class Engine:
                     self.timeline.start(e.name, tl.MEMCPY_OUT_FUSION_BUFFER)
                 result = out[off: off + n].reshape(e.tensor.shape)
                 if fused:
-                    self.timeline.end(e.name, tl.MEMCPY_OUT_FUSION_BUFFER)
+                    self.timeline.end(e.name, tl.MEMCPY_OUT_FUSION_BUFFER,
+                                      pool_args)
                 self._complete(e, result, None)
                 off += n
         except Exception as exc:  # surfaced at synchronize()
@@ -946,6 +1086,11 @@ class Engine:
         tele.REGISTRY.counter(
             "engine.errors" if err is not None else "engine.completed").inc()
         tele.REGISTRY.gauge("engine.queue_depth").set(depth)
+        # Release the snapshot slab BEFORE waking the waiter: the cycle
+        # loop's local batch list is the last engine-side reference, and
+        # a submit-then-wait caller's next enqueue must find the slab
+        # free, not race the loop thread for it.
+        e.tensor = _RETIRED
         if h is not None:
             h.result = result
             h.error = err
@@ -1029,6 +1174,11 @@ class Engine:
         if c is not None:
             c.dead = c.dead or "engine abandoned (elastic reconfiguration)"
             c._closed = True  # a blocked read aborts IF it ever returns
+        # Pool hygiene: the parked loop thread may still hold checked-out
+        # slabs (it is wedged inside the dead backend) — poison the pool
+        # so none of them can ever be handed to a later checkout. The
+        # successor engine builds a fresh pool.
+        self.pool.poison()
         self._shutdown.set()
         self._wake.set()
         with self._lock:

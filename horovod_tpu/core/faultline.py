@@ -44,6 +44,9 @@ Sites and modes::
                                                    the counter; vanish deletes the key)
     engine.submit  fail                            *_async enqueue raises
     engine.exec    stall(param=s) | poison | error  executor call (poison = NaN result)
+    engine.pool    exhausted                       buffer-pool checkout behaves as if
+                                                   the resident cap were reached (fresh
+                                                   allocation, counted as a miss)
     ckpt.write     torn                            checkpoint save dies mid-write
 
 Every firing increments ``fault.injected`` + ``fault.injected.<site>``,
@@ -67,7 +70,7 @@ LOG = logging.getLogger("horovod_tpu.faultline")
 
 #: The valid injection sites (parse errors name this list).
 SITES = ("kv.get", "kv.set", "kv.try_get", "hb.beat",
-         "engine.submit", "engine.exec", "ckpt.write")
+         "engine.submit", "engine.exec", "engine.pool", "ckpt.write")
 
 _MODES = {
     "kv.get": ("delay", "error"),
@@ -76,6 +79,7 @@ _MODES = {
     "hb.beat": ("skip", "freeze", "vanish"),
     "engine.submit": ("fail",),
     "engine.exec": ("stall", "poison", "error"),
+    "engine.pool": ("exhausted",),
     "ckpt.write": ("torn",),
 }
 
@@ -385,6 +389,15 @@ def engine_exec(op: str) -> Optional[Fault]:
     if f.mode == "error":
         raise FaultInjected(f.describe() + f" op={op}")
     return f  # poison: the executor NaN-fills its result
+
+
+def pool_exhausted() -> bool:
+    """engine.pool site: True = this checkout must behave as if the
+    pool's resident cap were reached (fresh allocation, counted as a
+    miss, nothing retained) — the degradation rung below OOM that the
+    allocation-regression tier exercises on demand."""
+    f = check("engine.pool")
+    return f is not None and f.mode == "exhausted"
 
 
 def ckpt_write() -> Optional[Fault]:

@@ -89,6 +89,10 @@ class HvdRequest(ctypes.Structure):
         ("prescale", ctypes.c_double),
         ("names", ctypes.c_char_p),
         ("data", ctypes.c_void_p),
+        # Where same-size results must be written: == data unless the
+        # input was DONATED (caller-owned, read-only to the engine), in
+        # which case the engine supplies a pooled bounce buffer.
+        ("out", ctypes.c_void_p),
         ("count", ctypes.c_longlong),
         ("ndim", ctypes.c_int),
         ("shape", ctypes.c_longlong * 8),
@@ -129,6 +133,12 @@ class HvdStats(ctypes.Structure):
         ("queue_depth", ctypes.c_longlong),
         ("wire_bytes", ctypes.c_longlong),
         ("wire_bytes_compressed", ctypes.c_longlong),
+        # Buffer-pool accounting (hvdcore BufferPool — fed into the same
+        # engine.pool.* telemetry the python pool feeds).
+        ("pool_hits", ctypes.c_longlong),
+        ("pool_misses", ctypes.c_longlong),
+        ("pool_checkouts", ctypes.c_longlong),
+        ("pool_bytes_resident", ctypes.c_longlong),
     ]
 
 
@@ -172,7 +182,7 @@ def load_library():
         ctypes.c_void_p, ctypes.c_int, ctypes.c_char_p, ctypes.c_int,
         ctypes.c_int, ctypes.c_void_p, ctypes.POINTER(ctypes.c_longlong),
         ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_double,
-        ctypes.c_int, ctypes.c_char_p]
+        ctypes.c_int, ctypes.c_int, ctypes.c_char_p]
     lib.hvd_engine_poll.restype = ctypes.c_int
     lib.hvd_engine_poll.argtypes = [ctypes.c_void_p, ctypes.c_longlong]
     lib.hvd_engine_wait_meta.restype = ctypes.c_int

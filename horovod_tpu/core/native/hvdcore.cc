@@ -77,6 +77,10 @@ struct hvd_request {
   double prescale;
   const char* names;  // ';'-joined tensor names of the fused batch
   void* data;         // fused input buffer
+  // Where same-size results must be written. Usually == data (in-place,
+  // the historical contract); differs for DONATED single entries, whose
+  // caller-owned input buffer the engine may only read.
+  void* out;
   long long count;    // elements in data
   // For non-fusable ops the original shape rides along:
   int ndim;
@@ -85,10 +89,11 @@ struct hvd_request {
 
 struct hvd_result {
   // Callback contract: for same-size results (allreduce, broadcast) write
-  // in place and set data = req->data. For size-changing results
-  // (allgather) set data to a buffer from hvd_alloc(); the engine frees it
-  // after copying out. Anything else would dangle once the Python callback
-  // frame drops its references.
+  // into req->out (== req->data unless the input was donated) and set
+  // data = req->out. For size-changing results (allgather) set data to a
+  // buffer from hvd_alloc(); the engine frees it after copying out.
+  // Anything else would dangle once the Python callback frame drops its
+  // references.
   void* data;
   long long nbytes;
   int ndim;
@@ -146,6 +151,13 @@ struct hvd_engine_stats {
   long long queue_depth;    // in-flight tensors right now
   long long wire_bytes;     // bytes the mesh collectives shipped
   long long wire_bytes_compressed;  // subset under a quantized policy
+  // Buffer-pool accounting (entry snapshots, fusion buffers, result
+  // buffers — hvdcore's twin of core/bufferpool.py, feeding the same
+  // engine.pool.* telemetry through the Python stats sync).
+  long long pool_hits;
+  long long pool_misses;
+  long long pool_checkouts;
+  long long pool_bytes_resident;
 };
 
 void* hvd_alloc(long long nbytes) { return malloc((size_t)nbytes); }
@@ -230,8 +242,9 @@ class Timeline {
                const std::string& args = "") {
     Emit(name, phase, 'B', args, ts_us);
   }
-  void EndAt(const std::string& name, const char* phase, long long ts_us) {
-    Emit(name, phase, 'E', "", ts_us);
+  void EndAt(const std::string& name, const char* phase, long long ts_us,
+             const std::string& args = "") {
+    Emit(name, phase, 'E', args, ts_us);
   }
 
   // Always the real clock, file or no file (a timeline enabled mid-run
@@ -409,6 +422,119 @@ std::string TensorArgs(int dtype_num, const std::vector<long long>& shape,
 }
 
 // ---------------------------------------------------------------------------
+// Buffer pool (the reference's PersistentBuffer seat, SURVEY C8 — C++
+// twin of core/bufferpool.py: entry snapshots, fusion buffers and result
+// buffers ride reused slabs so steady-state cycles allocate nothing)
+// ---------------------------------------------------------------------------
+
+class BufferPool {
+ public:
+  BufferPool() {
+    const char* v = getenv("HVD_POOL_MAX_BYTES");
+    max_bytes_ = v ? atoll(v) : (1LL << 30);
+  }
+
+  // Power-of-two size class, floored at 4 KiB (matches the python pool:
+  // exact-class reuse keeps the steady state predictable and a tiny
+  // request can never steal a huge slab).
+  static size_t ClassOf(long long nbytes) {
+    size_t cls = 4096;
+    while ((long long)cls < nbytes) cls <<= 1;
+    return cls;
+  }
+
+  // `tracked` (optional) reports whether the buffer is actually served
+  // by the pool (hit, or a miss the pool will retain) — the honest
+  // value of the trace spans' "pooled" arg: with pooling disabled or
+  // past the resident cap, copies must attribute as plain.
+  std::vector<char> Get(long long nbytes, bool* tracked = nullptr) {
+    size_t cls = ClassOf(nbytes);
+    std::lock_guard<std::mutex> g(mu_);
+    checkouts_++;
+    if (max_bytes_ > 0) {
+      auto it = free_.find(cls);
+      if (it != free_.end() && !it->second.empty()) {
+        std::vector<char> v = std::move(it->second.back());
+        it->second.pop_back();
+        hits_++;
+        v.resize((size_t)nbytes);
+        if (tracked) *tracked = true;
+        return v;
+      }
+    }
+    misses_++;
+    std::vector<char> v;
+    if (max_bytes_ <= 0) {
+      // Pooling disabled: a plain allocation of EXACTLY nbytes (class
+      // rounding here would make the documented unpooled baseline pay
+      // up to 2x host memory per in-flight tensor). Put() ignores it.
+      v.resize((size_t)nbytes);
+      if (tracked) *tracked = false;
+      return v;
+    }
+    v.reserve(cls);
+    v.resize((size_t)nbytes);
+    // Account by the same floor-class Put() uses (reserve may
+    // over-allocate past `cls`): Get/Put adjustments then cancel
+    // exactly and resident_ cannot drift.
+    bool retain = resident_ < max_bytes_;
+    resident_ += (long long)FloorClass(v.capacity());
+    if (tracked) *tracked = retain;
+    return v;
+  }
+
+  // Largest power-of-two class (>= 4 KiB) a capacity covers.
+  static size_t FloorClass(size_t capacity) {
+    size_t cls = 4096;
+    while ((cls << 1) <= capacity) cls <<= 1;
+    return cls;
+  }
+
+  void Put(std::vector<char>&& v) {
+    if (v.capacity() < 4096) return;  // sub-class slab: not pool-tracked
+    // Bucket by the largest class the capacity COVERS (reserve may
+    // over-allocate): every slab in bucket k then has capacity >= k, so
+    // a Get hit's resize can never reallocate.
+    size_t cls = FloorClass(v.capacity());
+    std::lock_guard<std::mutex> g(mu_);
+    if (max_bytes_ <= 0) return;  // pooling disabled: nothing tracked
+    if (resident_ > max_bytes_) {
+      // Over the resident cap: let this slab die.
+      resident_ -= (long long)cls;
+      if (resident_ < 0) resident_ = 0;
+      return;
+    }
+    free_[cls].push_back(std::move(v));
+  }
+
+  bool Enabled() const { return max_bytes_ > 0; }
+
+  // Pre-rendered span-args body for copy spans, from Get()'s `tracked`
+  // result: pooled only when the buffer was actually served by the
+  // pool, so the pooled-vs-plain trace A/B stays honest under
+  // HVD_POOL_MAX_BYTES=0, a blown cap, or the exhausted fault site.
+  static const char* PooledArgs(bool tracked) {
+    return tracked ? "\"pooled\": true" : "\"pooled\": false";
+  }
+
+  void Stats(long long* hits, long long* misses, long long* checkouts,
+             long long* resident) {
+    std::lock_guard<std::mutex> g(mu_);
+    *hits = hits_;
+    *misses = misses_;
+    *checkouts = checkouts_;
+    *resident = resident_ > 0 ? resident_ : 0;
+  }
+
+ private:
+  std::mutex mu_;
+  std::map<size_t, std::vector<std::vector<char>>> free_;
+  long long max_bytes_ = 0;
+  long long resident_ = 0;  // bytes in pool-tracked slabs (free + lent)
+  long long hits_ = 0, misses_ = 0, checkouts_ = 0;
+};
+
+// ---------------------------------------------------------------------------
 // Engine
 // ---------------------------------------------------------------------------
 
@@ -422,23 +548,40 @@ struct Entry {
   int root_rank;
   int wire;  // engine wire policy code (hvd_request.wire)
   double prescale;
+  // Non-donated submits snapshot into a pool-checked-out slab (`data`,
+  // returned to the pool at completion); donated submits reference the
+  // caller's buffer in place (`ext`, READ-ONLY for the engine — the
+  // Python binding keeps the buffer alive until the handle retires).
   std::vector<char> data;
+  const char* ext = nullptr;
+  long long nbytes = 0;
   std::vector<long long> shape;
   Clock::time_point enqueued;
+
+  const char* bytes() const { return ext ? ext : data.data(); }
 };
 
 struct HandleState {
   bool done = false;
   std::string error;
+  // Pool-checked-out result buffer; the destructor (last reference —
+  // after CopyResult/Drop retired the handle and every waiter left
+  // WaitMeta) returns it to the pool, which the shared_ptr keeps alive.
   std::vector<char> result;
   std::vector<long long> shape;
+  std::shared_ptr<BufferPool> pool;
+
+  ~HandleState() {
+    if (pool) pool->Put(std::move(result));
+  }
 };
 
 class Engine {
  public:
   Engine(double cycle_s, long long fusion_bytes, double stall_s,
          const char* timeline_path)
-      : cycle_s_(cycle_s), fusion_bytes_(fusion_bytes), stall_s_(stall_s) {
+      : cycle_s_(cycle_s), fusion_bytes_(fusion_bytes), stall_s_(stall_s),
+        pool_(std::make_shared<BufferPool>()) {
     if (timeline_path && timeline_path[0]) timeline_.Initialize(timeline_path);
     loop_ = std::thread(&Engine::Loop, this);
     watchdog_ = std::thread(&Engine::Watchdog, this);
@@ -501,7 +644,7 @@ class Engine {
   long long Enqueue(int op, const char* name, int dtype_num, int itemsize,
                     const void* data, const long long* shape, int ndim,
                     int average, int root_rank, double prescale, int wire,
-                    char* err) {
+                    int donate, char* err) {
     std::unique_lock<std::mutex> lk(mu_);
     if (shutdown_) {
       snprintf(err, 256, "Horovod engine has been shut down");
@@ -528,16 +671,39 @@ class Engine {
     e.prescale = prescale;
     long long count = 1;
     for (int i = 0; i < ndim; ++i) count *= shape[i];
-    e.data.resize((size_t)(count * itemsize));
-    memcpy(e.data.data(), data, e.data.size());
+    e.nbytes = count * itemsize;
+    // Submit-time snapshot as a MEMCPY span at the head of QUEUE; the
+    // END args carry the zero-copy attribution (pooled slab copy vs
+    // donated ownership handoff that skipped the copy entirely).
+    long long t0 = timeline_.NowUs();
+    const char* mem_args;
+    if (donate) {
+      // Ownership handoff: reference the caller's buffer in place (the
+      // Python binding pins it until the handle retires); the engine
+      // only READS it — results land in pool buffers.
+      e.ext = (const char*)data;
+      mem_args = "\"donated\": true";
+    } else {
+      bool tracked = false;
+      e.data = pool_->Get(e.nbytes, &tracked);
+      memcpy(e.data.data(), data, (size_t)e.nbytes);
+      mem_args = BufferPool::PooledArgs(tracked);
+    }
     e.shape.assign(shape, shape + ndim);
     e.enqueued = Clock::now();
     pending_names_[e.name] = e.enqueued;
     if (op >= 0 && op < 3) stats_.submitted[op]++;
-    stats_.submitted_bytes += (long long)e.data.size();
-    handles_[e.handle] = std::make_shared<HandleState>();
+    stats_.submitted_bytes += e.nbytes;
+    auto hs = std::make_shared<HandleState>();
+    hs->pool = pool_;
+    handles_[e.handle] = std::move(hs);
     long long h = e.handle;
-    timeline_.Begin(e.name, "QUEUE");  // ring records even with no file
+    // Args ride the END only (the python twin's shape — the trace CLI
+    // reads zero-copy attribution off span ends, like NEGOTIATE's
+    // `cached`).
+    timeline_.BeginAt(e.name, "QUEUE", t0);  // ring records w/o file too
+    timeline_.BeginAt(e.name, "MEMCPY", t0);
+    timeline_.EndAt(e.name, "MEMCPY", timeline_.NowUs(), mem_args);
     queue_.push_back(std::move(e));
     lk.unlock();
     cv_.notify_all();
@@ -601,9 +767,13 @@ class Engine {
   }
 
   void GetStats(hvd_engine_stats* out) {
-    std::lock_guard<std::mutex> g(mu_);
-    *out = stats_;
-    out->queue_depth = (long long)pending_names_.size();
+    {
+      std::lock_guard<std::mutex> g(mu_);
+      *out = stats_;
+      out->queue_depth = (long long)pending_names_.size();
+    }
+    pool_->Stats(&out->pool_hits, &out->pool_misses, &out->pool_checkouts,
+                 &out->pool_bytes_resident);
   }
 
   void Shutdown() {
@@ -751,7 +921,7 @@ class Engine {
       table += ",\"p\":";
       table += pbuf;
       table += ",\"t\":" + std::to_string(SecondsSince(e.enqueued));
-      table += ",\"b\":" + std::to_string((long long)e.data.size());
+      table += ",\"b\":" + std::to_string(e.nbytes);
       table += ",\"w\":" + std::to_string(e.wire) + "}";
     }
     table += "]";
@@ -852,7 +1022,7 @@ class Engine {
                    msg.empty() ? "mismatched collective" : msg.c_str());
         continue;
       }
-      for (auto* e : group) executed_bytes += (long long)e->data.size();
+      for (auto* e : group) executed_bytes += e->nbytes;
       if (group[0]->op == HVD_ALLREDUCE) {
         ExecAllreduceBatch(group);
       } else {
@@ -894,7 +1064,7 @@ class Engine {
       fuse_bytes = 0;
     };
     for (auto& e : entries) {
-      cycle_bytes += (long long)e.data.size();
+      cycle_bytes += e.nbytes;
       if (e.op == HVD_ALLREDUCE) {
         bool compatible =
             fuse.empty() ||
@@ -902,10 +1072,10 @@ class Engine {
              fuse[0]->average == e.average &&
              fuse[0]->prescale == e.prescale &&
              fuse[0]->wire == e.wire &&
-             fuse_bytes + (long long)e.data.size() <= fusion_limit);
+             fuse_bytes + e.nbytes <= fusion_limit);
         if (!compatible) flush();
         fuse.push_back(&e);
-        fuse_bytes += (long long)e.data.size();
+        fuse_bytes += e.nbytes;
       } else {
         flush();
         ExecSingle(e);
@@ -950,7 +1120,7 @@ class Engine {
     for (auto* e : batch) {
       if (!names.empty()) names += ';';
       names += e->name;
-      total += (long long)e->data.size() / itemsize;
+      total += e->nbytes / itemsize;
     }
     if (batch.size() > 1) {
       std::lock_guard<std::mutex> g(mu_);
@@ -958,17 +1128,34 @@ class Engine {
       stats_.fused_tensors += (long long)batch.size();
       stats_.fused_bytes += total * itemsize;
     }
-    std::vector<char> fused((size_t)(total * itemsize));
-    long long off = 0;
-    for (auto* e : batch) {
-      if (batch.size() > 1)
-        timeline_.Begin(e->name, "MEMCPY_IN_FUSION_BUFFER");
-      memcpy(fused.data() + off, e->data.data(), e->data.size());
-      off += (long long)e->data.size();
-      if (batch.size() > 1)
-        timeline_.End(e->name, "MEMCPY_IN_FUSION_BUFFER");
-    }
+    // Fusion buffer from the pool, reused across cycles (the reference's
+    // persistent fusion buffer, operations.cc:2035-2074). A batch of ONE
+    // skips the copy entirely: the entry's own buffer is the request
+    // buffer (with a pooled bounce output when the input was donated —
+    // donated buffers are read-only to the engine).
+    std::vector<char> fused, bounce;
     hvd_request req{};
+    if (batch.size() > 1) {
+      bool tracked = false;
+      fused = pool_->Get(total * itemsize, &tracked);
+      long long off = 0;
+      for (auto* e : batch) {
+        timeline_.Begin(e->name, "MEMCPY_IN_FUSION_BUFFER");
+        memcpy(fused.data() + off, e->bytes(), (size_t)e->nbytes);
+        off += e->nbytes;
+        timeline_.End(e->name, "MEMCPY_IN_FUSION_BUFFER",
+                      BufferPool::PooledArgs(tracked));
+      }
+      req.data = fused.data();
+      req.out = fused.data();
+    } else if (batch[0]->ext) {
+      bounce = pool_->Get(batch[0]->nbytes);
+      req.data = (void*)batch[0]->ext;
+      req.out = bounce.data();
+    } else {
+      req.data = batch[0]->data.data();
+      req.out = batch[0]->data.data();
+    }
     req.op = HVD_ALLREDUCE;
     req.dtype_num = batch[0]->dtype_num;
     req.itemsize = itemsize;
@@ -976,7 +1163,6 @@ class Engine {
     req.wire = batch[0]->wire;  // batch is policy-uniform (fusion key)
     req.prescale = batch[0]->prescale;
     req.names = names.c_str();
-    req.data = fused.data();
     req.count = total;
     req.ndim = 1;
     req.shape[0] = total;
@@ -1006,24 +1192,37 @@ class Engine {
         timeline_.EndAt(e->name, "ALLREDUCE", t1);
       }
     }
+    // Stage every result (copies out of the fused buffer), retire the
+    // cycle's pool buffers, THEN wake the waiters — see Stage/Notify.
+    std::vector<std::shared_ptr<HandleState>> staged;
+    staged.reserve(batch.size());
     if (rc != 0) {
-      for (auto* e : batch) Complete(*e, nullptr, 0, nullptr, res.error);
-      return;
-    }
-    if (res.nbytes != total * itemsize) {
       for (auto* e : batch)
-        Complete(*e, nullptr, 0, nullptr,
-                 "executor returned wrong allreduce size");
-      return;
+        staged.push_back(Stage(*e, nullptr, 0, nullptr, res.error));
+    } else if (res.nbytes != total * itemsize) {
+      for (auto* e : batch)
+        staged.push_back(Stage(*e, nullptr, 0, nullptr,
+                               "executor returned wrong allreduce size"));
+    } else {
+      long long roff = 0;
+      for (auto* e : batch) {
+        staged.push_back(Stage(
+            *e, (char*)res.data + roff, e->nbytes, &e->shape, nullptr,
+            batch.size() > 1 ? "MEMCPY_OUT_FUSION_BUFFER" : nullptr));
+        roff += e->nbytes;
+      }
+      if (res.data && res.data != req.data && res.data != req.out)
+        free(res.data);
     }
-    off = 0;
-    for (auto* e : batch) {
-      Complete(*e, (char*)res.data + off, (long long)e->data.size(),
-               &e->shape, nullptr,
-               batch.size() > 1 ? "MEMCPY_OUT_FUSION_BUFFER" : nullptr);
-      off += (long long)e->data.size();
-    }
-    if (res.data && res.data != req.data) free(res.data);
+    RetireBuffers(fused, bounce);
+    for (auto& hs : staged) Notify(hs);
+  }
+
+  // Return cycle-scoped pool buffers (fusion / donated-input bounce)
+  // after every Complete copied out of them.
+  void RetireBuffers(std::vector<char>& fused, std::vector<char>& bounce) {
+    if (fused.capacity()) pool_->Put(std::move(fused));
+    if (bounce.capacity()) pool_->Put(std::move(bounce));
   }
 
   void ExecSingle(Entry& e) {
@@ -1036,8 +1235,19 @@ class Engine {
     req.wire = e.wire;
     req.prescale = e.prescale;
     req.names = e.name.c_str();
-    req.data = e.data.data();
-    req.count = (long long)e.data.size() / e.itemsize;
+    std::vector<char> bounce;
+    req.data = (void*)e.bytes();
+    if (e.ext && e.op != HVD_ALLGATHER) {
+      // Donated input is read-only to the engine: same-size results
+      // (broadcast) land in a pooled bounce buffer instead. Allgather
+      // results always come back in the callback's own hvd_alloc()
+      // buffer — no bounce needed.
+      bounce = pool_->Get(e.nbytes);
+      req.out = bounce.data();
+    } else {
+      req.out = req.data;
+    }
+    req.count = e.nbytes / e.itemsize;
     req.ndim = (int)e.shape.size();
     for (size_t i = 0; i < e.shape.size() && i < 8; ++i)
       req.shape[i] = e.shape[i];
@@ -1059,21 +1269,34 @@ class Engine {
       timeline_.BeginAt(e.name, phase, split, TensorArgs(e.dtype_num, e.shape));
       timeline_.EndAt(e.name, phase, t1);
     }
+    std::shared_ptr<HandleState> hs;
     if (rc != 0) {
-      Complete(e, nullptr, 0, nullptr, res.error);
-      return;
+      hs = Stage(e, nullptr, 0, nullptr, res.error);
+    } else {
+      std::vector<long long> shape(res.shape, res.shape + res.ndim);
+      hs = Stage(e, (char*)res.data, res.nbytes, &shape, nullptr);
+      if (res.data && res.data != req.data && res.data != req.out)
+        free(res.data);
     }
-    std::vector<long long> shape(res.shape, res.shape + res.ndim);
-    Complete(e, (char*)res.data, res.nbytes, &shape, nullptr);
-    if (res.data && res.data != req.data) free(res.data);
+    if (bounce.capacity()) pool_->Put(std::move(bounce));
+    Notify(hs);
   }
 
   // `copy_phase` (e.g. MEMCPY_OUT_FUSION_BUFFER) wraps just the result
   // copy-out so the span nests inside the still-open QUEUE span
   // (reference: out-copy spans, operations.cc:1359-1374).
-  void Complete(Entry& e, const char* data, long long nbytes,
-                const std::vector<long long>* shape, const char* error,
-                const char* copy_phase = nullptr) {
+  //
+  // Completion is split in two so every cycle-scoped pool buffer can
+  // retire BEFORE any waiter wakes: Stage() lands the result/error in
+  // the handle and returns the entry's snapshot slab to the pool;
+  // Notify() flips `done`. A caller woken in between would race the
+  // loop thread for the very slabs its last cycle used (entry
+  // snapshots, the fused buffer) and turn the steady state into misses.
+  std::shared_ptr<HandleState> Stage(Entry& e, const char* data,
+                                     long long nbytes,
+                                     const std::vector<long long>* shape,
+                                     const char* error,
+                                     const char* copy_phase = nullptr) {
     std::shared_ptr<HandleState> hs;
     {
       std::lock_guard<std::mutex> g(mu_);
@@ -1082,24 +1305,45 @@ class Engine {
       // counts every completion the same way).
       if (error) stats_.errors++; else stats_.completed++;
       auto it = handles_.find(e.handle);
-      if (it == handles_.end()) return;
-      hs = it->second;
+      if (it != handles_.end()) hs = it->second;
     }
-    if (error) {
-      hs->error = error;
-    } else {
-      bool trace_copy = copy_phase != nullptr;
-      if (trace_copy) timeline_.Begin(e.name, copy_phase);
-      hs->result.assign(data, data + nbytes);
-      if (shape) hs->shape = *shape;
-      if (trace_copy) timeline_.End(e.name, copy_phase);
+    if (hs != nullptr) {
+      if (error) {
+        hs->error = error;
+      } else {
+        bool trace_copy = copy_phase != nullptr;
+        if (trace_copy) timeline_.Begin(e.name, copy_phase);
+        // Result buffer from the pool (returned by ~HandleState once the
+        // handle retires and the last waiter leaves).
+        bool tracked = false;
+        hs->result = pool_->Get(nbytes, &tracked);
+        memcpy(hs->result.data(), data, (size_t)nbytes);
+        if (shape) hs->shape = *shape;
+        if (trace_copy)
+          timeline_.End(e.name, copy_phase,
+                        BufferPool::PooledArgs(tracked));
+      }
+      timeline_.End(e.name, "QUEUE");
     }
-    timeline_.End(e.name, "QUEUE");
+    // Retire the entry's snapshot slab (donated buffers are caller-owned
+    // and stay untouched).
+    if (!e.ext && e.data.capacity()) pool_->Put(std::move(e.data));
+    return hs;
+  }
+
+  void Notify(const std::shared_ptr<HandleState>& hs) {
+    if (hs == nullptr) return;
     {
       std::lock_guard<std::mutex> g(mu_);
       hs->done = true;
     }
     cv_done_.notify_all();
+  }
+
+  void Complete(Entry& e, const char* data, long long nbytes,
+                const std::vector<long long>* shape, const char* error,
+                const char* copy_phase = nullptr) {
+    Notify(Stage(e, data, nbytes, shape, error, copy_phase));
   }
 
   // Reference: CheckForStalledTensors warns every 60 s about tensors stuck
@@ -1147,6 +1391,9 @@ class Engine {
   long long fusion_bytes_;
   double stall_s_;
   Timeline timeline_;
+  // shared_ptr: HandleStates return their result buffers on destruction,
+  // which may outlive a destroyed Engine (a straggling WaitMeta caller).
+  std::shared_ptr<BufferPool> pool_;
 
   std::mutex mu_;
   std::condition_variable cv_, cv_done_;
@@ -1212,10 +1459,10 @@ long long hvd_engine_enqueue(void* e, int op, const char* name, int dtype_num,
                              int itemsize, const void* data,
                              const long long* shape, int ndim, int average,
                              int root_rank, double prescale, int wire,
-                             char* err) {
+                             int donate, char* err) {
   return static_cast<Engine*>(e)->Enqueue(op, name, dtype_num, itemsize, data,
                                           shape, ndim, average, root_rank,
-                                          prescale, wire, err);
+                                          prescale, wire, donate, err);
 }
 
 int hvd_engine_poll(void* e, long long handle) {

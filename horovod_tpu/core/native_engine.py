@@ -21,8 +21,8 @@ from typing import List, Optional
 
 import numpy as np
 
-from horovod_tpu.core import faultline as flt, native, numerics as numx, \
-    telemetry as tele, timeline as tl
+from horovod_tpu.core import bufferpool as bpool, faultline as flt, \
+    native, numerics as numx, telemetry as tele, timeline as tl
 from horovod_tpu.core.engine import (
     STALL_WARNING_TIME_S,
     WIRE_CODES,
@@ -31,6 +31,7 @@ from horovod_tpu.core.engine import (
     EngineError,
     JaxExecutor,
     ShutdownError,
+    _freeze_donated,
     _multi_controller,
     _negotiated,
     config_from_env,
@@ -186,9 +187,20 @@ def _make_callback(executor):
                 return 0
             dtype = _DTYPES[req.dtype_num]
             nbytes = int(req.count) * int(req.itemsize)
+            # Zero-copy view of the engine's buffer: the C++ loop thread
+            # is blocked inside this callback for its whole duration, so
+            # the pointer is stable and a defensive copy (one full pass
+            # over every payload, removed with the buffer pool) would
+            # buy nothing. Flagged read-only — executors only READ their
+            # input; results go to separate (pooled) buffers.
             buf = np.frombuffer(
                 (ctypes.c_char * nbytes).from_address(req.data),
-                dtype=dtype).copy()
+                dtype=dtype)
+            buf.flags.writeable = False
+            # Same-size results land at req.out: == req.data (in place)
+            # unless the input was donated, where the engine supplied a
+            # pooled bounce buffer instead.
+            dst = req.out if req.out else req.data
             executor.last_stage_s = 0.0
             executor.last_wire_bytes = 0
             executor.last_wire_compressed = 0
@@ -202,8 +214,8 @@ def _make_callback(executor):
                 executor.wire_policy = WIRE_NAMES.get(req.wire, "none")
                 out = executor.allreduce(buf, bool(req.average))
                 out = np.ascontiguousarray(out, dtype=dtype)
-                ctypes.memmove(req.data, out.ctypes.data, nbytes)
-                res.data, res.nbytes = req.data, nbytes
+                ctypes.memmove(dst, out.ctypes.data, nbytes)
+                res.data, res.nbytes = dst, nbytes
                 res.ndim, res.shape[0] = 1, req.count
             elif req.op == 1:  # allgather: output is bigger — C-owned buf
                 shape = tuple(req.shape[i] for i in range(req.ndim))
@@ -221,8 +233,8 @@ def _make_callback(executor):
                 shape = tuple(req.shape[i] for i in range(req.ndim))
                 out = executor.broadcast(buf.reshape(shape), int(req.root_rank))
                 out = np.ascontiguousarray(out, dtype=dtype)
-                ctypes.memmove(req.data, out.ctypes.data, nbytes)
-                res.data, res.nbytes = req.data, nbytes
+                ctypes.memmove(dst, out.ctypes.data, nbytes)
+                res.data, res.nbytes = dst, nbytes
                 res.ndim = out.ndim
                 for i, s in enumerate(out.shape):
                     res.shape[i] = s
@@ -262,6 +274,18 @@ class NativeEngine:
 
         self._lib = native.load_library()
         self._executor = executor or JaxExecutor()
+        # Python-side buffer pool: executor output/staging buffers and
+        # synchronize() result buffers (the C++ loop keeps its own twin
+        # inside libhvdcore for entry/fusion/result buffers; both feed
+        # the same engine.pool.* counters — the C++ side through the
+        # stats sync below).
+        self._pool = bpool.BufferPool(own_gauge=False)
+        if getattr(self._executor, "pool", None) is None:
+            self._executor.pool = self._pool
+        # Donated submit buffers, pinned until their handle retires: the
+        # C++ entry references them in place (read-only), so Python must
+        # keep them alive until completion.
+        self._donated: dict = {}
         # Engine-wide default wire format (HVD_COMPRESSION) — same rule
         # and fail-fast as the python twin.
         self.wire_default = wire_policy_from_env()
@@ -336,6 +360,11 @@ class NativeEngine:
         ("engine.cycle_seconds_total", "cycle_seconds"),
         ("engine.wire_bytes", "wire_bytes"),
         ("engine.wire_bytes.compressed", "wire_bytes_compressed"),
+        # The C++ pool's events fold into the SAME counters the python
+        # pool feeds (core/bufferpool.py).
+        ("engine.pool.hits", "pool_hits"),
+        ("engine.pool.misses", "pool_misses"),
+        ("engine.pool.checkouts", "pool_checkouts"),
     )
 
     def _collect_stats(self):
@@ -356,6 +385,10 @@ class NativeEngine:
                     self._last_stats[field] = value
             tele.REGISTRY.gauge("engine.queue_depth").set(
                 int(st.queue_depth))
+            # Resident bytes is a gauge: C++ pool + this engine's python
+            # pool together (one data plane, one occupancy number).
+            tele.REGISTRY.gauge("engine.pool.bytes_resident").set(
+                int(st.pool_bytes_resident) + self._pool.bytes_resident)
 
     def _emit_clock_meta(self, offset_us: Optional[int],
                          rtt_us: Optional[int]):
@@ -466,7 +499,8 @@ class NativeEngine:
     def _enqueue(self, op: str, name: str, tensor: np.ndarray,
                  average: bool = False, root_rank: int = 0,
                  prescale: float = 1.0,
-                 compression: Optional[str] = None) -> int:
+                 compression: Optional[str] = None,
+                 donate: bool = False) -> int:
         # Fault site engine.submit (core/faultline.py) — in the python
         # shim, BEFORE the C++ enqueue, so both engines fail a submit at
         # the same point with the same observable shape.
@@ -475,7 +509,10 @@ class NativeEngine:
             raise EngineError(injected)
         if self._ptr is None:
             raise ShutdownError("engine is shut down")
-        tensor = np.ascontiguousarray(tensor)
+        tensor = np.asarray(tensor)
+        donate = donate and tensor.flags["C_CONTIGUOUS"]
+        if not donate:
+            tensor = np.ascontiguousarray(tensor)
         if tensor.dtype not in _DTYPE_CODE:
             raise EngineError(f"unsupported dtype {tensor.dtype}")
         if tensor.ndim > 8:
@@ -489,18 +526,31 @@ class NativeEngine:
         else:
             wire = (resolve_wire_policy(compression)
                     if compression is not None else self.wire_default)
+        flipped = False
+        if donate:
+            # Ownership handoff: the C++ entry references this buffer in
+            # place (read-only — results go to pooled bounce buffers);
+            # flag the view unwriteable so an in-process mutation raises,
+            # and pin it until the handle retires.
+            flipped = _freeze_donated(tensor)
         err = ctypes.create_string_buffer(256)
         shape = (ctypes.c_longlong * max(tensor.ndim, 1))(*tensor.shape)
         h = self._lib.hvd_engine_enqueue(
             self._ptr, _OPS[op], name.encode(), _DTYPE_CODE[tensor.dtype],
             tensor.dtype.itemsize, tensor.ctypes.data, shape, tensor.ndim,
             int(average), int(root_rank), float(prescale),
-            int(WIRE_CODES[wire]), err)
+            int(WIRE_CODES[wire]), int(donate), err)
         if h < 0:
+            # Rejected submit: the engine never took ownership — a
+            # donated buffer we froze must become writable again.
+            if flipped:
+                tensor.flags.writeable = True
             msg = err.value.decode()
             if "already pending" in msg:
                 raise DuplicateNameError(msg)
             raise ShutdownError(msg)
+        if donate:
+            self._donated[int(h)] = tensor
         record_submit(op, tensor.nbytes,
                       int(self._lib.hvd_engine_pending(self._ptr)))
         # Numerics (core/numerics.py): local nonfinite at submit is the
@@ -512,21 +562,31 @@ class NativeEngine:
 
     def allreduce_async(self, name: str, tensor: np.ndarray, average: bool,
                         prescale: float = 1.0,
-                        compression: Optional[str] = None) -> int:
+                        compression: Optional[str] = None,
+                        donate: bool = False) -> int:
         return self._enqueue("allreduce", name, tensor, average=average,
-                             prescale=prescale, compression=compression)
+                             prescale=prescale, compression=compression,
+                             donate=donate)
 
-    def allgather_async(self, name: str, tensor: np.ndarray) -> int:
-        return self._enqueue("allgather", name, tensor)
+    def allgather_async(self, name: str, tensor: np.ndarray,
+                        donate: bool = False) -> int:
+        return self._enqueue("allgather", name, tensor, donate=donate)
 
     def broadcast_async(self, name: str, tensor: np.ndarray,
-                        root_rank: int) -> int:
-        return self._enqueue("broadcast", name, tensor, root_rank=root_rank)
+                        root_rank: int, donate: bool = False) -> int:
+        return self._enqueue("broadcast", name, tensor, root_rank=root_rank,
+                             donate=donate)
 
     def poll(self, handle: int) -> bool:
         st = self._lib.hvd_engine_poll(self._ptr, handle)
         if st < 0:
             raise EngineError(f"unknown handle {handle}")
+        if st:
+            # Completion reached: the C++ entry no longer references a
+            # donated buffer — release the pin here too, so poll-only
+            # callers don't hold donated memory until shutdown (the
+            # python twin drops its reference at completion).
+            self._donated.pop(handle, None)
         return bool(st)
 
     def synchronize(self, handle: int) -> np.ndarray:
@@ -541,13 +601,18 @@ class NativeEngine:
             raise EngineError(f"unknown handle {handle}")
         dtype, name = self._meta.pop(handle,
                                      (np.dtype(np.float32), ""))
+        # Completion reached: the C++ entry no longer references a
+        # donated buffer — release the pin.
+        self._donated.pop(handle, None)
         if rc == 1:
             self._lib.hvd_engine_drop(self._ptr, handle)
             msg = err.value.decode()
             if "shut down" in msg:
                 raise ShutdownError(msg)
             raise EngineError(msg)
-        out = np.empty(int(nbytes.value), np.uint8)
+        # Result buffer from the pool — recycled once the caller drops
+        # the returned view.
+        out = self._pool.checkout(int(nbytes.value), np.uint8)
         rc = self._lib.hvd_engine_copy_result(
             self._ptr, handle, out.ctypes.data, out.nbytes)
         if rc != 0:
@@ -619,6 +684,12 @@ class NativeEngine:
         if c is not None:
             c.dead = c.dead or "engine abandoned (elastic reconfiguration)"
             c._closed = True
+        # Pool hygiene: the parked C++ loop thread may still hold
+        # checked-out slabs (its own pool is engine-internal and parks
+        # with it); poison the python-side pool so nothing it lent can
+        # be handed out again. _donated is NOT cleared — the parked
+        # loop may still read those buffers forever.
+        self._pool.poison()
         ptr, self._ptr = self._ptr, None
         if ptr is not None:
             self._lib.hvd_engine_shutdown(ptr)  # signal only — no join
@@ -649,6 +720,8 @@ class NativeEngine:
         self._collect_stats()
         self._ptr = None
         self._meta.clear()
+        # Workers joined: no C++ reference to donated buffers remains.
+        self._donated.clear()
         # A later SIGUSR1 must dump a LIVE engine's ring, not this dead
         # one's — and the module-global handler state must not pin us.
         tl.uninstall_sigusr1(self._dump_flight)

@@ -34,6 +34,12 @@ from typing import Callable, List, Optional
 
 # Activity names (reference: operations.h:29-50).
 QUEUE = "QUEUE"
+# Submit-time snapshot copy (nested at the head of the QUEUE span; its
+# END args carry the zero-copy attribution: {"pooled": bool} for a
+# pool-slab copy, {"donated": true} for an ownership handoff that
+# skipped the copy entirely — utils/trace.py splits MEMCPY medians by
+# these the way NEGOTIATE is split by `cached`).
+MEMCPY = "MEMCPY"
 NEGOTIATE_ALLREDUCE = "NEGOTIATE_ALLREDUCE"
 NEGOTIATE_ALLGATHER = "NEGOTIATE_ALLGATHER"
 NEGOTIATE_BROADCAST = "NEGOTIATE_BROADCAST"

@@ -66,6 +66,8 @@ from horovod_tpu.jax.sharded import (  # noqa: F401
 )
 
 from horovod_tpu.common.compat import shard_map as _shard_map
+from horovod_tpu.jax import mpi_ops  # noqa: F401  — engine-path async
+# verbs (allreduce_async/synchronize/... with zero-copy donate=True)
 from horovod_tpu.core import numerics as _num
 from horovod_tpu.core import sentinel as _sentinel
 from horovod_tpu.core import telemetry as _tele

@@ -1,0 +1,107 @@
+"""JAX engine-path async verbs — the host/async twin of the compiled
+collectives, with zero-copy donation.
+
+The compiled hot path needs no engine (collectives compile into the
+step); this surface exists for host-side async callers that hold jax (or
+numpy) arrays — checkpoint shards, metric tensors, host-staged gradient
+buckets — the role the reference's framework adapters play over its C++
+core (torch/mpi_ops_v2.cc, tensorflow/mpi_ops.cc).
+
+Zero-copy by default where it is safe:
+
+- jax arrays convert through dlpack/``__array_interface__`` into a
+  read-only numpy view of the runtime buffer — no host copy.
+- ``donate=True`` hands that buffer to the engine outright: the submit
+  snapshot is skipped entirely and the engine references the buffer in
+  place until completion (reading only — results land in engine-pooled
+  buffers), which is always safe for jax arrays because they are
+  immutable. The caller must keep its reference semantics in mind: the
+  array's buffer is pinned until ``synchronize``.
+
+Without ``donate``, the engine snapshots into a pooled slab (see
+core/bufferpool.py) — mutate-after-submit still cannot change what gets
+reduced.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from horovod_tpu.core import get_engine
+
+_name_counter = 0
+
+
+def _auto_name(prefix: str, name: Optional[str]) -> str:
+    global _name_counter
+    if name is not None:
+        return name
+    _name_counter += 1
+    return f"jax.{prefix}.noname.{_name_counter}"
+
+
+def _np_of(tensor) -> np.ndarray:
+    """Zero-copy host view of a jax/numpy/dlpack tensor (read-only for
+    runtime-owned buffers; never a copy when the protocol allows)."""
+    if isinstance(tensor, np.ndarray):
+        return tensor
+    if hasattr(tensor, "__dlpack__"):
+        try:
+            return np.from_dlpack(tensor)
+        except Exception:
+            pass  # device-resident or an old numpy: fall through
+    return np.asarray(tensor)
+
+
+def allreduce_async(tensor, average: bool = True,
+                    name: Optional[str] = None,
+                    compression: Optional[str] = None,
+                    donate: bool = False) -> int:
+    """Enqueue an allreduce; returns a handle for :func:`synchronize`.
+    ``compression`` is the per-request engine wire policy ('int8'/'fp8');
+    ``donate=True`` skips the submit snapshot (ownership handoff)."""
+    return get_engine().allreduce_async(
+        _auto_name("allreduce", name), _np_of(tensor), average,
+        compression=compression, donate=donate)
+
+
+def allgather_async(tensor, name: Optional[str] = None,
+                    donate: bool = False) -> int:
+    return get_engine().allgather_async(
+        _auto_name("allgather", name), _np_of(tensor), donate=donate)
+
+
+def broadcast_async(tensor, root_rank: int, name: Optional[str] = None,
+                    donate: bool = False) -> int:
+    return get_engine().broadcast_async(
+        _auto_name("broadcast", name), _np_of(tensor), root_rank,
+        donate=donate)
+
+
+def poll(handle: int) -> bool:
+    return get_engine().poll(handle)
+
+
+def synchronize(handle: int) -> np.ndarray:
+    """Block until completion; returns the host result (a view of an
+    engine-pooled buffer — recycled once the caller drops it)."""
+    return get_engine().synchronize(handle)
+
+
+def allreduce(tensor, average: bool = True, name: Optional[str] = None,
+              compression: Optional[str] = None,
+              donate: bool = False) -> np.ndarray:
+    return synchronize(allreduce_async(tensor, average, name,
+                                       compression, donate))
+
+
+def allgather(tensor, name: Optional[str] = None,
+              donate: bool = False) -> np.ndarray:
+    return synchronize(allgather_async(tensor, name, donate))
+
+
+def broadcast(tensor, root_rank: int, name: Optional[str] = None,
+              donate: bool = False) -> np.ndarray:
+    return synchronize(broadcast_async(tensor, root_rank, name, donate))

@@ -229,20 +229,36 @@ def np_quantize(flat: np.ndarray, policy):
     """Host-side :func:`quantize` twin. Pads to a block multiple itself
     (engine chunks are pow2-bucketed, but defensive padding keeps any
     block size correct); returns (payload, scales, padded_len)."""
+    npad = padded_len(max(flat.shape[0], 1), policy.block)
+    payload = np.empty((npad,), np_wire_dtype(policy))
+    scales = np.empty((npad // policy.block,), np.float32)
+    np_quantize_into(flat, policy, payload, scales,
+                     np.empty((npad,), np.float32))
+    return payload, scales, npad
+
+
+def np_quantize_into(flat: np.ndarray, policy, payload: np.ndarray,
+                     scales: np.ndarray, work: np.ndarray):
+    """:func:`np_quantize` staged into caller-owned buffers — the engines
+    check ``payload``/``scales``/``work`` out of their buffer pool so the
+    steady-state wire staging allocates nothing (``work`` is an f32
+    scratch of ``payload``'s length; all three are 1-d, length/dtype
+    exact). The math is bit-identical to :func:`np_quantize` — rint
+    (ties to even) then clip then int cast — which is what keeps the
+    python/C++ engine reduction digests equal under a quantized policy."""
     n = flat.shape[0]
-    npad = padded_len(max(n, 1), policy.block)
-    x = np.zeros((npad,), np.float32)
-    x[:n] = np.asarray(flat, np.float32)
-    x = x.reshape(-1, policy.block)
+    npad = payload.shape[0]
+    work[:n] = np.asarray(flat, np.float32)
+    work[n:] = 0.0
+    x = work.reshape(-1, policy.block)
     amax = np.max(np.abs(x), axis=1)
-    scale = np.where(amax > 0, amax / policy.qmax, 1.0).astype(np.float32)
-    y = x / scale[:, None]
+    np.copyto(scales, np.where(amax > 0, amax / policy.qmax, 1.0),
+              casting="unsafe")
+    np.divide(x, scales.reshape(-1, 1), out=x)
     if policy.round_to_int:
-        payload = np.clip(np.rint(y), -policy.qmax, policy.qmax).astype(
-            np.int8)
-    else:
-        payload = y.astype(np_wire_dtype(policy))
-    return payload.reshape(npad), scale, npad
+        np.rint(x, out=x)
+        np.clip(x, -policy.qmax, policy.qmax, out=x)
+    np.copyto(payload, work[:npad], casting="unsafe")
 
 
 def np_dequantize_sum(payloads: np.ndarray, scales: np.ndarray,

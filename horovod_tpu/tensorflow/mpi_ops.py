@@ -40,17 +40,23 @@ def _np_collective(kind: str, t: np.ndarray, *, name: str,
     from horovod_tpu.core import engine as _eng
 
     e = _eng.get_engine()
+    # donate=True: the buffer is a py_function-scoped temporary (TF hands
+    # the body its own eager tensor, alive in this frame until the
+    # synchronize below returns, i.e. past completion), so the engine
+    # can reference it in place instead of snapshotting — the engine
+    # only READS donated buffers; results land in its pooled buffers.
     if kind == "allreduce":
         # The engine wire format is >=1-d; restore scalar shape after.
         # `wire` is the per-request engine wire policy ('int8'/'fp8').
         h = e.allreduce_async(name, np.atleast_1d(t), average,
-                              compression=wire)
+                              compression=wire, donate=True)
         return e.synchronize(h).reshape(np.shape(t))
     if kind == "allgather":
         # Scalars ride the >=1-d wire as one gathered row apiece.
-        return e.synchronize(e.allgather_async(name, np.atleast_1d(t)))
+        return e.synchronize(e.allgather_async(name, np.atleast_1d(t),
+                                               donate=True))
     if kind == "broadcast":
-        h = e.broadcast_async(name, np.atleast_1d(t), root)
+        h = e.broadcast_async(name, np.atleast_1d(t), root, donate=True)
         return e.synchronize(h).reshape(np.shape(t))
     raise ValueError(kind)
 
@@ -112,18 +118,37 @@ def _bridge_group(kind: str, tensors, names, *, average=False, root=0,
 
         e = _eng.get_engine()
         handles = []
+        # donate=True: each buffer lives in this frame (ts) until every
+        # synchronize below returned — past completion — so the engine
+        # may reference it in place and skip the submit snapshot (it
+        # only READS donated buffers).
         for k, name, t, w in zip(kinds, names, ts, wires):
             a = np.atleast_1d(np.asarray(t.numpy()))
             if k == "allreduce":
                 handles.append(e.allreduce_async(name, a, average,
-                                                 compression=w))
+                                                 compression=w,
+                                                 donate=True))
             elif k == "broadcast":
-                handles.append(e.broadcast_async(name, a, root))
+                handles.append(e.broadcast_async(name, a, root,
+                                                 donate=True))
             elif k == "allgather":
-                handles.append(e.allgather_async(name, a))
+                handles.append(e.allgather_async(name, a, donate=True))
             else:
                 raise ValueError(k)
-        outs = [e.synchronize(h) for h in handles]
+        # Drain EVERY handle even when one errors (then re-raise the
+        # first failure): an abandoned handle would orphan its donated
+        # buffer's pin on the native engine, and the group's remaining
+        # collectives must complete cross-rank regardless.
+        outs, first_err = [], None
+        for h in handles:
+            try:
+                outs.append(e.synchronize(h))
+            except Exception as exc:
+                if first_err is None:
+                    first_err = exc
+                outs.append(None)
+        if first_err is not None:
+            raise first_err
         # allgather legitimately changes the first dim; everything else
         # restores the submitted shape (scalars ride the >=1-d wire).
         return [o if k == "allgather" else o.reshape(np.shape(t))

@@ -109,14 +109,21 @@ def synchronize(handle: int) -> torch.Tensor:
 
 def allreduce_async(tensor: torch.Tensor, average: bool = True,
                     name: Optional[str] = None,
-                    compression: Optional[str] = None) -> int:
+                    compression: Optional[str] = None,
+                    donate: bool = False) -> int:
     # `compression` here is the per-request ENGINE wire-format name
     # ('int8'/'fp8' — a Compressor's .engine_wire); cast compressors are
     # applied by the caller around the collective as in the reference.
+    # `donate=True` hands the tensor's host buffer to the engine — the
+    # submit snapshot is skipped and the engine references it in place
+    # (read-only) until completion. The numpy side is flagged
+    # unwriteable, but torch can still write through its own reference:
+    # mutating a donated tensor before synchronize() is undefined
+    # behavior, the caller's promise to keep (see docs/running.md).
     out = torch.empty_like(tensor)
     h = get_engine().allreduce_async(
         _auto_name("allreduce", name), _np_of(tensor), average,
-        compression=compression
+        compression=compression, donate=donate
     )
     _register(h, tensor, out)
     return h
@@ -164,8 +171,10 @@ def allreduce_(tensor: torch.Tensor, average: bool = True,
 # allgather
 # ---------------------------------------------------------------------------
 
-def allgather_async(tensor: torch.Tensor, name: Optional[str] = None) -> int:
-    h = get_engine().allgather_async(_auto_name("allgather", name), _np_of(tensor))
+def allgather_async(tensor: torch.Tensor, name: Optional[str] = None,
+                    donate: bool = False) -> int:
+    h = get_engine().allgather_async(_auto_name("allgather", name),
+                                     _np_of(tensor), donate=donate)
     _register(h, tensor, None)
     return h
 
@@ -196,10 +205,12 @@ def allgather(tensor: torch.Tensor, name: Optional[str] = None) -> torch.Tensor:
 # ---------------------------------------------------------------------------
 
 def broadcast_async(tensor: torch.Tensor, root_rank: int,
-                    name: Optional[str] = None) -> int:
+                    name: Optional[str] = None,
+                    donate: bool = False) -> int:
     out = torch.empty_like(tensor)
     h = get_engine().broadcast_async(
-        _auto_name("broadcast", name), _np_of(tensor), root_rank
+        _auto_name("broadcast", name), _np_of(tensor), root_rank,
+        donate=donate
     )
     _register(h, tensor, out)
     return h

@@ -52,9 +52,14 @@ from typing import Dict, List, Optional, Tuple
 CLOCK_EVENT = "HVD_CLOCK"
 RANK_READY = "RANK_READY"
 _COLLECTIVES = ("ALLREDUCE", "ALLGATHER", "BROADCAST")
-# Phase display order for critical-path output.
-_PHASE_ORDER = ("NEGOTIATE", "MEMCPY_IN_FUSION_BUFFER", "WAIT_FOR_DATA",
-                "COLLECTIVE", "MEMCPY_OUT_FUSION_BUFFER", "OTHER")
+# Phase display order for critical-path output. MEMCPY is the submit-time
+# snapshot copy (nested at the head of QUEUE); its END args carry the
+# zero-copy attribution ({"pooled": bool} / {"donated": true}).
+_PHASE_ORDER = ("NEGOTIATE", "MEMCPY", "MEMCPY_IN_FUSION_BUFFER",
+                "WAIT_FOR_DATA", "COLLECTIVE", "MEMCPY_OUT_FUSION_BUFFER",
+                "OTHER")
+_MEMCPY_PHASES = ("MEMCPY", "MEMCPY_IN_FUSION_BUFFER",
+                  "MEMCPY_OUT_FUSION_BUFFER")
 
 _RANK_FILE_RE = re.compile(r"(?:timeline|hvd_flight)\.rank(\d+)[.\w]*\.json$")
 
@@ -432,6 +437,16 @@ def _negotiate_rounds(spans: Dict[Tuple[str, str], List[tuple]]
     return out
 
 
+def _span_stats(durs) -> dict:
+    """count / total µs / median µs of a span-duration list (shared by
+    the negotiate and memcpy attributions)."""
+    if not durs:
+        return {"count": 0, "us": 0, "median_us": None}
+    durs = sorted(durs)
+    return {"count": len(durs), "us": sum(durs),
+            "median_us": durs[len(durs) // 2]}
+
+
 def negotiate_attribution(span_dicts) -> dict:
     """Fast-vs-full attribution of negotiate time across ranks: counts,
     total µs and median µs of spans resolved by cached (bitvector)
@@ -443,15 +458,7 @@ def negotiate_attribution(span_dicts) -> dict:
             bucket = ("unknown" if cached is None
                       else "cached" if cached else "full")
             split[bucket].append(dur)
-
-    def stats(durs):
-        if not durs:
-            return {"count": 0, "us": 0, "median_us": None}
-        durs = sorted(durs)
-        return {"count": len(durs), "us": sum(durs),
-                "median_us": durs[len(durs) // 2]}
-
-    return {k: stats(v) for k, v in split.items()}
+    return {k: _span_stats(v) for k, v in split.items()}
 
 
 def _phase_of(activity: str) -> Optional[str]:
@@ -459,10 +466,32 @@ def _phase_of(activity: str) -> Optional[str]:
         return "NEGOTIATE"
     if activity in _COLLECTIVES:
         return "COLLECTIVE"
-    if activity in ("MEMCPY_IN_FUSION_BUFFER", "WAIT_FOR_DATA",
+    if activity in ("MEMCPY", "MEMCPY_IN_FUSION_BUFFER", "WAIT_FOR_DATA",
                     "MEMCPY_OUT_FUSION_BUFFER"):
         return activity
     return None
+
+
+def memcpy_attribution(span_dicts) -> dict:
+    """Zero-copy attribution of the MEMCPY* phases: counts, total µs and
+    median µs of copy spans split by how their submit/fusion copy was
+    served — ``donated`` (ownership handoff, no copy), ``pooled``
+    (pool-slab copy) or ``plain`` (fresh allocation / pre-pool traces).
+    Same one-pass span-dict input as :func:`negotiate_attribution`."""
+    split = {"donated": [], "pooled": [], "plain": []}
+    for spans in span_dicts:
+        for (tensor, act), sp in spans.items():
+            if act not in _MEMCPY_PHASES:
+                continue
+            for b, e, args in sp:
+                if args.get("donated"):
+                    bucket = "donated"
+                elif args.get("pooled"):
+                    bucket = "pooled"
+                else:
+                    bucket = "plain"
+                split[bucket].append(e - b)
+    return {k: _span_stats(v) for k, v in split.items()}
 
 
 def critical_path_data(target: str) -> dict:
@@ -506,7 +535,8 @@ def critical_path_data(target: str) -> dict:
     instances.sort(key=lambda i: -i["total_us"])
     return {"instances": len(instances), "phase_us": phase_us,
             "shares": shares, "slowest": instances[:5],
-            "negotiate": negotiate_attribution(span_dicts)}
+            "negotiate": negotiate_attribution(span_dicts),
+            "memcpy": memcpy_attribution(span_dicts)}
 
 
 def critical_path_report(target: str) -> str:
@@ -528,6 +558,16 @@ def critical_path_report(target: str) -> str:
                              f"median={s['median_us'] / 1e3:.2f} ms")
         lines.append("negotiate rounds (response cache): "
                      + " | ".join(parts))
+    mem = d.get("memcpy", {})
+    if any(mem.get(k, {}).get("count") for k in ("donated", "pooled")):
+        # Zero-copy attribution: how the copy phases were served.
+        parts = []
+        for k in ("donated", "pooled", "plain"):
+            s = mem.get(k, {"count": 0})
+            if s["count"]:
+                parts.append(f"{k} n={s['count']} "
+                             f"median={s['median_us'] / 1e3:.3f} ms")
+        lines.append("copy spans (buffer pool): " + " | ".join(parts))
     if d["slowest"]:
         lines.append("slowest instances (the critical path):")
         for inst in d["slowest"]:

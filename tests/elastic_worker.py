@@ -97,6 +97,51 @@ class ChaosAndLog(hk.callbacks.Callback):
             fh.write(json.dumps(rec) + "\n")
         print(f"EPOCH gen={GEN} rank={RANK} epoch={epoch} "
               f"size={hvd.size()} loss={rec['loss']:.4f}", flush=True)
+        _maybe_pool_check()
+
+
+_POOLCHECKED = False
+
+
+def _pool_misses(e):
+    if hasattr(e, "pool"):  # python engine
+        return e.pool.misses
+    import ctypes
+
+    from horovod_tpu.core import native as _nat
+
+    st = _nat.HvdStats()
+    e._lib.hvd_engine_get_stats(e._ptr, ctypes.byref(st))
+    return int(st.pool_misses) + e._pool.misses
+
+
+def _maybe_pool_check():
+    """Chaos-tier pool hygiene (zero-copy data plane): after the peer's
+    SIGKILL forced an in-place shrink — which abandons (and poisons) the
+    wedged engine's buffer pool — the lone survivor's FRESH engine must
+    round-trip through a working pool with the miss counter flat in
+    steady state. Single-survivor worlds only: no cross-rank engine
+    coupling inside the chaos scenario."""
+    global _POOLCHECKED
+    if (_POOLCHECKED or GEN != 0 or hvd.num_processes() != 1
+            or elastic.get_world().epoch == 0):
+        return
+    _POOLCHECKED = True
+    from horovod_tpu.core import engine as _eng
+
+    e = _eng.get_engine()
+    warm = None
+    for i in range(8):
+        h = e.allreduce_async(f"poolcheck/{i % 2}",
+                              np.full((512,), 1.0, np.float32), False)
+        out = e.synchronize(h)
+        assert np.isfinite(np.asarray(out)).all()
+        if i == 3:
+            warm = _pool_misses(e)
+    flat = _pool_misses(e) == warm
+    assert flat, (warm, _pool_misses(e))
+    print(f"POOLCHECK gen={GEN} rank={RANK} misses_flat={flat}",
+          flush=True)
 
 
 trainer = hk.Trainer(MLP(), optax.sgd(0.02, momentum=0.9), rng=0)

@@ -72,11 +72,13 @@ def test_cpp_timeline_diff_comparable_with_python_twin(hvd, tmp_path):
     assert ("ALLGATHER", "B", ("float32", (2, 3))) in cpp["t/g"]
     # Both writers must cover the single-op vocabulary declared in
     # core/timeline.py — not merely agree with each other (the reference
-    # emits WAIT_FOR_DATA before every executed op, operations.cc:783-807).
+    # emits WAIT_FOR_DATA before every executed op, operations.cc:783-807;
+    # MEMCPY is the submit-time snapshot span of the zero-copy data
+    # plane, nested at the head of QUEUE).
     for summary in (cpp, py):
         acts = {a for evs in summary.values() for a, _, _ in evs}
-        assert acts == {tl.QUEUE, tl.WAIT_FOR_DATA, tl.ALLREDUCE,
-                        tl.ALLGATHER, tl.BROADCAST}, acts
+        assert acts == {tl.QUEUE, tl.MEMCPY, tl.WAIT_FOR_DATA,
+                        tl.ALLREDUCE, tl.ALLGATHER, tl.BROADCAST}, acts
 
 
 class _PluggedExecutor:
@@ -134,8 +136,9 @@ def test_fused_timeline_covers_declared_vocabulary(hvd, tmp_path, impl):
 
     summary = _summarize(path)
     acts = {a for evs in summary.values() for a, _, _ in evs}
-    declared = {tl.QUEUE, tl.WAIT_FOR_DATA, tl.MEMCPY_IN_FUSION_BUFFER,
-                tl.ALLREDUCE, tl.MEMCPY_OUT_FUSION_BUFFER}
+    declared = {tl.QUEUE, tl.MEMCPY, tl.WAIT_FOR_DATA,
+                tl.MEMCPY_IN_FUSION_BUFFER, tl.ALLREDUCE,
+                tl.MEMCPY_OUT_FUSION_BUFFER}
     assert acts == declared, acts ^ declared
     # The fused tensors carry the fusion-buffer spans; the plug ran alone.
     for name in ("t/fa", "t/fb"):

@@ -537,6 +537,12 @@ def test_chaos_sigkill_shrink_and_rejoin(engine, tmp_path):
                    if ln.startswith("[0] EPOCH gen=0") and "size=4" in ln]
     assert gen0_shrunk, out[-4000:]
 
+    # Zero-copy data plane: the SIGKILL mid-cycle (and the shrink's
+    # engine abandonment, which poisons the wedged engine's buffer
+    # pool) must not poison the survivor's pool — its fresh engine
+    # round-trips with a flat steady-state miss counter.
+    assert "POOLCHECK gen=0 rank=0 misses_flat=True" in out, out[-4000:]
+
     # Flight dump attributes the dead process.
     import glob
 

@@ -1,0 +1,386 @@
+"""Zero-copy engine data plane (core/bufferpool.py + the engines'
+pooled/donated submit paths).
+
+Pins, for BOTH engines:
+
+- the allocation-free steady state: pool misses stop growing after
+  warmup (the PersistentBuffer claim, SURVEY C8, as a regression test);
+- snapshot semantics under adversarial mutation: a caller scribbling on
+  its buffers immediately after every ``*_async`` submit cannot change
+  what gets reduced — digests match an untouched-world run bitwise;
+- donation semantics: ``donate=True`` skips the snapshot, the engine
+  never writes the donated buffer, and mutating a donated numpy array
+  raises (the view is flagged unwriteable);
+- pool hygiene: ``abandon()`` poisons the dying engine's pool (leaked
+  slabs can never be handed out again) and the ``engine.pool:exhausted``
+  fault site forces the cap-reached path on demand.
+"""
+
+import ctypes
+import hashlib
+import threading
+
+import numpy as np
+import pytest
+
+from horovod_tpu.core import bufferpool as bpool
+from horovod_tpu.core import engine as eng
+from horovod_tpu.core import faultline as flt
+from horovod_tpu.core import native
+from horovod_tpu.core import timeline as tl
+from horovod_tpu.core.native_engine import NativeEngine
+
+
+class EchoExecutor:
+    """Deterministic local data plane: allreduce doubles, allgather
+    tiles x2, broadcast adds 100 (float) — engine-independent results."""
+
+    def allreduce(self, flat, average):
+        return flat * 2.0 if flat.dtype.kind == "f" else flat * 2
+
+    def allgather(self, t):
+        return np.tile(t, (2,) + (1,) * (t.ndim - 1))
+
+    def broadcast(self, t, root):
+        return t + 100.0 if t.dtype.kind == "f" else t.copy()
+
+
+class GatedEcho(EchoExecutor):
+    """First call blocks until release() — submits pile up while the
+    caller mutates its buffers, the adversarial race this file pins."""
+
+    def __init__(self):
+        self.gate = threading.Event()
+        self.started = threading.Event()
+        self.calls = 0
+
+    def _pause(self):
+        self.calls += 1
+        if self.calls == 1:
+            self.started.set()
+            self.gate.wait(10.0)
+
+    def allreduce(self, flat, average):
+        self._pause()
+        return super().allreduce(flat, average)
+
+    def allgather(self, t):
+        self._pause()
+        return super().allgather(t)
+
+    def broadcast(self, t, root):
+        self._pause()
+        return super().broadcast(t, root)
+
+
+def _mk(impl, executor, **kw):
+    kw.setdefault("cycle_time_s", 0.002)
+    if impl == "native":
+        kw.setdefault("timeline_path", "")
+        return NativeEngine(executor=executor, **kw)
+    kw.setdefault("timeline", tl.Timeline(None))
+    return eng.Engine(executor=executor, **kw)
+
+
+# ---------------------------------------------------------------------------
+# BufferPool unit semantics
+# ---------------------------------------------------------------------------
+
+def test_pool_recycles_on_release():
+    p = bpool.BufferPool(max_bytes=1 << 20)
+    a = p.checkout(1024, np.float32)
+    a[:] = 7.0
+    assert p.stats()["misses"] == 1
+    # Slab pinned while a view lives: a second checkout cannot reuse it.
+    b = p.checkout(1024, np.float32)
+    assert p.stats()["misses"] == 2
+    assert not np.shares_memory(a, b)
+    del a, b
+    # Both slabs free again: the next two checkouts are hits.
+    c = p.checkout(1024, np.float32)
+    d = p.checkout(512, np.float32)  # same 4 KiB class
+    assert p.stats()["hits"] == 2
+    assert p.stats()["misses"] == 2
+    del c, d
+
+
+def test_pool_derived_views_pin_the_slab():
+    p = bpool.BufferPool(max_bytes=1 << 20)
+    a = p.checkout(256, np.float32)
+    view = a.reshape(16, 16)[3:5]
+    del a
+    # A grandchild view still pins the slab (numpy collapses view chains
+    # onto the owning array) — reuse now would scribble on `view`.
+    b = p.checkout(256, np.float32)
+    assert p.stats()["hits"] == 0
+    assert not np.shares_memory(view, b)
+    del view, b
+
+
+def test_pool_per_dtype_and_class():
+    p = bpool.BufferPool(max_bytes=1 << 20)
+    f = p.checkout(100, np.float32)
+    del f
+    # Same class, different dtype: no cross-dtype reuse.
+    i = p.checkout(100, np.int32)
+    assert p.stats()["hits"] == 0
+    del i
+
+
+def test_pool_disabled_and_capped():
+    off = bpool.BufferPool(max_bytes=0)
+    assert not off.enabled
+    x = off.checkout(64, np.float32)
+    del x
+    y = off.checkout(64, np.float32)
+    assert off.stats() == {"hits": 0, "misses": 2, "checkouts": 2,
+                           "bytes_resident": 0}
+    del y
+    # Cap: one 4 KiB slab fits, the second is not retained.
+    small = bpool.BufferPool(max_bytes=4096)
+    a = small.checkout(1024, np.float32)
+    b = small.checkout(1024, np.float32)
+    assert small.stats()["bytes_resident"] == 4096
+    del a, b
+    c = small.checkout(1024, np.float32)
+    assert small.stats()["hits"] == 1  # the retained slab came back
+    del c
+
+
+def test_pool_poison_never_reuses():
+    p = bpool.BufferPool(max_bytes=1 << 20)
+    a = p.checkout(1024, np.float32)
+    p.poison()
+    assert p.poisoned
+    del a
+    b = p.checkout(1024, np.float32)
+    assert p.stats()["hits"] == 0
+    assert p.stats()["bytes_resident"] == 0
+    del b
+
+
+def test_pool_exhausted_fault_site():
+    try:
+        flt.configure("engine.pool:exhausted:2")
+        p = bpool.BufferPool(max_bytes=1 << 20)
+        a = p.checkout(1024, np.float32)
+        del a
+        b = p.checkout(1024, np.float32)  # second exhausted firing
+        del b
+        # Both firings allocated fresh without retaining.
+        assert p.stats()["misses"] == 2
+        assert p.stats()["bytes_resident"] == 0
+        c = p.checkout(1024, np.float32)  # spec spent: pools again
+        assert p.stats()["bytes_resident"] == 4096
+        del c
+    finally:
+        flt.reset()
+
+
+# ---------------------------------------------------------------------------
+# Allocation-free steady state (the pinned regression test, both engines)
+# ---------------------------------------------------------------------------
+
+def _native_pool_misses(e):
+    st = native.HvdStats()
+    e._lib.hvd_engine_get_stats(e._ptr, ctypes.byref(st))
+    return int(st.pool_misses) + e._pool.misses
+
+
+@pytest.mark.parametrize("impl", ["python", "native"])
+def test_steady_state_pool_misses_flat(impl):
+    """N steady-state cycles with a fixed working set: after warmup the
+    pool serves every submit snapshot, fusion buffer and result buffer
+    from reused slabs — the miss counter must stop growing (the
+    allocation-free claim of ROADMAP item 5, pinned)."""
+    ex = EchoExecutor()
+    e = _mk(impl, ex)
+    try:
+        tensors = [np.full((1024,), float(k), np.float32)
+                   for k in range(4)]
+
+        def one_iter():
+            # Synchronize after each submit: single-entry cycles, so the
+            # cycle composition (and therefore the slab classes) is
+            # deterministic — no composition-dependent late misses.
+            for k, t in enumerate(tensors):
+                h = e.allreduce_async(f"steady/{k}", t, average=False)
+                np.testing.assert_allclose(e.synchronize(h),
+                                           np.full((1024,), 2.0 * k))
+            h = e.allgather_async("steady/g", tensors[1])
+            e.synchronize(h)
+            h = e.broadcast_async("steady/b", tensors[2], 0)
+            e.synchronize(h)
+
+        for _ in range(12):
+            one_iter()
+        warm = _native_pool_misses(e) if impl == "native" else e.pool.misses
+        assert warm > 0  # the pool is actually in the path
+        for _ in range(25):
+            one_iter()
+        final = (_native_pool_misses(e) if impl == "native"
+                 else e.pool.misses)
+        assert final == warm, (
+            f"{impl} engine still allocating in steady state: "
+            f"pool misses {warm} -> {final}")
+        hits = (e._pool.hits if impl == "native" else e.pool.hits)
+        assert hits > 0
+    finally:
+        e.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# Adversarial snapshot semantics (both engines)
+# ---------------------------------------------------------------------------
+
+def _digest(arrays):
+    return hashlib.sha256(
+        b"".join(np.ascontiguousarray(a).tobytes()
+                 for a in arrays)).hexdigest()
+
+
+def _submit_all(e, bufs, donate=False):
+    return [
+        e.allreduce_async("adv/r", bufs[0], average=False, donate=donate),
+        e.allgather_async("adv/g", bufs[1], donate=donate),
+        e.broadcast_async("adv/b", bufs[2], 1, donate=donate),
+    ]
+
+
+def _fresh_bufs():
+    return [np.arange(256, dtype=np.float32),
+            np.linspace(-1.0, 1.0, 48, dtype=np.float32).reshape(4, 12),
+            np.full((33,), 3.25, np.float32)]
+
+
+@pytest.mark.parametrize("impl", ["python", "native"])
+def test_mutate_after_submit_does_not_change_reduction(impl):
+    """The architecture invariant, adversarially: the caller scribbles
+    over every buffer immediately after its *_async call, while the
+    executor is provably still blocked — the reduced digests must equal
+    the untouched-world run bitwise."""
+    # Control: untouched world.
+    e = _mk(impl, EchoExecutor())
+    try:
+        handles = _submit_all(e, _fresh_bufs())
+        control = _digest([e.synchronize(h) for h in handles])
+    finally:
+        e.shutdown()
+
+    ex = GatedEcho()
+    e = _mk(impl, ex)
+    try:
+        bufs = _fresh_bufs()
+        handles = [e.allreduce_async("adv/r", bufs[0], average=False)]
+        bufs[0][:] = -777.0  # mutate IMMEDIATELY after submit
+        assert ex.started.wait(10.0)  # executor is wedged mid-batch
+        handles.append(e.allgather_async("adv/g", bufs[1]))
+        bufs[1][:] = np.nan
+        handles.append(e.broadcast_async("adv/b", bufs[2], 1))
+        bufs[2][:] = 0.0
+        ex.gate.set()
+        mutated = _digest([e.synchronize(h) for h in handles])
+    finally:
+        ex.gate.set()
+        e.shutdown()
+    assert mutated == control
+
+
+@pytest.mark.parametrize("impl", ["python", "native"])
+def test_donate_then_mutate_raises_and_reduces_correctly(impl):
+    """donate=True hands the buffer over: the numpy array is flagged
+    unwriteable, so a donate-then-mutate raises instead of corrupting
+    the reduction; results match the snapshot path bitwise, and the
+    engine never writes the donated buffer (it is read-only to it)."""
+    e = _mk(impl, EchoExecutor())
+    try:
+        handles = _submit_all(e, _fresh_bufs())
+        control = _digest([e.synchronize(h) for h in handles])
+    finally:
+        e.shutdown()
+
+    ex = GatedEcho()
+    e = _mk(impl, ex)
+    try:
+        bufs = _fresh_bufs()
+        keep = [b.copy() for b in bufs]
+        handles = _submit_all(e, bufs, donate=True)
+        for b in bufs:
+            with pytest.raises(ValueError):
+                b[0] = 123.0  # donated: mutation must raise
+        ex.gate.set()
+        donated = _digest([e.synchronize(h) for h in handles])
+        # The engine only ever READ the donated buffers.
+        for b, k in zip(bufs, keep):
+            np.testing.assert_array_equal(b, k)
+    finally:
+        ex.gate.set()
+        e.shutdown()
+    assert donated == control
+
+
+@pytest.mark.parametrize("impl", ["python", "native"])
+def test_rejected_donation_restores_writability(impl):
+    """A REJECTED donated submit (duplicate name) must hand the buffer
+    back writable: the engine never took ownership, and a permanently
+    read-only caller buffer would be a silent resource-state leak."""
+    ex = GatedEcho()
+    e = _mk(impl, ex)
+    try:
+        first = np.ones((8,), np.float32)
+        h = e.allreduce_async("rej/x", first, average=False, donate=True)
+        dup = np.ones((8,), np.float32)
+        with pytest.raises(eng.DuplicateNameError):
+            e.allreduce_async("rej/x", dup, average=False, donate=True)
+        dup[0] = 5.0  # ownership stayed with the caller
+        ex.gate.set()
+        e.synchronize(h)
+        # The accepted donation stays frozen.
+        with pytest.raises(ValueError):
+            first[0] = 5.0
+    finally:
+        ex.gate.set()
+        e.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# Pool hygiene on the elastic path
+# ---------------------------------------------------------------------------
+
+def test_abandon_poisons_pool_python():
+    e = _mk("python", EchoExecutor())
+    pool = e.pool
+    lent = pool.checkout(1024, np.float32)  # a slab "in flight"
+    e.abandon()
+    assert pool.poisoned
+    del lent
+    again = pool.checkout(1024, np.float32)
+    assert pool.stats()["hits"] == 0  # nothing the old engine lent comes back
+    del again
+    # A successor engine starts with a fresh, working pool.
+    e2 = _mk("python", EchoExecutor())
+    try:
+        assert e2.pool is not pool and not e2.pool.poisoned
+        h = e2.allreduce_async("post/r", np.ones((8,), np.float32), False)
+        np.testing.assert_allclose(e2.synchronize(h), np.full((8,), 2.0))
+    finally:
+        e2.shutdown()
+
+
+def test_abandon_poisons_pool_native():
+    e = _mk("native", EchoExecutor())
+    pool = e._pool
+    buf = np.ones((16,), np.float32)
+    h = e.allreduce_async("aband/r", buf, False, donate=True)
+    e.synchronize(h)
+    e.abandon()
+    assert pool.poisoned
+    # The donated-buffer pin survives the abandonment (the parked C++
+    # loop may still reference it) — the keepalive map is NOT cleared.
+    e2 = _mk("native", EchoExecutor())
+    try:
+        assert e2._pool is not pool and not e2._pool.poisoned
+        h = e2.allreduce_async("post/r", np.ones((8,), np.float32), False)
+        np.testing.assert_allclose(e2.synchronize(h), np.full((8,), 2.0))
+    finally:
+        e2.shutdown()
