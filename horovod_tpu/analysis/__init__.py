@@ -1,0 +1,28 @@
+"""hvdcheck — cross-language static analysis for the engine core.
+
+A stdlib-only checker suite that reads BOTH sides of every hand-twinned
+surface independently and fails the tree on drift:
+
+- :mod:`.abi` — the C ABI of ``hvdcore.cc`` vs the ctypes mirrors
+  (struct fields, exported signatures, callback typedefs);
+- :mod:`.parity` — cross-engine observable parity (telemetry counter
+  names, timeline span vocabulary and span-args keys, the negotiation
+  decision grammar, the dtype/wire/op code tables);
+- :mod:`.invariants` — an ``ast`` rule pack for the CLAUDE.md engine
+  contracts (TF bridge grouping, engine lifecycle, donate-then-mutate,
+  eager-drain host-first broadcast, lock ordering, import-free
+  entrypoints).
+
+CLI: ``python -m horovod_tpu.analysis [--json] [--root DIR]`` — exit 0
+on a clean tree, 2 on findings. The same checks run in tier-1 CI via
+``tests/test_analysis.py``, so a drift fails the commit it lands in.
+Rule catalog + how to add a rule: ``docs/static-analysis.md``.
+"""
+
+from horovod_tpu.analysis.report import (  # noqa: F401
+    Finding,
+    RULE_CATALOG,
+    render,
+    repo_root,
+    run_all,
+)

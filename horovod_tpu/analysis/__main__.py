@@ -1,0 +1,39 @@
+"""CLI: ``python -m horovod_tpu.analysis [--json] [--root DIR]``.
+
+Exit codes (pinned by tests/test_analysis.py): 0 clean, 2 findings,
+1 the analysis itself failed (a parser outgrown by the code it reads —
+that is a red run, not a pass)."""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="python -m horovod_tpu.analysis",
+        description="hvdcheck — cross-language ABI/invariant static "
+                    "analysis for the engine core")
+    p.add_argument("--json", action="store_true",
+                   help="machine-readable findings on stdout")
+    p.add_argument("--root", default=None,
+                   help="repository root (default: resolved from the "
+                        "installed package location)")
+    p.add_argument("--list-rules", action="store_true",
+                   help="print the rule catalog and exit")
+    args = p.parse_args(argv)
+
+    from horovod_tpu.analysis import report
+
+    if args.list_rules:
+        for rule, desc in sorted(report.RULE_CATALOG.items()):
+            print(f"{rule}: {desc}")
+        return 0
+    findings = report.run_all(args.root)
+    print(report.render(findings, as_json=args.json))
+    return 2 if findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,370 @@
+"""C-ABI consistency checker: ``hvdcore.cc`` vs the ctypes binding.
+
+The two sides of the native-engine ABI are maintained by hand in two
+languages (``struct hvd_*`` + exported ``hvd_engine_*`` signatures in
+C++; ``ctypes.Structure`` mirrors + ``argtypes``/``restype`` in
+``core/native/__init__.py``). A skew — a field added on one side, an
+argument widened, an order swap — corrupts silently at runtime because
+ctypes trusts the declarations. This checker parses BOTH sides
+independently (cparse.py for the C subset, ``ast`` for the Python) and
+diffs them field-by-field and argument-by-argument.
+
+Path conventions (overridable for fixture tests): the C source is
+``horovod_tpu/core/native/hvdcore.cc`` and the binding is
+``horovod_tpu/core/native/__init__.py`` under the given root.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import Dict, List, Optional, Tuple
+
+from horovod_tpu.analysis import cparse
+from horovod_tpu.analysis.report import Finding
+
+# C struct name -> ctypes.Structure mirror class name.
+STRUCT_MIRRORS = {
+    "hvd_request": "HvdRequest",
+    "hvd_result": "HvdResult",
+    "hvd_engine_stats": "HvdStats",
+}
+
+# C typedef name -> CFUNCTYPE constant name.
+CALLBACK_MIRRORS = {
+    "hvd_exec_fn": "EXEC_FN",
+    "hvd_negotiate_fn": "NEG_FN",
+}
+
+# Canonical C type -> acceptable ctypes tokens (argument position).
+# Pointer-to-struct params map through the mirror classes; ``char**``
+# accepts the binding's deliberate ``POINTER(c_void_p)`` (the decision
+# string travels as a raw hvd_alloc pointer) as well as the natural
+# spelling.
+_ARG_MAP: Dict[str, Tuple[str, ...]] = {
+    "int": ("c_int",),
+    "double": ("c_double",),
+    "long long": ("c_longlong",),
+    "char*": ("c_char_p",),
+    "const char*": ("c_char_p",),
+    "void*": ("c_void_p",),
+    "const void*": ("c_void_p",),
+    "int*": ("POINTER(c_int)",),
+    "double*": ("POINTER(c_double)",),
+    "long long*": ("POINTER(c_longlong)",),
+    "const long long*": ("POINTER(c_longlong)",),
+    "char**": ("POINTER(c_void_p)", "POINTER(c_char_p)"),
+    "hvd_exec_fn": ("EXEC_FN",),
+    "hvd_negotiate_fn": ("NEG_FN",),
+    "hvd_request*": ("POINTER(HvdRequest)",),
+    "hvd_result*": ("POINTER(HvdResult)",),
+    "hvd_engine_stats*": ("POINTER(HvdStats)",),
+}
+
+# Canonical C type -> ctypes token inside a Structure (by-value field).
+_FIELD_MAP: Dict[str, str] = {
+    "int": "c_int",
+    "double": "c_double",
+    "long long": "c_longlong",
+    "char": "c_char",
+    "const char*": "c_char_p",
+    "char*": "c_char_p",
+    "void*": "c_void_p",
+}
+
+
+def _ctypes_token(node: ast.AST) -> str:
+    """Canonical string for a ctypes type expression in the binding:
+    ``ctypes.c_int`` -> ``c_int``; ``ctypes.c_longlong * 8`` ->
+    ``c_longlong*8``; ``ctypes.POINTER(ctypes.c_int)`` ->
+    ``POINTER(c_int)``; bare names (EXEC_FN, HvdStats) pass through."""
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Constant) and node.value is None:
+        return "None"
+    if isinstance(node, ast.BinOp) and isinstance(node.op, ast.Mult):
+        left = _ctypes_token(node.left)
+        if isinstance(node.right, ast.Constant):
+            return f"{left}*{node.right.value}"
+    if isinstance(node, ast.Call):
+        fn = _ctypes_token(node.func)
+        args = ", ".join(_ctypes_token(a) for a in node.args)
+        return f"{fn}({args})"
+    return ast.dump(node)
+
+
+class Binding:
+    """The ctypes side, parsed from core/native/__init__.py via ast."""
+
+    def __init__(self, path: str):
+        self.path = path
+        src = open(path).read()
+        tree = ast.parse(src, filename=path)
+        # Structure mirrors: class X(ctypes.Structure) with _fields_.
+        self.structs: Dict[str, List[Tuple[str, str, int]]] = {}
+        # lib.<name>.argtypes / restype assignments anywhere in the file.
+        self.argtypes: Dict[str, List[str]] = {}
+        self.restypes: Dict[str, str] = {}
+        self.lines: Dict[str, int] = {}
+        # CFUNCTYPE constants: NAME = ctypes.CFUNCTYPE(ret, args...).
+        self.callbacks: Dict[str, Tuple[str, List[str]]] = {}
+        # argtypes/restype declarations are read from load_library()
+        # ONLY: other builders in the module (load_termshield) declare
+        # different libraries' symbols, which are not this ABI.
+        for node in tree.body:
+            if isinstance(node, ast.ClassDef):
+                self._read_class(node)
+            elif isinstance(node, ast.Assign):
+                self._read_assign(node)
+            elif (isinstance(node, ast.FunctionDef)
+                    and node.name == "load_library"):
+                for sub in ast.walk(node):
+                    if isinstance(sub, ast.Assign):
+                        self._read_assign(sub)
+
+    def _read_class(self, node: ast.ClassDef):
+        for stmt in node.body:
+            if (isinstance(stmt, ast.Assign) and len(stmt.targets) == 1
+                    and isinstance(stmt.targets[0], ast.Name)
+                    and stmt.targets[0].id == "_fields_"
+                    and isinstance(stmt.value, (ast.List, ast.Tuple))):
+                fields = []
+                for elt in stmt.value.elts:
+                    if not (isinstance(elt, ast.Tuple)
+                            and len(elt.elts) == 2
+                            and isinstance(elt.elts[0], ast.Constant)):
+                        continue
+                    fields.append((elt.elts[0].value,
+                                   _ctypes_token(elt.elts[1]),
+                                   elt.lineno))
+                self.structs[node.name] = fields
+                self.lines[node.name] = node.lineno
+
+    def _read_assign(self, node: ast.Assign):
+        if len(node.targets) != 1:
+            return
+        tgt = node.targets[0]
+        # lib.<fn>.argtypes / lib.<fn>.restype
+        if (isinstance(tgt, ast.Attribute)
+                and isinstance(tgt.value, ast.Attribute)
+                and isinstance(tgt.value.value, ast.Name)):
+            fn = tgt.value.attr
+            if tgt.attr == "argtypes" and isinstance(node.value, ast.List):
+                self.argtypes[fn] = [_ctypes_token(e)
+                                     for e in node.value.elts]
+                self.lines[fn] = node.lineno
+            elif tgt.attr == "restype":
+                self.restypes[fn] = _ctypes_token(node.value)
+                self.lines.setdefault(fn, node.lineno)
+        # EXEC_FN = ctypes.CFUNCTYPE(...)
+        elif (isinstance(tgt, ast.Name) and isinstance(node.value, ast.Call)
+              and _ctypes_token(node.value.func) == "CFUNCTYPE"):
+            toks = [_ctypes_token(a) for a in node.value.args]
+            self.callbacks[tgt.id] = (toks[0], toks[1:])
+            self.lines[tgt.id] = node.lineno
+
+
+def _field_token(f: cparse.Field) -> Optional[str]:
+    base = _FIELD_MAP.get(f.ctype)
+    if base is None:
+        return None
+    return f"{base}*{f.array}" if f.array is not None else base
+
+
+def check(root: str,
+          cc_path: Optional[str] = None,
+          binding_path: Optional[str] = None) -> List[Finding]:
+    cc_path = cc_path or os.path.join(
+        root, "horovod_tpu", "core", "native", "hvdcore.cc")
+    binding_path = binding_path or os.path.join(
+        root, "horovod_tpu", "core", "native", "__init__.py")
+    cc_rel = os.path.relpath(cc_path, root)
+    py_rel = os.path.relpath(binding_path, root)
+    src = open(cc_path).read()
+    findings: List[Finding] = []
+
+    structs = cparse.parse_structs(src)
+    funcs = cparse.parse_extern_c_functions(src)
+    typedefs = cparse.parse_fn_typedefs(src)
+    binding = Binding(binding_path)
+
+    # -- structs vs ctypes.Structure mirrors -------------------------------
+    for cname, pyname in STRUCT_MIRRORS.items():
+        cfields = structs.get(cname)
+        pyfields = binding.structs.get(pyname)
+        if cfields is None:
+            findings.append(Finding(
+                "abi-struct", cc_rel, 0,
+                f"struct {cname} not found in {cc_rel}"))
+            continue
+        if pyfields is None:
+            findings.append(Finding(
+                "abi-struct", py_rel, 0,
+                f"ctypes mirror {pyname} (of struct {cname}) not found"))
+            continue
+        n = max(len(cfields), len(pyfields))
+        for i in range(n):
+            if i >= len(cfields):
+                name, tok, line = pyfields[i]
+                findings.append(Finding(
+                    "abi-struct", py_rel, line,
+                    f"{pyname}.{name} has no counterpart at index {i} of "
+                    f"struct {cname} — the mirror is longer than the C "
+                    "struct"))
+                continue
+            if i >= len(pyfields):
+                f = cfields[i]
+                findings.append(Finding(
+                    "abi-struct", cc_rel, f.line,
+                    f"struct {cname}.{f.name} (index {i}) is missing "
+                    f"from the ctypes mirror {pyname}"))
+                continue
+            f = cfields[i]
+            name, tok, line = pyfields[i]
+            expect = _field_token(f)
+            if expect is None:
+                findings.append(Finding(
+                    "abi-struct", cc_rel, f.line,
+                    f"struct {cname}.{f.name}: C type {f.ctype!r} is "
+                    "outside the checked ABI vocabulary — extend "
+                    "analysis/abi.py if this is intentional"))
+                continue
+            if name != f.name:
+                findings.append(Finding(
+                    "abi-struct", py_rel, line,
+                    f"{pyname} field {i} is {name!r} but struct "
+                    f"{cname} declares {f.name!r} at that index — "
+                    "order/name skew"))
+            if tok != expect:
+                findings.append(Finding(
+                    "abi-struct", py_rel, line,
+                    f"{pyname}.{name} is declared {tok} but struct "
+                    f"{cname}.{f.name} is {f.ctype}"
+                    f"{f'[{f.array}]' if f.array else ''} "
+                    f"(expected {expect})"))
+
+    # -- exported signatures vs argtypes/restype ---------------------------
+    for name, fn in sorted(funcs.items()):
+        declared = binding.argtypes.get(name)
+        if declared is None:
+            findings.append(Finding(
+                "abi-signature", py_rel, 0,
+                f"exported symbol {name} has no argtypes declaration in "
+                "load_library() — ctypes would default every argument "
+                "to int"))
+            continue
+        line = binding.lines.get(name, 0)
+        expect = []
+        bad = False
+        for arg in fn.args:
+            acc = _ARG_MAP.get(arg)
+            if acc is None:
+                findings.append(Finding(
+                    "abi-signature", cc_rel, fn.line,
+                    f"{name}: C argument type {arg!r} is outside the "
+                    "checked ABI vocabulary — extend analysis/abi.py"))
+                bad = True
+                break
+            expect.append(acc)
+        if bad:
+            continue
+        if len(declared) != len(expect):
+            findings.append(Finding(
+                "abi-signature", py_rel, line,
+                f"{name}: C declares {len(expect)} argument(s) but "
+                f"argtypes lists {len(declared)}"))
+        else:
+            for i, (tok, acc) in enumerate(zip(declared, expect)):
+                if tok not in acc:
+                    findings.append(Finding(
+                        "abi-signature", py_rel, line,
+                        f"{name} argument {i}: argtypes says {tok} but "
+                        f"the C signature says {fn.args[i]!r} (expected "
+                        f"{' or '.join(acc)})"))
+        # Return type: void -> no restype required (ctypes' default int
+        # return is discarded); anything else must be declared exactly.
+        restype = binding.restypes.get(name)
+        if fn.ret == "void":
+            if restype not in (None, "None"):
+                findings.append(Finding(
+                    "abi-signature", py_rel, line,
+                    f"{name} returns void but restype is declared "
+                    f"{restype}"))
+        elif fn.ret == "int":
+            if restype not in (None, "c_int"):
+                findings.append(Finding(
+                    "abi-signature", py_rel, line,
+                    f"{name} returns int but restype is declared "
+                    f"{restype}"))
+        else:
+            acc = _ARG_MAP.get(fn.ret)
+            if acc is None:
+                findings.append(Finding(
+                    "abi-signature", cc_rel, fn.line,
+                    f"{name}: C return type {fn.ret!r} is outside the "
+                    "checked ABI vocabulary"))
+            elif restype is None:
+                findings.append(Finding(
+                    "abi-signature", py_rel, line,
+                    f"{name} returns {fn.ret} but load_library() never "
+                    "declares a restype — ctypes would truncate it to "
+                    "int"))
+            elif restype not in acc:
+                findings.append(Finding(
+                    "abi-signature", py_rel, line,
+                    f"{name}: restype is {restype} but the C return "
+                    f"type is {fn.ret!r} (expected {' or '.join(acc)})"))
+
+    # Binding declarations for symbols the C side no longer exports.
+    for name in binding.argtypes:
+        if name not in funcs:
+            findings.append(Finding(
+                "abi-signature", py_rel, binding.lines.get(name, 0),
+                f"load_library() declares argtypes for {name}, which "
+                f"{cc_rel} does not export"))
+
+    # -- callback typedefs vs CFUNCTYPE shapes -----------------------------
+    for cname, pyname in CALLBACK_MIRRORS.items():
+        td = typedefs.get(cname)
+        cb = binding.callbacks.get(pyname)
+        if td is None:
+            findings.append(Finding(
+                "abi-callback", cc_rel, 0,
+                f"typedef {cname} not found in {cc_rel}"))
+            continue
+        if cb is None:
+            findings.append(Finding(
+                "abi-callback", py_rel, 0,
+                f"CFUNCTYPE constant {pyname} (mirror of {cname}) not "
+                "found"))
+            continue
+        ret, args = td
+        pyret, pyargs = cb
+        line = binding.lines.get(pyname, 0)
+        if (ret, pyret) != ("int", "c_int"):
+            findings.append(Finding(
+                "abi-callback", py_rel, line,
+                f"{pyname}: return type {pyret} does not match typedef "
+                f"{cname}'s {ret!r}"))
+        if len(args) != len(pyargs):
+            findings.append(Finding(
+                "abi-callback", py_rel, line,
+                f"{pyname}: {len(pyargs)} argument(s) declared but "
+                f"typedef {cname} has {len(args)}"))
+        else:
+            for i, (carg, parg) in enumerate(zip(args, pyargs)):
+                acc = _ARG_MAP.get(carg)
+                if acc is None:
+                    findings.append(Finding(
+                        "abi-callback", cc_rel, 0,
+                        f"{cname} argument {i}: C type {carg!r} is "
+                        "outside the checked ABI vocabulary"))
+                elif parg not in acc:
+                    findings.append(Finding(
+                        "abi-callback", py_rel, line,
+                        f"{pyname} argument {i}: {parg} does not match "
+                        f"typedef {cname}'s {carg!r} (expected "
+                        f"{' or '.join(acc)})"))
+    return findings

@@ -1,0 +1,375 @@
+"""Regex-grade C subset parser for ``hvdcore.cc`` (stdlib-only).
+
+Reads the native engine's translation unit WITHOUT compiling it and
+extracts exactly the surfaces the cross-language checkers diff against
+the Python side:
+
+- ``struct hvd_*`` field lists (name, C type, array length) in
+  declaration order — the C ABI the ctypes mirrors in
+  ``core/native/__init__.py`` must match field-for-field;
+- ``extern "C"`` function definitions (return type + parameter types)
+  — the ``argtypes``/``restype`` contract of ``load_library``;
+- function-pointer ``typedef``s (the executor / negotiator callback
+  shapes behind ``CFUNCTYPE``);
+- ``enum`` bodies, lookup-table string arrays (``DtypeName``), and
+  ``switch`` case→string maps (``WireName``) — small value tables the
+  Python twin re-declares and must not skew;
+- string literals (timeline span names, span-args keys, decision-
+  grammar kind chars) for the cross-engine parity checks.
+
+This is deliberately NOT a C parser: it understands only the idioms the
+engine core actually uses (single file, no preprocessor conditionals
+around the ABI, no nested struct definitions). If hvdcore.cc ever grows
+past that subset the parsers below fail LOUDLY (raise), which turns the
+analysis run red rather than silently checking nothing.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Dict, List, Optional, Tuple
+
+
+class CParseError(RuntimeError):
+    """The C source stepped outside the subset these parsers understand."""
+
+
+def strip_comments(src: str) -> str:
+    """Remove ``//`` and ``/* */`` comments, preserving string/char
+    literals (tensor-name escapes like ``\\"`` included) and line
+    numbers (newlines inside block comments are kept)."""
+    out: List[str] = []
+    i, n = 0, len(src)
+    while i < n:
+        c = src[i]
+        if c == '"' or c == "'":
+            quote = c
+            out.append(c)
+            i += 1
+            while i < n:
+                out.append(src[i])
+                if src[i] == "\\":
+                    if i + 1 < n:
+                        out.append(src[i + 1])
+                    i += 2
+                    continue
+                if src[i] == quote:
+                    i += 1
+                    break
+                i += 1
+            continue
+        if c == "/" and i + 1 < n and src[i + 1] == "/":
+            while i < n and src[i] != "\n":
+                i += 1
+            continue
+        if c == "/" and i + 1 < n and src[i + 1] == "*":
+            j = src.find("*/", i + 2)
+            if j < 0:
+                raise CParseError("unterminated block comment")
+            out.append("\n" * src.count("\n", i, j + 2))
+            i = j + 2
+            continue
+        out.append(c)
+        i += 1
+    return "".join(out)
+
+
+def _line_of(src: str, pos: int) -> int:
+    return src.count("\n", 0, pos) + 1
+
+
+# A struct field: ``<type tokens> <name>;`` or ``<type tokens> <name>[N];``
+# The extra-word repetition is LAZY with a required trailing name: regex
+# backtracking then yields the shortest valid type, so multi-word types
+# (``long long count``) split correctly instead of donating their last
+# letter to the name.
+_FIELD_RE = re.compile(
+    r"^\s*((?:const\s+)?[A-Za-z_][A-Za-z0-9_]*(?:\s+[A-Za-z_][A-Za-z0-9_]*)*?"
+    r"\s*\**)\s+([A-Za-z_][A-Za-z0-9_]*)\s*(?:\[\s*(\d+)\s*\])?\s*$")
+
+
+def _norm_type(t: str) -> str:
+    """Canonical spelling of a C type: single spaces, ``*`` attached
+    (``const char *`` -> ``const char*``)."""
+    t = re.sub(r"\s+", " ", t).strip()
+    t = re.sub(r"\s*\*\s*", "*", t)
+    return t
+
+
+class Field:
+    __slots__ = ("ctype", "name", "array", "line")
+
+    def __init__(self, ctype: str, name: str, array: Optional[int],
+                 line: int):
+        self.ctype = ctype
+        self.name = name
+        self.array = array
+        self.line = line
+
+    def __repr__(self):  # pragma: no cover - debugging aid
+        arr = f"[{self.array}]" if self.array else ""
+        return f"<{self.ctype} {self.name}{arr}>"
+
+
+def parse_structs(src: str,
+                  name_re: str = r"hvd_\w+") -> Dict[str, List[Field]]:
+    """Every ``struct <name> { ... };`` whose name matches ``name_re``
+    (flat plain-old-data bodies only — the default filter selects the C
+    ABI structs; internal C++ classes/structs are not part of the ABI
+    and use idioms outside this subset)."""
+    clean = strip_comments(src)
+    structs: Dict[str, List[Field]] = {}
+    want = re.compile(name_re)
+    for m in re.finditer(r"\bstruct\s+([A-Za-z_][A-Za-z0-9_]*)\s*\{", clean):
+        name = m.group(1)
+        if not want.fullmatch(name):
+            continue
+        body_start = m.end()
+        depth = 1
+        i = body_start
+        while i < len(clean) and depth:
+            if clean[i] == "{":
+                depth += 1
+            elif clean[i] == "}":
+                depth -= 1
+            i += 1
+        if depth:
+            raise CParseError(f"unterminated struct {name}")
+        body = clean[body_start:i - 1]
+        if "{" in body:
+            raise CParseError(
+                f"struct {name} has a nested brace body — outside the "
+                "parsed C subset")
+        fields: List[Field] = []
+        offset = body_start
+        for decl in body.split(";"):
+            stripped = decl.strip()
+            offset_here = offset
+            offset += len(decl) + 1
+            if not stripped:
+                continue
+            fm = _FIELD_RE.match(stripped)
+            if not fm:
+                # Methods/ctors would land here; hvd_* ABI structs are
+                # plain-old-data by contract.
+                raise CParseError(
+                    f"unparseable field in struct {name}: {stripped!r}")
+            fields.append(Field(
+                _norm_type(fm.group(1)), fm.group(2),
+                int(fm.group(3)) if fm.group(3) else None,
+                _line_of(clean, offset_here)))
+        structs[name] = fields
+    return structs
+
+
+class CFunc:
+    __slots__ = ("ret", "name", "args", "line")
+
+    def __init__(self, ret: str, name: str, args: List[str], line: int):
+        self.ret = ret
+        self.name = name
+        self.args = args
+        self.line = line
+
+
+# Lazy extra-words + required name for the same backtracking reason as
+# _FIELD_RE: ``long long hvd_engine_enqueue(`` must split type/name at
+# the last identifier.
+_FUNC_RE = re.compile(
+    r"^[ \t]*((?:const\s+)?[A-Za-z_][A-Za-z0-9_]*(?:\s+[A-Za-z_]"
+    r"[A-Za-z0-9_]*)*?\s*\**)\s*\n?\s*([A-Za-z_][A-Za-z0-9_]*)\s*\(",
+    re.M)
+
+
+# ``<type tokens> <name>``: the name is REQUIRED (every parameter in the
+# engine core is named), which lets regex backtracking split multi-word
+# types (``long long fusion_bytes``) correctly.
+_PARAM_RE = re.compile(
+    r"^((?:const\s+)?[A-Za-z_][A-Za-z0-9_]*(?:\s+[A-Za-z_][A-Za-z0-9_]*)*?"
+    r"\s*\**)\s+([A-Za-z_][A-Za-z0-9_]*)$")
+
+
+def _split_args(argtext: str) -> List[str]:
+    argtext = argtext.strip()
+    if not argtext or argtext == "void":
+        return []
+    args = []
+    for piece in argtext.split(","):
+        piece = re.sub(r"\s+", " ", piece).strip()
+        if not piece:
+            raise CParseError(f"empty parameter in {argtext!r}")
+        m = _PARAM_RE.match(piece)
+        if not m:
+            raise CParseError(f"unparseable parameter {piece!r}")
+        args.append(_norm_type(m.group(1)))
+    return args
+
+
+def parse_extern_c_functions(src: str) -> Dict[str, CFunc]:
+    """Function definitions inside ``extern \"C\" { ... }`` blocks."""
+    clean = strip_comments(src)
+    funcs: Dict[str, CFunc] = {}
+    for m in re.finditer(r'extern\s+"C"\s*\{', clean):
+        depth = 1
+        i = m.end()
+        start = i
+        while i < len(clean) and depth:
+            if clean[i] == "{":
+                depth += 1
+            elif clean[i] == "}":
+                depth -= 1
+            i += 1
+        block = clean[start:i - 1]
+        # Function-pointer typedefs share the block but are not exported
+        # symbols (parse_fn_typedefs reads them); blank them out, keeping
+        # offsets/line numbers intact for the remaining matches.
+        block = re.sub(r"typedef[^;]*;",
+                       lambda m: re.sub(r"[^\n]", " ", m.group(0)), block)
+        base = start
+        for fm in _FUNC_RE.finditer(block):
+            name = fm.group(2)
+            ret = fm.group(1).strip()
+            if name in ("if", "while", "for", "switch", "return",
+                        "sizeof") or ret.startswith(("return", "typedef")):
+                continue
+            if not name.startswith("hvd_"):
+                # The C ABI namespace is hvd_*; anything else at a line
+                # start is a statement, not an exported definition.
+                continue
+            # Parameter list runs to the matching ')'.
+            j = fm.end()
+            depth_p = 1
+            while j < len(block) and depth_p:
+                if block[j] == "(":
+                    depth_p += 1
+                elif block[j] == ")":
+                    depth_p -= 1
+                j += 1
+            argtext = block[fm.end():j - 1].replace("\n", " ")
+            funcs[name] = CFunc(_norm_type(fm.group(1)), name,
+                                _split_args(argtext),
+                                _line_of(clean, base + fm.start()))
+    return funcs
+
+
+def parse_fn_typedefs(src: str) -> Dict[str, Tuple[str, List[str]]]:
+    """``typedef <ret> (*<name>)(<args>);`` -> name: (ret, [arg types])."""
+    clean = strip_comments(src)
+    out: Dict[str, Tuple[str, List[str]]] = {}
+    for m in re.finditer(
+            r"typedef\s+([A-Za-z_][A-Za-z0-9_ ]*\**)\s*\(\s*\*\s*"
+            r"([A-Za-z_][A-Za-z0-9_]*)\s*\)\s*\(([^)]*)\)\s*;",
+            clean, re.S):
+        out[m.group(2)] = (_norm_type(m.group(1)),
+                           _split_args(m.group(3).replace("\n", " ")))
+    return out
+
+
+def parse_enum(src: str, name: str) -> Dict[str, int]:
+    """A sequential/explicit-value C enum body."""
+    clean = strip_comments(src)
+    m = re.search(r"\benum\s+" + re.escape(name) + r"\s*\{([^}]*)\}", clean)
+    if not m:
+        raise CParseError(f"enum {name} not found")
+    values: Dict[str, int] = {}
+    nxt = 0
+    for entry in m.group(1).split(","):
+        entry = entry.strip()
+        if not entry:
+            continue
+        em = re.match(r"^([A-Za-z_][A-Za-z0-9_]*)\s*(?:=\s*(\d+))?$", entry)
+        if not em:
+            raise CParseError(f"unparseable enum entry {entry!r}")
+        if em.group(2) is not None:
+            nxt = int(em.group(2))
+        values[em.group(1)] = nxt
+        nxt += 1
+    return values
+
+
+def parse_string_array(src: str, marker: str) -> List[str]:
+    """The string-literal initializer list of the array declared nearest
+    AFTER ``marker`` (e.g. the ``kNames`` table inside ``DtypeName``)."""
+    clean = strip_comments(src)
+    at = clean.find(marker)
+    if at < 0:
+        raise CParseError(f"marker {marker!r} not found")
+    m = re.search(r"\{((?:\s*\"[^\"]*\"\s*,?)+)\}", clean[at:])
+    if not m:
+        raise CParseError(f"no string array after {marker!r}")
+    return re.findall(r'"([^"]*)"', m.group(1))
+
+
+def parse_case_string_map(src: str, fn_name: str) -> Dict[int, str]:
+    """``case N: return "name";`` pairs inside one function body."""
+    clean = strip_comments(src)
+    at = clean.find(fn_name)
+    if at < 0:
+        raise CParseError(f"function {fn_name!r} not found")
+    brace = clean.find("{", at)
+    depth = 0
+    i = brace
+    while i < len(clean):
+        if clean[i] == "{":
+            depth += 1
+        elif clean[i] == "}":
+            depth -= 1
+            if depth == 0:
+                break
+        i += 1
+    body = clean[brace:i]
+    return {int(n): s for n, s in
+            re.findall(r'case\s+(\d+)\s*:\s*return\s+"([^"]*)"', body)}
+
+
+def string_literals(src: str) -> List[Tuple[str, int]]:
+    """Every double-quoted string literal (decoded for the escapes the
+    engine actually uses) with its line number. Comments excluded, and
+    CHAR literals are skipped by a stateful scan — a regex would pair a
+    quote inside ``'"'`` with the next real string's opening quote and
+    silently swallow genuine literals (JsonEscape's switch is exactly
+    that shape)."""
+    clean = strip_comments(src)
+    out: List[Tuple[str, int]] = []
+    i, n = 0, len(clean)
+    while i < n:
+        c = clean[i]
+        if c == "'":  # char literal: skip it, escapes included
+            i += 1
+            while i < n:
+                if clean[i] == "\\":
+                    i += 2
+                    continue
+                if clean[i] == "'":
+                    i += 1
+                    break
+                i += 1
+            continue
+        if c == '"':
+            start = i
+            i += 1
+            raw: List[str] = []
+            while i < n:
+                if clean[i] == "\\" and i + 1 < n:
+                    raw.append(clean[i:i + 2])
+                    i += 2
+                    continue
+                if clean[i] == '"':
+                    i += 1
+                    break
+                raw.append(clean[i])
+                i += 1
+            body = "".join(raw)
+            decoded = (body.replace('\\"', '"').replace("\\n", "\n")
+                       .replace("\\\\", "\\"))
+            out.append((decoded, _line_of(clean, start)))
+            continue
+        i += 1
+    return out
+
+
+def decision_kinds_handled(src: str) -> List[str]:
+    """The decision-grammar line kinds the C++ parser compares against
+    (``kind == 'g'`` / ``kind != 'e'`` in ``ParseAndExecute``)."""
+    clean = strip_comments(src)
+    return sorted(set(re.findall(r"kind\s*[!=]=\s*'([a-z])'", clean)))

@@ -1,0 +1,373 @@
+"""Cross-engine parity checker: python engine vs libhvdcore.
+
+The two engines owe byte-identical observable surfaces (the Horovod
+timeline/telemetry contract): every telemetry counter one engine feeds
+must be fed by the other, timeline span vocabularies must match, the
+negotiation decision grammar the python control plane emits must be
+handled by the C++ parser, and the small value tables both sides
+re-declare (dtype names, wire-policy codes, op codes) must not skew.
+Before this checker, that parity was pinned only where a test happened
+to look; here both sides are read independently from source.
+
+Sources (under the given root, overridable for fixture tests):
+- python emit sites: ``core/engine.py`` + ``core/bufferpool.py``
+- native emit sites: ``core/native_engine.py`` (direct emits, the
+  ``_STAT_COUNTERS`` stats sync, and whatever shared helpers it imports
+  from ``core/engine.py``)
+- C++ literals/tables: ``core/native/hvdcore.cc``
+- span vocabulary: ``core/timeline.py`` module constants
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from typing import Dict, List, Optional, Set, Tuple
+
+from horovod_tpu.analysis import cparse
+from horovod_tpu.analysis.report import Finding
+
+# Span names legitimately written by only ONE side: RANK_READY and the
+# HVD_CLOCK metadata are python-computed and pass through the C++
+# timeline hooks verbatim (the C++ writer never spells them).
+PY_ONLY_SPANS = {"RANK_READY", "HVD_CLOCK"}
+
+# Span-args keys computed python-side and passed through the C++ hooks
+# (clock metadata + negotiation readiness), excluded from the span-args
+# key diff.
+PASS_THROUGH_ARG_KEYS = {"process", "rank", "epoch_wall_us", "offset_us",
+                         "rtt_us"}
+
+
+def _registry_names(tree: ast.AST) -> Set[str]:
+    """Telemetry names in ``REGISTRY.counter/gauge/histogram("...")``
+    calls. f-strings canonicalize to ``<literal prefix>*``."""
+    names: Set[str] = set()
+    for node in ast.walk(tree):
+        if not (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr in ("counter", "gauge", "histogram")
+                and node.args):
+            continue
+        stack = [node.args[0]]
+        while stack:
+            arg = stack.pop()
+            if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+                names.add(arg.value)
+            elif isinstance(arg, ast.JoinedStr) and arg.values and \
+                    isinstance(arg.values[0], ast.Constant):
+                names.add(str(arg.values[0].value) + "*")
+            elif isinstance(arg, ast.IfExp):
+                # counter("engine.errors" if err else "engine.completed")
+                stack.extend((arg.body, arg.orelse))
+    return names
+
+
+def _function_defs(tree: ast.AST) -> Dict[str, ast.FunctionDef]:
+    return {n.name: n for n in ast.walk(tree)
+            if isinstance(n, ast.FunctionDef)}
+
+
+def _imported_engine_helpers(native_tree: ast.AST) -> Set[str]:
+    """Names native_engine.py imports from core.engine — shared helpers
+    whose telemetry emits count as native-fed too (the native engine
+    enqueues through them)."""
+    names: Set[str] = set()
+    for node in ast.walk(native_tree):
+        if isinstance(node, ast.ImportFrom) and node.module and \
+                node.module.endswith("core.engine"):
+            names.update(a.name for a in node.names)
+    return names
+
+
+def _stat_counters(native_tree: ast.AST) -> List[Tuple[str, str, int]]:
+    """The ``_STAT_COUNTERS`` (registry name, C stats field) table."""
+    for node in ast.walk(native_tree):
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 and \
+                isinstance(node.targets[0], ast.Name) and \
+                node.targets[0].id == "_STAT_COUNTERS" and \
+                isinstance(node.value, (ast.Tuple, ast.List)):
+            out = []
+            for elt in node.value.elts:
+                if isinstance(elt, ast.Tuple) and len(elt.elts) == 2 and \
+                        all(isinstance(e, ast.Constant) for e in elt.elts):
+                    out.append((elt.elts[0].value, elt.elts[1].value,
+                                elt.lineno))
+            return out
+    return []
+
+
+def _timeline_constants(timeline_tree: ast.AST) -> Dict[str, str]:
+    """Module-level ``NAME = "SPAN"`` constants of core/timeline.py."""
+    out: Dict[str, str] = {}
+    for node in timeline_tree.body:  # type: ignore[attr-defined]
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 and \
+                isinstance(node.targets[0], ast.Name) and \
+                isinstance(node.value, ast.Constant) and \
+                isinstance(node.value.value, str):
+            name = node.targets[0].id
+            if name.isupper() and re.fullmatch(r"[A-Z][A-Z_]+",
+                                               node.value.value):
+                out[name] = node.value.value
+    return out
+
+
+def _py_span_arg_keys(tree: ast.AST) -> Set[str]:
+    """String keys of every dict literal in the python engine source.
+    Span-args dicts are frequently built away from the timeline call
+    (``pool_args = {"pooled": ...}`` then reused on several ends), so
+    call-site-only extraction would miss them; engine.py keeps no other
+    string-keyed dict literals, which makes the file-wide sweep exact."""
+    keys: Set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Dict):
+            for k in node.keys:
+                if isinstance(k, ast.Constant) and isinstance(k.value, str):
+                    keys.add(k.value)
+        elif isinstance(node, ast.Assign):
+            # Conditional additions: args["wire"] = policy
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Subscript) and \
+                        isinstance(tgt.slice, ast.Constant) and \
+                        isinstance(tgt.slice.value, str):
+                    keys.add(tgt.slice.value)
+    return keys
+
+
+def _cc_span_arg_keys(src: str) -> Set[str]:
+    """Arg keys the C++ writer interpolates itself. The engine renders
+    args bodies with a space after the colon (``\\"pooled\\": true``)
+    and every OTHER JSON it builds (the chrome event skeleton, the
+    negotiation table) without one — that formatting convention is what
+    separates span-args keys from wire-protocol keys here, and
+    hvdcore.cc documents it next to TensorArgs."""
+    keys: Set[str] = set()
+    for lit, _ in cparse.string_literals(src):
+        keys.update(re.findall(r'"([a-z_]+)": ', lit))
+    return keys
+
+
+def _decision_kinds_emitted(native_tree: ast.AST) -> Set[str]:
+    """Decision-grammar line kinds emitted inside _make_negotiator:
+    f-strings / literals whose constant head matches ``<kind> ``."""
+    kinds: Set[str] = set()
+    fns = _function_defs(native_tree)
+    neg = fns.get("_make_negotiator")
+    if neg is None:
+        return kinds
+    for node in ast.walk(neg):
+        head: Optional[str] = None
+        if isinstance(node, ast.JoinedStr) and node.values and \
+                isinstance(node.values[0], ast.Constant):
+            head = str(node.values[0].value)
+        elif isinstance(node, ast.Constant) and isinstance(node.value, str):
+            head = node.value
+        if head is not None:
+            m = re.match(r"^([a-z]) ", head)
+            if m:
+                kinds.add(m.group(1))
+    return kinds
+
+
+def _dtype_table(native_tree: ast.AST) -> List[str]:
+    """The _DTYPES wire-dtype table of native_engine.py, as dtype-name
+    strings in code order (incl. the ml_dtypes.bfloat16 append)."""
+    names: List[str] = []
+    for node in ast.walk(native_tree):
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 and \
+                isinstance(node.targets[0], ast.Name) and \
+                node.targets[0].id == "_DTYPES" and \
+                isinstance(node.value, ast.List):
+            for elt in node.value.elts:
+                # np.dtype(np.float32) -> "float32"
+                if isinstance(elt, ast.Call) and elt.args and \
+                        isinstance(elt.args[0], ast.Attribute):
+                    names.append(elt.args[0].attr.rstrip("_"))
+    # The conditional append: _DTYPES.append(np.dtype(ml_dtypes.bfloat16))
+    for node in ast.walk(native_tree):
+        if isinstance(node, ast.Call) and \
+                isinstance(node.func, ast.Attribute) and \
+                node.func.attr == "append" and \
+                isinstance(node.func.value, ast.Name) and \
+                node.func.value.id == "_DTYPES" and node.args:
+            inner = node.args[0]
+            if isinstance(inner, ast.Call) and inner.args and \
+                    isinstance(inner.args[0], ast.Attribute):
+                names.append(inner.args[0].attr.rstrip("_"))
+    return names
+
+
+def _wire_policies(engine_tree: ast.AST) -> List[str]:
+    """ENGINE_WIRE_POLICIES from core/engine.py (code = index)."""
+    for node in ast.walk(engine_tree):
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 and \
+                isinstance(node.targets[0], ast.Name) and \
+                node.targets[0].id == "ENGINE_WIRE_POLICIES" and \
+                isinstance(node.value, (ast.Tuple, ast.List)):
+            return [e.value for e in node.value.elts
+                    if isinstance(e, ast.Constant)]
+    return []
+
+
+def _ops_table(native_tree: ast.AST) -> Dict[str, int]:
+    for node in ast.walk(native_tree):
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 and \
+                isinstance(node.targets[0], ast.Name) and \
+                node.targets[0].id == "_OPS" and \
+                isinstance(node.value, ast.Dict):
+            return {k.value: v.value for k, v in
+                    zip(node.value.keys, node.value.values)
+                    if isinstance(k, ast.Constant)
+                    and isinstance(v, ast.Constant)}
+    return {}
+
+
+def check(root: str,
+          cc_path: Optional[str] = None,
+          engine_path: Optional[str] = None,
+          native_path: Optional[str] = None,
+          bufferpool_path: Optional[str] = None,
+          timeline_path: Optional[str] = None) -> List[Finding]:
+    core = os.path.join(root, "horovod_tpu", "core")
+    cc_path = cc_path or os.path.join(core, "native", "hvdcore.cc")
+    engine_path = engine_path or os.path.join(core, "engine.py")
+    native_path = native_path or os.path.join(core, "native_engine.py")
+    bufferpool_path = bufferpool_path or os.path.join(core, "bufferpool.py")
+    timeline_path = timeline_path or os.path.join(core, "timeline.py")
+
+    cc_rel = os.path.relpath(cc_path, root)
+    native_rel = os.path.relpath(native_path, root)
+    engine_rel = os.path.relpath(engine_path, root)
+
+    src = open(cc_path).read()
+    engine_tree = ast.parse(open(engine_path).read(), filename=engine_path)
+    native_tree = ast.parse(open(native_path).read(), filename=native_path)
+    pool_tree = ast.parse(open(bufferpool_path).read(),
+                          filename=bufferpool_path)
+    tl_tree = ast.parse(open(timeline_path).read(), filename=timeline_path)
+
+    findings: List[Finding] = []
+
+    # -- telemetry counters ------------------------------------------------
+    # Python engine's full surface: everything engine.py + bufferpool.py
+    # emit. Native-fed surface: native_engine.py's own emits + the
+    # _STAT_COUNTERS stats sync + the shared engine.py helpers it
+    # imports + bufferpool.py (the native engine's python-side pool).
+    py_set = _registry_names(engine_tree) | _registry_names(pool_tree)
+    engine_fns = _function_defs(engine_tree)
+    shared: Set[str] = set()
+    for helper in _imported_engine_helpers(native_tree):
+        fn = engine_fns.get(helper)
+        if fn is not None:
+            shared |= _registry_names(fn)
+    stat_counters = _stat_counters(native_tree)
+    native_set = (_registry_names(native_tree) | shared
+                  | _registry_names(pool_tree)
+                  | {name for name, _, _ in stat_counters})
+    for name in sorted(py_set - native_set):
+        findings.append(Finding(
+            "parity-counters", engine_rel, 0,
+            f"telemetry name {name!r} is fed by the python engine but "
+            "has no native-engine feed (stats sync, shared helper, or "
+            "direct emit)"))
+    for name in sorted(native_set - py_set):
+        findings.append(Finding(
+            "parity-counters", native_rel, 0,
+            f"telemetry name {name!r} is fed by the native engine but "
+            "never by the python engine"))
+
+    # -- stats-sync fields exist in the C struct ---------------------------
+    stats_fields = {f.name for f in
+                    cparse.parse_structs(src).get("hvd_engine_stats", [])}
+    for reg_name, field, line in stat_counters:
+        if field not in stats_fields:
+            findings.append(Finding(
+                "parity-stats-fields", native_rel, line,
+                f"_STAT_COUNTERS maps {reg_name!r} to stats field "
+                f"{field!r}, which struct hvd_engine_stats does not "
+                "declare"))
+
+    # -- timeline span vocabulary ------------------------------------------
+    tl_consts = _timeline_constants(tl_tree)
+    py_spans = set(tl_consts.values())
+    # engine.py's f"NEGOTIATE_{e.op.upper()}" expands over the op table.
+    for op in ("allreduce", "allgather", "broadcast"):
+        py_spans.add(f"NEGOTIATE_{op.upper()}")
+    cc_spans = {lit for lit, _ in cparse.string_literals(src)
+                if re.fullmatch(r"[A-Z][A-Z_]{2,}", lit)
+                and not lit.startswith("HVD_")}  # HVD_* = env knobs
+    for span in sorted(cc_spans - py_spans):
+        findings.append(Finding(
+            "parity-spans", cc_rel, 0,
+            f"C++ timeline span {span!r} has no counterpart constant in "
+            "core/timeline.py"))
+    for span in sorted((py_spans - PY_ONLY_SPANS) - cc_spans):
+        findings.append(Finding(
+            "parity-spans", cc_rel, 0,
+            f"python timeline span {span!r} is never written by the C++ "
+            "timeline (only RANK_READY/HVD_CLOCK may ride the python-"
+            "side hooks)"))
+
+    # -- span-args keys ----------------------------------------------------
+    py_keys = _py_span_arg_keys(engine_tree)
+    cc_keys = _cc_span_arg_keys(src) - PASS_THROUGH_ARG_KEYS
+    for key in sorted(cc_keys - py_keys):
+        findings.append(Finding(
+            "parity-span-args", cc_rel, 0,
+            f"C++ span-args key {key!r} is never emitted by the python "
+            "engine's timeline calls"))
+    for key in sorted((py_keys - PASS_THROUGH_ARG_KEYS) - cc_keys):
+        findings.append(Finding(
+            "parity-span-args", engine_rel, 0,
+            f"python span-args key {key!r} is never emitted by the C++ "
+            "timeline writer"))
+
+    # -- negotiation decision grammar --------------------------------------
+    emitted = _decision_kinds_emitted(native_tree)
+    handled = set(cparse.decision_kinds_handled(src))
+    for kind in sorted(emitted - handled):
+        findings.append(Finding(
+            "parity-grammar", native_rel, 0,
+            f"decision line kind {kind!r} is emitted by the python "
+            "negotiator but not handled by hvdcore's ParseAndExecute"))
+    for kind in sorted(handled - emitted):
+        findings.append(Finding(
+            "parity-grammar", cc_rel, 0,
+            f"decision line kind {kind!r} is handled by hvdcore's "
+            "ParseAndExecute but never emitted by the python negotiator"))
+
+    # -- dtype-name table --------------------------------------------------
+    cc_dtypes = cparse.parse_string_array(src, "DtypeName")
+    py_dtypes = _dtype_table(native_tree)
+    if cc_dtypes != py_dtypes:
+        findings.append(Finding(
+            "parity-dtypes", cc_rel, 0,
+            f"C++ dtype table {cc_dtypes} does not match "
+            f"native_engine._DTYPES {py_dtypes} — codes are positional, "
+            "a skew mislabels every timeline dtype arg"))
+
+    # -- wire-policy codes -------------------------------------------------
+    cc_wire = cparse.parse_case_string_map(src, "WireName")
+    py_wire = _wire_policies(engine_tree)
+    expect_wire = {i: name for i, name in enumerate(py_wire)
+                   if name != "none"}
+    if cc_wire != expect_wire:
+        findings.append(Finding(
+            "parity-wire-codes", cc_rel, 0,
+            f"C++ WireName map {cc_wire} does not match "
+            f"ENGINE_WIRE_POLICIES {py_wire} (expected {expect_wire}; "
+            "code 0 = full width, no arg)"))
+
+    # -- op codes ----------------------------------------------------------
+    cc_ops = cparse.parse_enum(src, "HvdOp")
+    py_ops = _ops_table(native_tree)
+    expect_ops = {f"HVD_{name.upper()}": code
+                  for name, code in py_ops.items()}
+    for name, code in expect_ops.items():
+        if cc_ops.get(name) != code:
+            findings.append(Finding(
+                "parity-ops", cc_rel, 0,
+                f"HvdOp.{name} is {cc_ops.get(name)} in C++ but "
+                f"native_engine._OPS says {code}"))
+    return findings

@@ -975,11 +975,16 @@ class Engine:
         stage_us = int(getattr(self.executor, "last_stage_s", 0.0) * 1e6)
         split = min(t0_us + stage_us, t1)
         for e in entries:
+            args = {"dtype": str(e.tensor.dtype),
+                    "shape": list(e.tensor.shape)}
+            if e.compression not in ("", "none"):
+                # Wire-policy attribution, matching the C++ writer's
+                # TensorArgs (no arg at full width) — hvdcheck
+                # parity-span-args pins the two vocabularies together.
+                args["wire"] = e.compression
             self.timeline.start(e.name, tl.WAIT_FOR_DATA, ts_us=t0_us)
             self.timeline.end(e.name, tl.WAIT_FOR_DATA, ts_us=split)
-            self.timeline.start(e.name, activity,
-                                {"dtype": str(e.tensor.dtype),
-                                 "shape": list(e.tensor.shape)}, ts_us=split)
+            self.timeline.start(e.name, activity, args, ts_us=split)
             self.timeline.end(e.name, activity, ts_us=t1)
 
     def _exec_allreduce_batch(self, batch):

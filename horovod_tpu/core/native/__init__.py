@@ -13,6 +13,7 @@ import ctypes
 import os
 import subprocess
 import threading
+from typing import Optional
 
 _DIR = os.path.dirname(os.path.abspath(__file__))
 _SRC = os.path.join(_DIR, "hvdcore.cc")
@@ -21,29 +22,84 @@ _LIB = os.path.join(_DIR, "libhvdcore.so")
 _lock = threading.Lock()
 _lib = None
 
+# Default build promoted to -Wall -Wextra -Werror (hvdcheck satellite):
+# the engine core compiles warning-clean, and a new warning is a build
+# failure the commit it lands in, not reviewer homework.
+_BASE_FLAGS = ["-std=c++17", "-fPIC", "-shared", "-pthread",
+               "-Wall", "-Wextra", "-Werror"]
+
+# HVD_SANITIZE={thread,address} rebuild modes. Each mode publishes its
+# own artifact next to the source (the default lib is never clobbered
+# by a sanitized build, so flipping the env var back costs nothing).
+# -O1 -fno-omit-frame-pointer is the sanitizer-recommended pairing:
+# usable stacks, tolerable slowdown.
+_SANITIZE_MODES = {
+    "": ([], _LIB, ["-O2", "-g"]),
+    "thread": (["-fsanitize=thread", "-fno-omit-frame-pointer"],
+               os.path.join(_DIR, "libhvdcore.tsan.so"), ["-O1", "-g"]),
+    "address": (["-fsanitize=address", "-fno-omit-frame-pointer"],
+                os.path.join(_DIR, "libhvdcore.asan.so"), ["-O1", "-g"]),
+}
+
+# TSan suppressions for the Python-hosted run (tests + LD_PRELOAD
+# recipe in docs/static-analysis.md). The engine code itself must stay
+# report-clean — these only quiet runtime noise from non-instrumented
+# host code.
+TSAN_SUPPRESSIONS = os.path.join(_DIR, "tsan.supp")
+
 
 class NativeBuildError(RuntimeError):
     pass
 
 
-def build_library(force: bool = False) -> str:
-    """Compile libhvdcore.so if missing or stale. Returns the path."""
+def sanitize_mode() -> str:
+    """The HVD_SANITIZE build mode ('', 'thread' or 'address'); unknown
+    spellings fail fast rather than silently building unsanitized."""
+    mode = os.environ.get("HVD_SANITIZE", "").strip().lower()
+    if mode in ("0", "off", "none", "false"):
+        mode = ""
+    if mode not in _SANITIZE_MODES:
+        raise NativeBuildError(
+            f"unknown HVD_SANITIZE mode {mode!r}: expected 'thread' or "
+            "'address'")
+    return mode
+
+
+def sanitizer_runtime(mode: str = "thread") -> str:
+    """Path to the sanitizer runtime to LD_PRELOAD when loading a
+    sanitized libhvdcore into an UNinstrumented interpreter (loading it
+    bare fails with a static-TLS error). Resolved through the same
+    compiler that builds the library."""
+    name = {"thread": "libtsan.so", "address": "libasan.so"}[mode]
+    proc = subprocess.run(["g++", f"-print-file-name={name}"],
+                          capture_output=True, text=True)
+    path = proc.stdout.strip()
+    if proc.returncode != 0 or not os.path.exists(path):
+        raise NativeBuildError(f"cannot locate {name} via g++")
+    return os.path.realpath(path)
+
+
+def build_library(force: bool = False, mode: Optional[str] = None) -> str:
+    """Compile the engine library if missing or stale; returns the path.
+    ``mode`` overrides HVD_SANITIZE ('' = the plain production build)."""
+    mode = sanitize_mode() if mode is None else mode
+    san_flags, out, opt_flags = _SANITIZE_MODES[mode]
     with _lock:
-        if (not force and os.path.exists(_LIB)
-                and os.path.getmtime(_LIB) >= os.path.getmtime(_SRC)):
-            return _LIB
+        if (not force and os.path.exists(out)
+                and os.path.getmtime(out) >= os.path.getmtime(_SRC)):
+            return out
         # pid-suffixed temp: concurrent processes (multi-controller first
         # run on a shared filesystem) must not compile into the same file;
         # os.replace makes the final publish atomic whoever wins.
-        tmp = f"{_LIB}.tmp.{os.getpid()}.so"
-        cmd = ["g++", "-O2", "-g", "-std=c++17", "-fPIC", "-shared",
-               "-pthread", "-Wall", _SRC, "-o", tmp]
+        tmp = f"{out}.tmp.{os.getpid()}.so"
+        cmd = (["g++"] + opt_flags + _BASE_FLAGS + san_flags
+               + [_SRC, "-o", tmp])
         proc = subprocess.run(cmd, capture_output=True, text=True)
         if proc.returncode != 0:
             raise NativeBuildError(
                 f"failed to build libhvdcore: {proc.stderr[-2000:]}")
-        os.replace(tmp, _LIB)
-        return _LIB
+        os.replace(tmp, out)
+        return out
 
 
 _SHIELD_SRC = os.path.join(_DIR, "termshield.cc")
@@ -63,8 +119,8 @@ def load_termshield():
                 and os.path.getmtime(_SHIELD_LIB)
                 >= os.path.getmtime(_SHIELD_SRC)):
             tmp = f"{_SHIELD_LIB}.tmp.{os.getpid()}.so"
-            cmd = ["g++", "-O2", "-std=c++17", "-fPIC", "-shared",
-                   "-pthread", "-Wall", _SHIELD_SRC, "-o", tmp, "-ldl"]
+            cmd = (["g++", "-O2"] + _BASE_FLAGS
+                   + [_SHIELD_SRC, "-o", tmp, "-ldl"])
             proc = subprocess.run(cmd, capture_output=True, text=True)
             if proc.returncode != 0:
                 raise NativeBuildError(

@@ -46,6 +46,37 @@ double SecondsSince(Clock::time_point t0) {
   return std::chrono::duration<double>(Clock::now() - t0).count();
 }
 
+// Timed condition-variable wait that stays visible to ThreadSanitizer.
+//
+// Under a plain build this is cv.wait_for. Under -fsanitize=thread the
+// steady-clock wait_for lowers to pthread_cond_clockwait (glibc >= 2.30
+// via libstdc++), which this toolchain's TSan does NOT intercept: the
+// mutex release/re-acquire inside the wait becomes invisible, every
+// happens-before edge through the engine mutex is lost, and TSan
+// reports hundreds of false races "between two threads both holding
+// mu_". Routing the sanitized build through wait_until(system_clock)
+// keeps the wait on the intercepted pthread_cond_timedwait path. The
+// system clock can step mid-wait, but engine waits are milliseconds
+// and only pace the loop — and this variant exists only inside
+// sanitizer builds (HVD_SANITIZE), never in production ones.
+#if defined(__SANITIZE_THREAD__)
+template <class Pred>
+bool WaitFor(std::condition_variable& cv, std::unique_lock<std::mutex>& lk,
+             double seconds, Pred pred) {
+  return cv.wait_until(
+      lk,
+      std::chrono::system_clock::now() +
+          std::chrono::microseconds((long long)(seconds * 1e6)),
+      pred);
+}
+#else
+template <class Pred>
+bool WaitFor(std::condition_variable& cv, std::unique_lock<std::mutex>& lk,
+             double seconds, Pred pred) {
+  return cv.wait_for(lk, std::chrono::duration<double>(seconds), pred);
+}
+#endif
+
 // ---------------------------------------------------------------------------
 // C ABI shared with Python (ctypes)
 // ---------------------------------------------------------------------------
@@ -403,6 +434,12 @@ const char* WireName(int wire) {
 // Pre-rendered args body for timeline events — dtype + shape (+ the wire
 // policy when one applies), the detail the reference writer records
 // (timeline.cc:98-188).
+//
+// FORMATTING CONTRACT (hvdcheck parity-span-args): span-args bodies put
+// a space after the colon (`"dtype": ...`), and every other JSON this
+// file renders (the chrome event skeleton, the negotiation table) does
+// not — that convention is how the analyzer tells span-args keys apart
+// from wire-protocol keys when diffing the two engines' vocabularies.
 std::string TensorArgs(int dtype_num, const std::vector<long long>& shape,
                        int wire = 0) {
   std::string out = "\"dtype\": \"";
@@ -839,8 +876,8 @@ class Engine {
         // from the control plane's 'w' backoff folded into `cycle` above,
         // not from a different wait here. A fresh enqueue or shutdown
         // cuts either mode's sleep short.
-        cv_.wait_for(lk, std::chrono::duration<double>(cycle),
-                     [&] { return shutdown_ || !queue_.empty(); });
+        WaitFor(cv_, lk, cycle,
+                [&] { return shutdown_ || !queue_.empty(); });
         // On shutdown, leave queued entries for the failure drain below:
         // executing them could call into Python during teardown.
         if (shutdown_) break;
@@ -1355,9 +1392,7 @@ class Engine {
     while (true) {
       {
         std::unique_lock<std::mutex> lk(mu_);
-        if (cv_.wait_for(lk, std::chrono::duration<double>(interval),
-                         [&] { return shutdown_; }))
-          return;
+        if (WaitFor(cv_, lk, interval, [&] { return shutdown_; })) return;
       }
       if (stall_s_ <= 0) continue;
       if (SecondsSince(last_warn) < stall_s_ && last_warn != Clock::time_point{})

@@ -683,8 +683,45 @@ def test_chaos_frozen_heartbeat(engine, tmp_path):
     no-show ('lease expired', not 'grace' or 'vanished'), the survivor
     shrinks and keeps training, the supervisor kills the wedged process
     and its death report names the active injections, and the
-    survivor's flight dumps attribute its own injected faults."""
-    edir = str(tmp_path / f"elastic_fz_{engine}")
+    survivor's flight dumps attribute its own injected faults.
+
+    De-flake policy: these two variants are load-sensitive at ANY
+    revision on this one-core host (documented in CLAUDE.md) — the
+    whole scenario paces three processes against 5 s leases, so a noisy
+    neighbor can starve a heartbeat or the post-rejoin consistency
+    digest past its window. They get ONE automatic same-process retry
+    with a loud note; a double failure is a real regression."""
+    try:
+        _frozen_heartbeat_scenario(engine, str(tmp_path / "try1"))
+    except (AssertionError, subprocess.TimeoutExpired) as exc:
+        print(f"\n[RETRY] chaos frozen-heartbeat ({engine}) failed its "
+              f"first attempt — retrying once in-process; a second "
+              f"failure is a real regression. First failure: "
+              f"{str(exc)[:500]}", file=sys.stderr, flush=True)
+        # A timed-out attempt SIGKILLed only the launcher: its rank
+        # workers keep training and would starve the retry's 5 s leases
+        # on this one-core host (the stale-world hazard conftest guards
+        # against). Reap them before going again.
+        _reap_stray_world_children()
+        _frozen_heartbeat_scenario(engine, str(tmp_path / "try2"))
+
+
+def _reap_stray_world_children():
+    """SIGKILL leftover rank/launcher processes from a failed chaos
+    attempt (cmdline-marked, never an ancestor of this process), then
+    give the scheduler a beat. Best-effort: /proc races are fine."""
+    import conftest
+
+    for pid, _cmd in conftest._stale_world_processes():
+        try:
+            os.kill(pid, signal.SIGKILL)
+        except OSError:
+            pass
+    time.sleep(1.0)
+
+
+def _frozen_heartbeat_scenario(engine, base_dir):
+    edir = os.path.join(base_dir, f"elastic_fz_{engine}")
     os.makedirs(edir)
     env = _clean_env({
         "HVD_ENGINE": engine,
