@@ -15,6 +15,7 @@ from __future__ import annotations
 
 import ast
 import os
+import re
 import sys
 from typing import Dict, Iterable, List, Optional, Set, Tuple
 
@@ -428,6 +429,160 @@ def check_lock_order(trees: Dict[str, ast.AST]) -> List[Finding]:
 
 
 # ---------------------------------------------------------------------------
+# Rule: fault-site-registry
+# ---------------------------------------------------------------------------
+
+# A faultline SPEC reference: site:mode:count with the real grammar's
+# count shapes (N / * / P%, optional @M) — the count anchor is what keeps
+# prose like "docs/running.md:32" from matching. Sites are dotted
+# lowercase words, exactly as core/faultline.py declares them.
+_FAULT_SPEC_RE = re.compile(
+    r"\b([a-z_]+(?:\.[a-z_]+)+):([a-z]+):(\*|\d+%?)(?:@\d+)?")
+
+# Text surfaces where chaos specs are referenced (beyond the python
+# files the invariant scan already walks).
+_FAULT_DOC_GLOBS = ("docs", "CLAUDE.md")
+
+
+def _fault_registry(root: str):
+    """Parse SITES, _MODES and the site->guard-helper map out of
+    core/faultline.py. None when the file is absent (fixture roots)."""
+    path = os.path.join(root, "horovod_tpu", "core", "faultline.py")
+    if not os.path.exists(path):
+        return None
+    tree = _parse(path)
+    if tree is None:
+        return None
+    sites: Tuple[str, ...] = ()
+    modes: Dict[str, Tuple[str, ...]] = {}
+    for node in tree.body:  # type: ignore[attr-defined]
+        if not (isinstance(node, ast.Assign) and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)):
+            continue
+        tgt = node.targets[0].id
+        if tgt == "SITES" and isinstance(node.value, (ast.Tuple, ast.List)):
+            sites = tuple(e.value for e in node.value.elts
+                          if isinstance(e, ast.Constant))
+        elif tgt == "_MODES" and isinstance(node.value, ast.Dict):
+            for k, v in zip(node.value.keys, node.value.values):
+                if isinstance(k, ast.Constant) and \
+                        isinstance(v, (ast.Tuple, ast.List)):
+                    modes[k.value] = tuple(
+                        e.value for e in v.elts
+                        if isinstance(e, ast.Constant))
+    helpers: Dict[str, str] = {}  # site -> guard helper function name
+    for fn in ast.walk(tree):
+        if not isinstance(fn, ast.FunctionDef):
+            continue
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Call) and \
+                    _attr_name(node.func) == "check" and node.args and \
+                    isinstance(node.args[0], ast.Constant) and \
+                    node.args[0].value in sites:
+                helpers.setdefault(node.args[0].value, fn.name)
+    return sites, modes, helpers
+
+
+def check_fault_sites(root: str) -> List[Finding]:
+    """Every faultline site string referenced in tests/docs/specs must
+    resolve to a site (and mode) the registry declares, and every
+    declared site must actually be THREADED — its guard helper called
+    from real source outside faultline.py. A renamed or unthreaded site
+    would otherwise turn the chaos tests that reference it inert while
+    they keep passing."""
+    reg = _fault_registry(root)
+    if reg is None:
+        return []
+    sites, modes, helpers = reg
+    rel_flt = os.path.join("horovod_tpu", "core", "faultline.py")
+    findings: List[Finding] = []
+    for site in sites:
+        if site not in helpers:
+            findings.append(Finding(
+                "fault-site-registry", rel_flt, 0,
+                f"fault site {site!r} is declared in SITES but has no "
+                "check(\"<site>\") guard helper in faultline.py"))
+    # Threading: each guard helper must be invoked from non-faultline
+    # source (horovod_tpu/ only — tests exercising a helper directly do
+    # not make the site threaded in the product).
+    called: Set[str] = set()
+    pkg = os.path.join(root, "horovod_tpu")
+    for dirpath, dirnames, filenames in os.walk(pkg):
+        dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+        for fn in filenames:
+            if not fn.endswith(".py") or fn == "faultline.py":
+                continue
+            tree = _parse(os.path.join(dirpath, fn))
+            if tree is None:
+                continue
+            for node in ast.walk(tree):
+                if isinstance(node, ast.Call):
+                    name = _attr_name(node.func)
+                    if name:
+                        called.add(name)
+    for site, helper in sorted(helpers.items()):
+        if helper not in called:
+            findings.append(Finding(
+                "fault-site-registry", rel_flt, 0,
+                f"fault site {site!r} has a guard helper {helper}() "
+                "that is never called from horovod_tpu/ source — the "
+                "site is declared but not threaded, so chaos specs "
+                "naming it inject nothing"))
+    # Spec references: python files under the scanned trees plus the
+    # markdown docs; every site:mode:count string must resolve.
+    scan = list(_iter_py_files(root))
+    docs_dir = os.path.join(root, _FAULT_DOC_GLOBS[0])
+    if os.path.isdir(docs_dir):
+        scan += [os.path.join(docs_dir, f)
+                 for f in sorted(os.listdir(docs_dir))
+                 if f.endswith(".md")]
+    claude = os.path.join(root, _FAULT_DOC_GLOBS[1])
+    if os.path.exists(claude):
+        scan.append(claude)
+    for path in scan:
+        try:
+            text = open(path).read()
+        except OSError:
+            continue
+        rel = os.path.relpath(path, root)
+        # Negative-grammar fixtures: a function that references
+        # FaultSpecError is TESTING rejection — the deliberately-invalid
+        # specs inside it are not site references.
+        exempt: List[Tuple[int, int]] = []
+        if path.endswith(".py"):
+            tree = _parse(path)
+            if tree is not None:
+                for fn in ast.walk(tree):
+                    if isinstance(fn, ast.FunctionDef) and any(
+                            isinstance(n, (ast.Name, ast.Attribute))
+                            and _attr_name(n) == "FaultSpecError"
+                            for n in ast.walk(fn)):
+                        last = max((getattr(n, "lineno", fn.lineno)
+                                    for n in ast.walk(fn)),
+                                   default=fn.lineno)
+                        exempt.append((fn.lineno, last))
+        for m in _FAULT_SPEC_RE.finditer(text):
+            site, mode = m.group(1), m.group(2)
+            line = text.count("\n", 0, m.start()) + 1
+            if any(a <= line <= b for a, b in exempt):
+                continue
+            if site not in sites:
+                findings.append(Finding(
+                    "fault-site-registry", rel, line,
+                    f"fault spec references site {site!r}, which "
+                    "core/faultline.py SITES does not declare — a "
+                    "renamed site silently turns this chaos spec "
+                    "inert"))
+            elif mode not in modes.get(site, ()):
+                findings.append(Finding(
+                    "fault-site-registry", rel, line,
+                    f"fault spec references mode {mode!r} for site "
+                    f"{site!r}; valid modes: "
+                    f"{', '.join(modes.get(site, ()))}"))
+    return findings
+
+
+# ---------------------------------------------------------------------------
 # Rule: entrypoint-imports
 # ---------------------------------------------------------------------------
 
@@ -509,4 +664,5 @@ def check(root: str,
                 lock_trees[rel] = tree
     findings.extend(check_lock_order(lock_trees))
     findings.extend(check_entrypoint_imports(root, entrypoints))
+    findings.extend(check_fault_sites(root))
     return findings

@@ -65,6 +65,10 @@ RULE_CATALOG: Dict[str, str] = {
                   "hierarchy: engine lock > pool lock > telemetry locks",
     "entrypoint-imports": "bench.py and run.py must stay import-free at "
                           "module level (stdlib only)",
+    "fault-site-registry": "every faultline site referenced in "
+                           "tests/docs/specs must resolve to a declared "
+                           "site+mode, and every declared site must be "
+                           "threaded (its guard called from source)",
 }
 
 

@@ -935,6 +935,7 @@ class ElasticWorld:
         old_epoch, old_np = self.epoch, self.nproc
         self._mark_reconfigure_on_timeline()
         self._abandon_engine_if_wedged()
+        self._quiesce_engine_bounded()
         from horovod_tpu.common import topology as topo
 
         LOG.warning("elastic shrink: draining the engine and tearing "
@@ -957,6 +958,7 @@ class ElasticWorld:
         _coord.set_world_epoch(self.epoch)
         self._write_journal("shrink", lost=dead_list)
         self._publish_gauges()
+        self._clear_draining_marker()
         _tele.REGISTRY.counter("world.reconfigures").inc()
         reason = (f"RECONFIGURE: world epoch {old_epoch} -> {self.epoch}; "
                   f"lost process(es) {dead_list} "
@@ -1024,6 +1026,7 @@ class ElasticWorld:
             addr = rec["addr"]
         self._mark_reconfigure_on_timeline()
         self._abandon_engine_if_wedged()
+        self._quiesce_engine_bounded()
         from horovod_tpu.common import topology as topo
 
         LOG.warning("elastic shrink: draining the engine and tearing "
@@ -1060,6 +1063,7 @@ class ElasticWorld:
             self._write_journal("shrink_multi", lost=dead_list,
                                 survivors=survivors)
         self._publish_gauges()
+        self._clear_draining_marker()
         _tele.REGISTRY.counter("world.reconfigures").inc()
         reason = (f"RECONFIGURE: world epoch {old_epoch} -> {self.epoch};"
                   f" lost process(es) {dead_list} "
@@ -1114,6 +1118,32 @@ class ElasticWorld:
         bring_up_distributed(addr, num_processes, process_id,
                              init_timeout_s=rebuild_timeout_s())
         return jax.devices()
+
+    def _quiesce_engine_bounded(self):
+        """Politeness drain before the shrink teardown (the quiesce
+        plane, core/engine.py): close admission so nothing new rides
+        into a world being torn down, give in-flight work one bounded
+        chance to finish, and log what drained vs what was wedged
+        behind the dead peer. No-op when the engine was already
+        abandoned (its singleton is gone)."""
+        from horovod_tpu.core import engine as _eng
+
+        rep = _eng.quiesce_engine(1.0, reason="elastic shrink")
+        if rep is not None:
+            LOG.info("elastic shrink: engine quiesce report: %s", rep)
+
+    def _clear_draining_marker(self):
+        """A shrink SURVIVES: the quiesce above marked this process
+        draining (/healthz non-200), but the successor world is live —
+        clear the marker so the degraded-world signal (world.degraded)
+        is the only health downgrade left standing."""
+        try:
+            from horovod_tpu.core import sentinel as _sentinel
+
+            _sentinel.note_draining(None)
+            _tele.REGISTRY.gauge("engine.draining").set(0)
+        except Exception:
+            pass
 
     def _abandon_engine_if_wedged(self):
         """After a KV-plane failover the engine's control plane is

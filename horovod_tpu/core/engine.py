@@ -146,6 +146,42 @@ class ShutdownError(EngineError):
     SHUT_DOWN_ERROR, operations.cc:1833-1848)."""
 
 
+class CollectiveTimeout(EngineError):
+    """A per-request deadline fired before the collective completed. The
+    message names the PHASE the entry was stuck in (QUEUE / NEGOTIATE /
+    ALLREDUCE / ...) and its age — fail fast with attribution instead of
+    waiting out the global negotiation timeout. The entry itself may
+    still be in flight (a wedged executor call cannot be interrupted);
+    only the waiter is released, and an eventual late completion is
+    discarded."""
+
+
+class CancelledError(EngineError):
+    """The collective was cooperatively cancelled (``cancel(handle)``).
+    Pre-announce entries retire locally without executing; entries
+    already announced to peers (or already executing) complete
+    cross-rank — a fused/negotiated batch cannot be torn — and their
+    result is discarded, so negotiation coherence is preserved by
+    construction."""
+
+
+def collective_deadline_from_env() -> Optional[float]:
+    """HVD_COLLECTIVE_DEADLINE_S: the engine-wide default per-request
+    deadline (seconds); per-request ``deadline_ms`` overrides it. Unset,
+    empty or <= 0 means no default — and the deadline plane then adds
+    ZERO hot-path work (the sweep short-circuits on a zero count)."""
+    raw = (os.environ.get("HVD_COLLECTIVE_DEADLINE_S") or "").strip()
+    if not raw:
+        return None
+    try:
+        val = float(raw)
+    except ValueError:
+        raise EngineError(
+            f"bad HVD_COLLECTIVE_DEADLINE_S {raw!r} on {_process_str()}: "
+            "want seconds (a float)") from None
+    return val if val > 0 else None
+
+
 @dataclass
 class _Entry:
     handle: int
@@ -166,6 +202,15 @@ class _Entry:
     # Processes whose announcement of this tensor has been marked on the
     # timeline (RANK_READY instants inside the NEGOTIATE_* span).
     ready_marked: set = field(default_factory=set)
+    # Deadline/cancel plane: absolute monotonic deadline (None = none),
+    # the phase the entry is currently stuck in (QUEUE -> NEGOTIATE ->
+    # ALLREDUCE/ALLGATHER/BROADCAST — the CollectiveTimeout attribution),
+    # whether the deadline already failed the waiter, and whether a
+    # cooperative cancel is pending.
+    deadline: Optional[float] = None
+    phase: str = tl.QUEUE
+    fired: bool = False
+    cancelled: bool = False
 
 
 class _Handle:
@@ -549,6 +594,13 @@ class Engine:
         # Engine-wide default wire format (HVD_COMPRESSION); per-request
         # policies override it at submit. Fails fast on misspellings.
         self.wire_default = wire_policy_from_env()
+        # Deadline/cancel/drain plane: the engine-wide default deadline
+        # (HVD_COLLECTIVE_DEADLINE_S), the count of in-flight entries
+        # carrying a deadline (the sweep's zero-cost short circuit), and
+        # the quiesce reason once admission is closed.
+        self.default_deadline_s = collective_deadline_from_env()
+        self._deadline_count = 0
+        self._quiesced: Optional[str] = None
         self.timeline = timeline if timeline is not None else tl.from_env()
         if self.timeline.enabled:
             # Staging time feeds the WAIT_FOR_DATA spans; only measured
@@ -562,6 +614,10 @@ class Engine:
         self._next_handle = 0
         self._shutdown = threading.Event()
         self._wake = threading.Event()  # enqueue cuts idle sleeps short
+        # Submitting a deadline'd entry breaks the watchdog's (possibly
+        # 12 s) idle sleep immediately — the tightened sweep tick alone
+        # would only take effect on the NEXT wait. Shutdown sets it too.
+        self._stall_kick = threading.Event()
         self._last_stall_warn = 0.0
         # Negotiated multi-controller path (core/coordinator.py): entries
         # drained but not yet agreed with the peer processes.
@@ -601,6 +657,14 @@ class Engine:
         with self._lock:
             if self._shutdown.is_set():
                 raise ShutdownError("engine is shut down")
+            if self._quiesced is not None:
+                # Admission closed (quiesce): fail FAST with a
+                # descriptive error — new work must not ride into a
+                # draining engine (graceful preemption, elastic shrink).
+                raise EngineError(
+                    f"engine is draining ({self._quiesced}): submissions "
+                    "are closed — the engine is completing in-flight "
+                    "work before shutdown (quiesce)")
             if entry.name in self._pending_names:
                 raise DuplicateNameError(
                     f"a collective named '{entry.name}' is already pending; "
@@ -611,6 +675,9 @@ class Engine:
             self._next_handle += 1
             self._handles[entry.handle] = h
             self._pending_names[entry.name] = entry
+            if entry.deadline is not None:
+                self._deadline_count += 1
+                self._stall_kick.set()
             depth = len(self._pending_names)
         record_submit(entry.op, entry.tensor.nbytes, depth)
         # Numerics (core/numerics.py): the local nonfinite count of the
@@ -664,10 +731,22 @@ class Engine:
                 entry.tensor.flags.writeable = True
             raise
 
+    def _abs_deadline(self, deadline_ms: Optional[float]) -> Optional[float]:
+        """Per-request ``deadline_ms`` (overrides the engine-wide
+        HVD_COLLECTIVE_DEADLINE_S default; <= 0 disables for this
+        request) as an absolute monotonic instant, or None."""
+        if deadline_ms is not None:
+            return (time.monotonic() + deadline_ms / 1000.0
+                    if deadline_ms > 0 else None)
+        if self.default_deadline_s is not None:
+            return time.monotonic() + self.default_deadline_s
+        return None
+
     def allreduce_async(self, name: str, tensor: np.ndarray, average: bool,
                         prescale: float = 1.0,
                         compression: Optional[str] = None,
-                        donate: bool = False) -> int:
+                        donate: bool = False,
+                        deadline_ms: Optional[float] = None) -> int:
         # `compression` is the per-request engine wire policy (frontend
         # Compression objects carry it as .engine_wire); None defers to
         # the HVD_COMPRESSION default.
@@ -676,22 +755,127 @@ class Engine:
         snap, donated, flipped, span = self._snapshot(tensor, donate)
         return self._submit(
             _Entry(-1, name, "allreduce", snap, average=average,
-                   prescale=prescale, compression=wire, donated=donated),
+                   prescale=prescale, compression=wire, donated=donated,
+                   deadline=self._abs_deadline(deadline_ms)),
             span, flipped)
 
     def allgather_async(self, name: str, tensor: np.ndarray,
-                        donate: bool = False) -> int:
+                        donate: bool = False,
+                        deadline_ms: Optional[float] = None) -> int:
         snap, donated, flipped, span = self._snapshot(tensor, donate)
         return self._submit(
-            _Entry(-1, name, "allgather", snap, donated=donated),
+            _Entry(-1, name, "allgather", snap, donated=donated,
+                   deadline=self._abs_deadline(deadline_ms)),
             span, flipped)
 
     def broadcast_async(self, name: str, tensor: np.ndarray, root_rank: int,
-                        donate: bool = False) -> int:
+                        donate: bool = False,
+                        deadline_ms: Optional[float] = None) -> int:
         snap, donated, flipped, span = self._snapshot(tensor, donate)
         return self._submit(
             _Entry(-1, name, "broadcast", snap, root_rank=root_rank,
-                   donated=donated), span, flipped)
+                   donated=donated,
+                   deadline=self._abs_deadline(deadline_ms)),
+            span, flipped)
+
+    # -- deadline / cancel / drain plane --------------------------------------
+
+    def cancel(self, handle: int) -> bool:
+        """Cooperative cancel. Pre-announce entries retire locally at the
+        next cycle without executing; entries already announced to peers
+        (or executing) complete cross-rank and DISCARD their result —
+        either way ``synchronize`` raises :class:`CancelledError`.
+        Returns False when the handle is unknown or already complete."""
+        with self._lock:
+            h = self._handles.get(handle)
+            if h is None or h.event.is_set():
+                return False
+            for e in self._pending_names.values():
+                if e.handle == handle:
+                    e.cancelled = True
+                    break
+            else:
+                return False
+        self._wake.set()  # retire promptly even on an idle engine
+        return True
+
+    def _sweep_deadlines(self):
+        """Fail the waiter of every overdue entry with an attributed
+        :class:`CollectiveTimeout` naming the phase it is stuck in, plus
+        ONE flight dump per sweep. Runs on the loop thread each cycle
+        (QUEUE/NEGOTIATE phases) and on the stall watchdog thread (an
+        executor call the loop is wedged inside). Zero work when no
+        in-flight entry carries a deadline."""
+        if not self._deadline_count:
+            return
+        now = time.monotonic()
+        expired = []
+        with self._lock:
+            for e in self._pending_names.values():
+                if (e.deadline is not None and not e.fired
+                        and now > e.deadline):
+                    e.fired = True
+                    expired.append(e)
+        if not expired:
+            return
+        lines = []
+        for e in expired:
+            age = now - e.enqueued_at
+            err = CollectiveTimeout(
+                f"collective '{e.name}' exceeded its deadline after "
+                f"{age:.2f}s stuck in phase {e.phase} on {_process_str()}"
+                " (the request is abandoned; a late completion will be "
+                "discarded)")
+            tele.REGISTRY.counter("engine.deadline_exceeded").inc()
+            self.timeline.instant(e.name, tl.DEADLINE_EXCEEDED,
+                                  {"phase": e.phase,
+                                   "age_s": round(age, 3)})
+            with self._lock:
+                h = self._handles.get(e.handle)
+            if h is not None and not h.event.is_set():
+                h.error = err
+                h.event.set()
+            lines.append(f"{e.name} (phase {e.phase}, {age:.2f}s)")
+        self._dump_flight("collective deadline exceeded: "
+                          + ", ".join(lines))
+
+    def _cull(self, entries):
+        """Retire cancelled / deadline-fired entries that have NOT been
+        announced to peers yet (local retirement is safe — no peer lists
+        them); returns the survivors in order. Announced entries keep
+        negotiating/executing and discard their result at completion."""
+        live = []
+        for e in entries:
+            if e.cancelled:
+                self._complete(e, None, None)  # -> CancelledError path
+            elif e.fired:
+                self._complete(e, None, CollectiveTimeout(
+                    f"collective '{e.name}' exceeded its deadline in "
+                    f"phase {e.phase}"))
+            else:
+                live.append(e)
+        return live
+
+    def quiesce(self, deadline_s: float,
+                reason: str = "quiesce requested"):
+        """Drain for a graceful exit: close admission (new submits fail
+        fast; ``/healthz`` reports ``draining``), complete negotiated
+        in-flight work, and report what was drained. Bounded by
+        ``deadline_s`` — work wedged behind a dead peer cannot be
+        completed, only reported. Reused by elastic shrink and the
+        graceful-preemption ladder."""
+        with self._lock:
+            already = self._quiesced is not None
+            if not already:
+                self._quiesced = reason
+
+        def _names():
+            with self._lock:
+                return list(self._pending_names)
+
+        return quiesce_drain(reason, deadline_s, already, _names,
+                             self._wake.set,
+                             min(self.cycle_time_s, 0.01))
 
     # -- completion API (reference: handle_manager.cc + mpi_ops_v2.cc poll/
     # wait_and_clear:228-338) -------------------------------------------------
@@ -833,7 +1017,11 @@ class Engine:
         from horovod_tpu.core import coordinator as coord
 
         t_cycle = time.monotonic()
+        entries = self._cull(entries)  # cancel/deadline BEFORE announce
         for e in entries:
+            # Phase attribution reuses the span vocabulary (the C++
+            # sweep spells the same literals — hvdcheck parity-spans).
+            e.phase = f"NEGOTIATE_{e.op.upper()}"
             self.timeline.start(e.name, f"NEGOTIATE_{e.op.upper()}")
         self._negotiating.extend(entries)
         c = self._coordinator
@@ -921,11 +1109,13 @@ class Engine:
 
     def _run_cycle(self):
         t_cycle = time.monotonic()
+        self._sweep_deadlines()
         entries = self._drain()
         self._maybe_build_coordinator()
         if self._coordinator is not None:
             self._negotiated_cycle(entries)
             return
+        entries = self._cull(entries)  # cancelled/overdue: retire locally
         if len(entries) > 1 and _multi_controller():
             # Fallback (negotiation disabled/unavailable): sort each
             # drained cycle by name so thread-racy enqueue order within a
@@ -1037,6 +1227,8 @@ class Engine:
                 if batch[0].prescale != 1.0:
                     flat = flat * batch[0].prescale
             t0 = self.timeline.now_us()
+            for e in batch:
+                e.phase = tl.ALLREDUCE  # deadline attribution: executing
             # Wire policy rides an executor attribute, not a parameter,
             # so custom test executors with the historical two-arg
             # signature keep working (batches are policy-uniform — the
@@ -1068,6 +1260,7 @@ class Engine:
     def _exec_single(self, e: _Entry):
         try:
             t0 = self.timeline.now_us()
+            e.phase = e.op.upper()  # deadline attribution: executing
             if e.op == "allgather":
                 out = self.executor.allgather(e.tensor)
                 record_wire(self.executor)
@@ -1083,9 +1276,22 @@ class Engine:
             self._complete(e, None, EngineError(str(exc)))
 
     def _complete(self, e: _Entry, result, err: Optional[Exception]):
+        if e.cancelled and err is None:
+            # Cooperative cancel: the result (if the entry executed —
+            # post-agreement cancels complete cross-rank) is DISCARDED
+            # and the waiter sees CancelledError. Span + counter are the
+            # cross-engine parity surface (CANCELLED / engine.cancelled).
+            self.timeline.start(e.name, tl.CANCELLED)
+            self.timeline.end(e.name, tl.CANCELLED)
+            tele.REGISTRY.counter("engine.cancelled").inc()
+            result, err = None, CancelledError(
+                f"collective '{e.name}' was cancelled (cooperative "
+                "cancel; result discarded)")
         self.timeline.end(e.name, tl.QUEUE)
         with self._lock:
             self._pending_names.pop(e.name, None)
+            if e.deadline is not None and self._deadline_count > 0:
+                self._deadline_count -= 1
             depth = len(self._pending_names)
             h = self._handles.get(e.handle)
         tele.REGISTRY.counter(
@@ -1096,14 +1302,30 @@ class Engine:
         # a submit-then-wait caller's next enqueue must find the slab
         # free, not race the loop thread for it.
         e.tensor = _RETIRED
-        if h is not None:
+        if h is not None and not h.event.is_set():
+            # A deadline-fired handle was already released with its
+            # attributed CollectiveTimeout — a late completion (the
+            # wedged executor finally returning) must not clobber it.
             h.result = result
             h.error = err
             h.event.set()
 
     def _stall_loop(self):
         interval = max(self.stall_warning_s / 5.0, 0.01)
-        while not self._shutdown.wait(interval):
+        while not self._shutdown.is_set():
+            # Deadline enforcement for entries the LOOP thread cannot
+            # reach (wedged inside an executor call): tighten the tick
+            # while any in-flight entry carries a deadline, so an
+            # exec-stuck collective fails its waiter promptly and not on
+            # the (much coarser) stall-warning cadence. The kick breaks
+            # an already-started coarse sleep the moment a deadline'd
+            # entry is submitted.
+            tick = min(interval, 0.05) if self._deadline_count else interval
+            if self._stall_kick.wait(tick):
+                self._stall_kick.clear()
+            if self._shutdown.is_set():
+                return
+            self._sweep_deadlines()
             self._check_stalls()
 
     def _check_stalls(self):
@@ -1186,6 +1408,7 @@ class Engine:
         self.pool.poison()
         self._shutdown.set()
         self._wake.set()
+        self._stall_kick.set()
         with self._lock:
             handles = list(self._handles.values())
             self._handles.clear()
@@ -1207,6 +1430,7 @@ class Engine:
             self._coordinator.close()
         self._shutdown.set()
         self._wake.set()  # break an idle sleep immediately
+        self._stall_kick.set()
         self._thread.join(timeout=5)
         # If the loop thread was inside _maybe_build_coordinator when the
         # check above ran, the coordinator exists only now. Close it again:
@@ -1262,6 +1486,63 @@ def shutdown_engine():
         if _engine is not None:
             _engine.shutdown()
             _engine = None
+
+
+def quiesce_drain(reason: str, deadline_s: float, already: bool,
+                  pending_names, wake, tick_s: float):
+    """The quiesce policy BOTH engines share (core/native_engine.py
+    calls this too): mark the process draining, bounded-drain until the
+    in-flight table empties, and report NAMES. The report shape — name
+    lists, not counts — and the draining marker/gauge/log wording are
+    part of the engines' same-observable-semantics contract, so they
+    live in exactly one place. ``pending_names`` is each engine's view
+    of its in-flight table; ``wake`` nudges an idle loop (a no-op for
+    the C++ engine, whose loop ticks on its own)."""
+    before = pending_names()
+    tele.REGISTRY.gauge("engine.draining").set(1)
+    try:
+        from horovod_tpu.core import sentinel as _sentinel
+
+        _sentinel.note_draining(reason)
+    except Exception:
+        pass
+    deadline = time.monotonic() + max(0.0, deadline_s)
+    pending = before
+    while pending and time.monotonic() < deadline:
+        wake()
+        time.sleep(tick_s)
+        pending = pending_names()
+    drained = [n for n in before if n not in pending]
+    report = dict(reason=reason, drained=drained,
+                  still_pending=pending,
+                  deadline_hit=bool(pending), already=already)
+    if pending:
+        LOG.warning(
+            "engine quiesce: drained %d of %d in-flight collective(s)"
+            " within %.1fs; still pending: %s", len(drained),
+            len(before), deadline_s, ", ".join(pending))
+    else:
+        LOG.info("engine quiesce: drained %d in-flight collective(s);"
+                 " admission closed (%s)", len(drained), reason)
+    return report
+
+
+def quiesce_engine(deadline_s: float,
+                   reason: str = "quiesce requested"):
+    """Quiesce the engine singleton if one exists: close admission,
+    drain in-flight work within ``deadline_s``, report what drained.
+    Returns the report dict, or None when no engine was ever built.
+    Reused by elastic shrink (a bounded politeness drain before the
+    teardown) and the graceful-preemption ladder."""
+    with _engine_lock:
+        e = _engine
+    if e is None:
+        return None
+    try:
+        return e.quiesce(deadline_s, reason=reason)
+    except Exception:
+        LOG.warning("engine quiesce failed", exc_info=True)
+        return None
 
 
 def abandon_engine():

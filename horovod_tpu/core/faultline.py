@@ -48,6 +48,9 @@ Sites and modes::
                                                    the resident cap were reached (fresh
                                                    allocation, counted as a miss)
     ckpt.write     torn                            checkpoint save dies mid-write
+    preempt.signal deliver                         behave as if SIGTERM arrived (the
+                                                   graceful-preemption ladder fires
+                                                   at a deterministic batch)
 
 Every firing increments ``fault.injected`` + ``fault.injected.<site>``,
 appends to a bounded record the flight dumps embed (``"faults"`` section
@@ -70,7 +73,8 @@ LOG = logging.getLogger("horovod_tpu.faultline")
 
 #: The valid injection sites (parse errors name this list).
 SITES = ("kv.get", "kv.set", "kv.try_get", "hb.beat",
-         "engine.submit", "engine.exec", "engine.pool", "ckpt.write")
+         "engine.submit", "engine.exec", "engine.pool", "ckpt.write",
+         "preempt.signal")
 
 _MODES = {
     "kv.get": ("delay", "error"),
@@ -81,6 +85,7 @@ _MODES = {
     "engine.exec": ("stall", "poison", "error"),
     "engine.pool": ("exhausted",),
     "ckpt.write": ("torn",),
+    "preempt.signal": ("deliver",),
 }
 
 
@@ -404,6 +409,16 @@ def ckpt_write() -> Optional[Fault]:
     """ckpt.write site: 'torn' — the saver writes half the payload then
     raises, simulating a rank dying mid-save."""
     return check("ckpt.write")
+
+
+def preempt_signal() -> bool:
+    """preempt.signal site: True = behave as if the platform's SIGTERM
+    just arrived (core/preempt.py polls this at the trainer's batch
+    boundary — armed identically on every rank, a lockstep batch count
+    makes the whole graceful-preemption ladder deterministic, which a
+    real mid-epoch signal race never is)."""
+    f = check("preempt.signal")
+    return f is not None and f.mode == "deliver"
 
 
 # Arm from the environment once at import. A bad spec in a chaos run must

@@ -143,6 +143,10 @@ class HvdRequest(ctypes.Structure):
         # Engine wire policy code (core/engine.py WIRE_CODES).
         ("wire", ctypes.c_int),
         ("prescale", ctypes.c_double),
+        # Seconds to the request's deadline at executor-call time (0 =
+        # none; negative = already overdue — enforcement is the engine
+        # loop/watchdog's, this is data-plane advice only).
+        ("deadline_s", ctypes.c_double),
         ("names", ctypes.c_char_p),
         ("data", ctypes.c_void_p),
         # Where same-size results must be written: == data unless the
@@ -195,6 +199,10 @@ class HvdStats(ctypes.Structure):
         ("pool_misses", ctypes.c_longlong),
         ("pool_checkouts", ctypes.c_longlong),
         ("pool_bytes_resident", ctypes.c_longlong),
+        # Deadline/cancel plane (engine.deadline_exceeded /
+        # engine.cancelled counter parity with the python engine).
+        ("deadline_exceeded", ctypes.c_longlong),
+        ("cancelled", ctypes.c_longlong),
     ]
 
 
@@ -238,9 +246,11 @@ def load_library():
         ctypes.c_void_p, ctypes.c_int, ctypes.c_char_p, ctypes.c_int,
         ctypes.c_int, ctypes.c_void_p, ctypes.POINTER(ctypes.c_longlong),
         ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_double,
-        ctypes.c_int, ctypes.c_int, ctypes.c_char_p]
+        ctypes.c_int, ctypes.c_int, ctypes.c_double, ctypes.c_char_p]
     lib.hvd_engine_poll.restype = ctypes.c_int
     lib.hvd_engine_poll.argtypes = [ctypes.c_void_p, ctypes.c_longlong]
+    lib.hvd_engine_cancel.restype = ctypes.c_int
+    lib.hvd_engine_cancel.argtypes = [ctypes.c_void_p, ctypes.c_longlong]
     lib.hvd_engine_wait_meta.restype = ctypes.c_int
     lib.hvd_engine_wait_meta.argtypes = [
         ctypes.c_void_p, ctypes.c_longlong,
@@ -253,6 +263,9 @@ def load_library():
     lib.hvd_engine_drop.argtypes = [ctypes.c_void_p, ctypes.c_longlong]
     lib.hvd_engine_pending.restype = ctypes.c_longlong
     lib.hvd_engine_pending.argtypes = [ctypes.c_void_p]
+    lib.hvd_engine_pending_names.restype = ctypes.c_longlong
+    lib.hvd_engine_pending_names.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.c_longlong]
     lib.hvd_engine_get_stats.argtypes = [ctypes.c_void_p,
                                          ctypes.POINTER(HvdStats)]
     lib.hvd_engine_timeline_instant.argtypes = [

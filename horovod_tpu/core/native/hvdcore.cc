@@ -106,6 +106,14 @@ struct hvd_request {
   // engines' reductions bit-identical under the same policy.
   int wire;
   double prescale;
+  // Seconds until the request's deadline at the moment the executor is
+  // called (0 = no deadline; negative = already overdue — the waiter
+  // has been failed, the engine is finishing for protocol coherence).
+  // A fused batch carries the tightest member deadline. Deadline
+  // ENFORCEMENT is the engine's (the loop + watchdog sweep fail the
+  // waiter with an attributed CollectiveTimeout); this field only lets
+  // the data plane bound its own staging if it cares.
+  double deadline_s;
   const char* names;  // ';'-joined tensor names of the fused batch
   void* data;         // fused input buffer
   // Where same-size results must be written. Usually == data (in-place,
@@ -189,6 +197,10 @@ struct hvd_engine_stats {
   long long pool_misses;
   long long pool_checkouts;
   long long pool_bytes_resident;
+  // Deadline/cancel plane (engine.deadline_exceeded / engine.cancelled
+  // telemetry parity with the python twin's counters).
+  long long deadline_exceeded;
+  long long cancelled;
 };
 
 void* hvd_alloc(long long nbytes) { return malloc((size_t)nbytes); }
@@ -594,8 +606,26 @@ struct Entry {
   long long nbytes = 0;
   std::vector<long long> shape;
   Clock::time_point enqueued;
+  // Per-request deadline (absolute; valid when has_deadline). The
+  // waiter-failing sweep reads the Pending twin in pending_names_; the
+  // Entry copy computes hvd_request.deadline_s at execution.
+  Clock::time_point deadline;
+  bool has_deadline = false;
 
   const char* bytes() const { return ext ? ext : data.data(); }
+};
+
+// Per-in-flight-tensor bookkeeping (keyed by name in pending_names_):
+// what the stall watchdog and the deadline sweep need to fail a waiter
+// with phase attribution while the loop thread may be wedged inside an
+// executor call.
+struct Pending {
+  Clock::time_point enqueued;
+  Clock::time_point deadline;
+  bool has_deadline = false;
+  bool fired = false;   // deadline already failed the waiter
+  long long handle = -1;
+  const char* phase = "QUEUE";  // -> NEGOTIATE -> ALLREDUCE/...
 };
 
 struct HandleState {
@@ -681,7 +711,7 @@ class Engine {
   long long Enqueue(int op, const char* name, int dtype_num, int itemsize,
                     const void* data, const long long* shape, int ndim,
                     int average, int root_rank, double prescale, int wire,
-                    int donate, char* err) {
+                    int donate, double deadline_s, char* err) {
     std::unique_lock<std::mutex> lk(mu_);
     if (shutdown_) {
       snprintf(err, 256, "Horovod engine has been shut down");
@@ -728,7 +758,22 @@ class Engine {
     }
     e.shape.assign(shape, shape + ndim);
     e.enqueued = Clock::now();
-    pending_names_[e.name] = e.enqueued;
+    Pending p;
+    p.enqueued = e.enqueued;
+    p.handle = e.handle;
+    if (deadline_s > 0) {
+      e.has_deadline = true;
+      e.deadline = e.enqueued + std::chrono::duration_cast<Clock::duration>(
+          std::chrono::duration<double>(deadline_s));
+      p.has_deadline = true;
+      p.deadline = e.deadline;
+      deadline_count_++;
+      // Break the watchdog's (possibly stall_s_/5-long) idle sleep NOW:
+      // its tightened sweep tick alone would only take effect on the
+      // next wait, far past this request's deadline.
+      deadline_kick_ = true;
+    }
+    pending_names_[e.name] = p;
     if (op >= 0 && op < 3) stats_.submitted[op]++;
     stats_.submitted_bytes += e.nbytes;
     auto hs = std::make_shared<HandleState>();
@@ -747,12 +792,34 @@ class Engine {
     return h;
   }
 
-  // -1 unknown, 0 pending, 1 done.
+  // -1 unknown, 0 pending, 1 done ok, 2 done with an error. The ok/err
+  // split lets the binding release donated-buffer pins only on clean
+  // completions (an errored one may be a deadline expiry whose entry —
+  // and in-place buffer reference — is still in flight).
   int Poll(long long handle) {
     std::lock_guard<std::mutex> g(mu_);
     auto it = handles_.find(handle);
     if (it == handles_.end()) return -1;
-    return it->second->done ? 1 : 0;
+    if (!it->second->done) return 0;
+    return it->second->error.empty() ? 1 : 2;
+  }
+
+  // Cooperative cancel: 0 = marked (the loop retires a pre-announce
+  // entry locally; an announced/executing one completes cross-rank and
+  // discards its result), -1 = unknown handle or already complete.
+  int Cancel(long long handle) {
+    {
+      std::lock_guard<std::mutex> g(mu_);
+      auto it = handles_.find(handle);
+      if (it == handles_.end() || it->second->done) return -1;
+      bool in_flight = false;
+      for (auto& kv : pending_names_)
+        if (kv.second.handle == handle) { in_flight = true; break; }
+      if (!in_flight) return -1;
+      cancelled_.insert(handle);
+    }
+    cv_.notify_all();  // retire promptly even on an idle engine
+    return 0;
   }
 
   // Blocks until completion. 0 ok, 1 collective error, -1 unknown handle.
@@ -800,6 +867,26 @@ class Engine {
 
   long long PendingCount() {
     std::lock_guard<std::mutex> g(mu_);
+    return (long long)pending_names_.size();
+  }
+
+  // ';'-joined names of the in-flight tensors (the quiesce report's
+  // drained/still-pending attribution — the python twin reports NAMES,
+  // so the binding must too). Returns the pending count; the joined
+  // string is truncated at cap (never mid-name: a name that does not
+  // fit is dropped whole).
+  long long PendingNames(char* out, long long cap) {
+    std::lock_guard<std::mutex> g(mu_);
+    long long used = 0;
+    if (cap > 0) out[0] = '\0';
+    for (auto& kv : pending_names_) {
+      long long need = (long long)kv.first.size() + (used > 0 ? 1 : 0);
+      if (used + need + 1 > cap) break;
+      if (used > 0) out[used++] = ';';
+      memcpy(out + used, kv.first.c_str(), kv.first.size());
+      used += (long long)kv.first.size();
+      out[used] = '\0';
+    }
     return (long long)pending_names_.size();
   }
 
@@ -884,6 +971,10 @@ class Engine {
         batch.swap(queue_);
         negotiate = neg_active_ && neg_fn_ != nullptr;
       }
+      // Deadline sweep rides the cycle (reference rhythm: RunLoopOnce
+      // housekeeping). The watchdog thread sweeps too, for the case
+      // where THIS thread is about to wedge inside an executor call.
+      SweepDeadlines();
       if (negotiate) {
         NegotiateCycle(batch);
       } else {
@@ -926,6 +1017,11 @@ class Engine {
   void NegotiateCycle(std::deque<Entry>& fresh) {
     Clock::time_point t0 = Clock::now();
     for (auto& e : fresh) {
+      // Cancel/deadline cull BEFORE the announce: a pre-announce entry
+      // retires locally (no peer lists it); once announced it must
+      // complete cross-rank and discard (a round cannot be torn).
+      if (CullEntry(e)) continue;
+      SetPhase(e.name, NegPhase(e.op));
       timeline_.Begin(e.name, NegPhase(e.op));
       negotiating_.push_back(std::move(e));
     }
@@ -1101,6 +1197,7 @@ class Engine {
       fuse_bytes = 0;
     };
     for (auto& e : entries) {
+      if (CullEntry(e)) continue;  // cancelled/overdue: retire locally
       cycle_bytes += e.nbytes;
       if (e.op == HVD_ALLREDUCE) {
         bool compatible =
@@ -1199,10 +1296,12 @@ class Engine {
     req.average = batch[0]->average;
     req.wire = batch[0]->wire;  // batch is policy-uniform (fusion key)
     req.prescale = batch[0]->prescale;
+    req.deadline_s = BatchDeadlineRemaining(batch);
     req.names = names.c_str();
     req.count = total;
     req.ndim = 1;
     req.shape[0] = total;
+    for (auto* e : batch) SetPhase(e->name, "ALLREDUCE");
     hvd_result res{};
     long long t0 = timeline_.NowUs();
     int rc = CallExecutor(&req, &res);
@@ -1284,11 +1383,13 @@ class Engine {
     } else {
       req.out = req.data;
     }
+    req.deadline_s = DeadlineRemaining(e);
     req.count = e.nbytes / e.itemsize;
     req.ndim = (int)e.shape.size();
     for (size_t i = 0; i < e.shape.size() && i < 8; ++i)
       req.shape[i] = e.shape[i];
     const char* phase = e.op == HVD_ALLGATHER ? "ALLGATHER" : "BROADCAST";
+    SetPhase(e.name, phase);
     hvd_result res{};
     long long t0 = timeline_.NowUs();
     int rc = CallExecutor(&req, &res);
@@ -1335,16 +1436,47 @@ class Engine {
                                      const char* error,
                                      const char* copy_phase = nullptr) {
     std::shared_ptr<HandleState> hs;
+    bool cancelled = false;
+    bool already_done = false;  // deadline sweep released the waiter
     {
       std::lock_guard<std::mutex> g(mu_);
-      pending_names_.erase(e.name);
+      auto pit = pending_names_.find(e.name);
+      if (pit != pending_names_.end()) {
+        already_done = pit->second.fired;
+        if (pit->second.has_deadline && deadline_count_ > 0)
+          deadline_count_--;
+        pending_names_.erase(pit);
+      }
+      // Cooperative cancel: an organic error outranks it (the waiter
+      // gets the real failure); otherwise the completed/late result is
+      // DISCARDED and the waiter sees the cancel error.
+      cancelled = cancelled_.erase(e.handle) > 0 && error == nullptr;
+      if (cancelled) stats_.cancelled++;
       // Counted whether or not the handle is still live (the Python twin
       // counts every completion the same way).
-      if (error) stats_.errors++; else stats_.completed++;
+      if (error || cancelled) stats_.errors++; else stats_.completed++;
       auto it = handles_.find(e.handle);
-      if (it != handles_.end()) hs = it->second;
+      if (it != handles_.end()) {
+        hs = it->second;
+        already_done = already_done || hs->done;
+      }
     }
-    if (hs != nullptr) {
+    std::string cancel_msg;
+    if (cancelled) {
+      timeline_.Begin(e.name, "CANCELLED");
+      timeline_.End(e.name, "CANCELLED");
+      cancel_msg = "collective '" + e.name +
+                   "' was cancelled (cooperative cancel; result "
+                   "discarded)";
+      error = cancel_msg.c_str();
+    }
+    if (hs != nullptr && already_done) {
+      // The sweep already failed this waiter with its attributed
+      // CollectiveTimeout — a late completion must neither clobber the
+      // error nor re-notify (the sweep's write was the final one).
+      timeline_.End(e.name, "QUEUE");
+      hs = nullptr;
+    } else if (hs != nullptr) {
       if (error) {
         hs->error = error;
       } else {
@@ -1383,6 +1515,107 @@ class Engine {
     Notify(Stage(e, data, nbytes, shape, error, copy_phase));
   }
 
+  // Remaining seconds to an entry's deadline at execution time (the
+  // hvd_request.deadline_s the data plane sees): 0 = none; may be
+  // negative when already overdue (the waiter has been failed and the
+  // engine is finishing for coherence only).
+  static double DeadlineRemaining(const Entry& e) {
+    if (!e.has_deadline) return 0.0;
+    return std::chrono::duration<double>(e.deadline - Clock::now()).count();
+  }
+
+  double BatchDeadlineRemaining(const std::vector<Entry*>& batch) {
+    double best = 0.0;
+    for (auto* e : batch) {
+      if (!e->has_deadline) continue;
+      double r = DeadlineRemaining(*e);
+      if (best == 0.0 || r < best) best = r;
+    }
+    return best;
+  }
+
+  // Phase attribution for the deadline sweep (QUEUE -> NEGOTIATE_* ->
+  // ALLREDUCE/...); `phase` must be a string literal (stored by ptr).
+  void SetPhase(const std::string& name, const char* phase) {
+    std::lock_guard<std::mutex> g(mu_);
+    auto it = pending_names_.find(name);
+    if (it != pending_names_.end()) it->second.phase = phase;
+  }
+
+  // Fail the waiter of every overdue entry with an attributed
+  // CollectiveTimeout-shaped error naming the stuck phase, and stamp a
+  // DEADLINE_EXCEEDED instant into the ring. Runs on the loop thread
+  // each cycle and on the watchdog thread (the loop may be wedged
+  // inside an executor call). Zero work while no entry has a deadline.
+  void SweepDeadlines() {
+    struct Fired {
+      long long handle;
+      std::string name;
+      const char* phase;
+      double age;
+    };
+    std::vector<Fired> fired;
+    {
+      std::lock_guard<std::mutex> g(mu_);
+      if (deadline_count_ <= 0) return;
+      Clock::time_point now = Clock::now();
+      for (auto& kv : pending_names_) {
+        Pending& p = kv.second;
+        if (p.has_deadline && !p.fired && now > p.deadline) {
+          p.fired = true;
+          fired.push_back(Fired{p.handle, kv.first, p.phase,
+                                SecondsSince(p.enqueued)});
+        }
+      }
+    }
+    for (auto& f : fired) {
+      std::shared_ptr<HandleState> hs;
+      {
+        std::lock_guard<std::mutex> g(mu_);
+        stats_.deadline_exceeded++;
+        auto it = handles_.find(f.handle);
+        if (it != handles_.end() && !it->second->done) {
+          hs = it->second;
+          char msg[512];
+          snprintf(msg, sizeof(msg),
+                   "collective '%s' exceeded its deadline after %.2fs "
+                   "stuck in phase %s (the request is abandoned; a late "
+                   "completion will be discarded)",
+                   f.name.c_str(), f.age, f.phase);
+          hs->error = msg;
+          hs->done = true;
+        }
+      }
+      if (hs != nullptr) cv_done_.notify_all();
+      char args[96];
+      snprintf(args, sizeof(args), "\"phase\": \"%s\", \"age_s\": %.3f",
+               f.phase, f.age);
+      timeline_.Instant(f.name, "DEADLINE_EXCEEDED", args);
+    }
+  }
+
+  // Cancel/deadline cull before announce/execute: true when the entry
+  // was retired locally (waiter released, nothing announced/executed).
+  bool CullEntry(Entry& e) {
+    bool cancelled, fired;
+    {
+      std::lock_guard<std::mutex> g(mu_);
+      cancelled = cancelled_.count(e.handle) > 0;
+      auto it = pending_names_.find(e.name);
+      fired = it != pending_names_.end() && it->second.fired;
+    }
+    if (cancelled) {
+      Complete(e, nullptr, 0, nullptr, nullptr);  // Stage -> cancel path
+      return true;
+    }
+    if (fired) {
+      Complete(e, nullptr, 0, nullptr,
+               "collective exceeded its deadline before execution");
+      return true;
+    }
+    return false;
+  }
+
   // Reference: CheckForStalledTensors warns every 60 s about tensors stuck
   // in the table (operations.cc:1535-1581). Separate thread: the loop
   // thread may itself be inside a hung collective.
@@ -1392,8 +1625,19 @@ class Engine {
     while (true) {
       {
         std::unique_lock<std::mutex> lk(mu_);
-        if (WaitFor(cv_, lk, interval, [&] { return shutdown_; })) return;
+        // Deadline enforcement for entries the loop thread cannot
+        // reach (wedged inside the executor): tighten the tick while
+        // any in-flight entry carries a deadline. The kick (set at
+        // enqueue) breaks an already-started coarse sleep — the
+        // tightened tick alone would only apply to the NEXT wait.
+        double tick = deadline_count_ > 0 && interval > 0.05
+                          ? 0.05 : interval;
+        WaitFor(cv_, lk, tick,
+                [&] { return shutdown_ || deadline_kick_; });
+        if (shutdown_) return;
+        deadline_kick_ = false;
       }
+      SweepDeadlines();
       if (stall_s_ <= 0) continue;
       if (SecondsSince(last_warn) < stall_s_ && last_warn != Clock::time_point{})
         continue;
@@ -1404,7 +1648,7 @@ class Engine {
         // condition to report.
         std::lock_guard<std::mutex> g(mu_);
         for (auto& kv : pending_names_) {
-          if (SecondsSince(kv.second) > stall_s_) {
+          if (SecondsSince(kv.second.enqueued) > stall_s_) {
             if (!stalled.empty()) stalled += ", ";
             stalled += kv.first;
           }
@@ -1434,8 +1678,14 @@ class Engine {
   std::condition_variable cv_, cv_done_;
   hvd_engine_stats stats_{};  // guarded by mu_
   std::deque<Entry> queue_;
-  std::unordered_map<std::string, Clock::time_point> pending_names_;
+  std::unordered_map<std::string, Pending> pending_names_;
   std::unordered_map<long long, std::shared_ptr<HandleState>> handles_;
+  // Deadline/cancel plane (guarded by mu_): in-flight entries carrying
+  // a deadline (the sweep's zero-cost short circuit) and handles with a
+  // cooperative cancel pending.
+  long long deadline_count_ = 0;
+  bool deadline_kick_ = false;  // enqueue -> watchdog wake (under mu_)
+  std::unordered_set<long long> cancelled_;
   long long next_handle_ = 0;
   bool shutdown_ = false;
   bool sort_by_name_ = false;
@@ -1494,14 +1744,19 @@ long long hvd_engine_enqueue(void* e, int op, const char* name, int dtype_num,
                              int itemsize, const void* data,
                              const long long* shape, int ndim, int average,
                              int root_rank, double prescale, int wire,
-                             int donate, char* err) {
+                             int donate, double deadline_s, char* err) {
   return static_cast<Engine*>(e)->Enqueue(op, name, dtype_num, itemsize, data,
                                           shape, ndim, average, root_rank,
-                                          prescale, wire, donate, err);
+                                          prescale, wire, donate, deadline_s,
+                                          err);
 }
 
 int hvd_engine_poll(void* e, long long handle) {
   return static_cast<Engine*>(e)->Poll(handle);
+}
+
+int hvd_engine_cancel(void* e, long long handle) {
+  return static_cast<Engine*>(e)->Cancel(handle);
 }
 
 int hvd_engine_wait_meta(void* e, long long handle, long long* nbytes,
@@ -1520,6 +1775,10 @@ void hvd_engine_drop(void* e, long long handle) {
 
 long long hvd_engine_pending(void* e) {
   return static_cast<Engine*>(e)->PendingCount();
+}
+
+long long hvd_engine_pending_names(void* e, char* out, long long cap) {
+  return static_cast<Engine*>(e)->PendingNames(out, cap);
 }
 
 void hvd_engine_get_stats(void* e, hvd_engine_stats* out) {

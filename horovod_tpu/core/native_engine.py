@@ -27,6 +27,8 @@ from horovod_tpu.core.engine import (
     STALL_WARNING_TIME_S,
     WIRE_CODES,
     WIRE_NAMES,
+    CancelledError,
+    CollectiveTimeout,
     DuplicateNameError,
     EngineError,
     JaxExecutor,
@@ -34,8 +36,10 @@ from horovod_tpu.core.engine import (
     _freeze_donated,
     _multi_controller,
     _negotiated,
+    collective_deadline_from_env,
     config_from_env,
     make_autotuner,
+    quiesce_drain,
     record_cache_config,
     record_submit,
     resolve_wire_policy,
@@ -289,6 +293,15 @@ class NativeEngine:
         # Engine-wide default wire format (HVD_COMPRESSION) — same rule
         # and fail-fast as the python twin.
         self.wire_default = wire_policy_from_env()
+        # Deadline/cancel/drain plane (same knobs as the python twin):
+        # the HVD_COLLECTIVE_DEADLINE_S default, the quiesce reason once
+        # admission closes, and donated buffers whose waiter a deadline
+        # released while the C++ entry may STILL reference them — parked
+        # for process lifetime (the leak-the-wedged doctrine; freeing
+        # them under a wedged executor's zero-copy read would be UB).
+        self.default_deadline_s = collective_deadline_from_env()
+        self._quiesced: Optional[str] = None
+        self._parked_donations: list = []
         self._ready_marked: dict = {}  # name -> processes marked RANK_READY
         if timeline_path:
             # Staging time feeds the WAIT_FOR_DATA spans; only measured
@@ -365,6 +378,10 @@ class NativeEngine:
         ("engine.pool.hits", "pool_hits"),
         ("engine.pool.misses", "pool_misses"),
         ("engine.pool.checkouts", "pool_checkouts"),
+        # Deadline/cancel plane — the python twin's counters of the
+        # same names are fed in its sweep/_complete paths.
+        ("engine.deadline_exceeded", "deadline_exceeded"),
+        ("engine.cancelled", "cancelled"),
     )
 
     def _collect_stats(self):
@@ -500,7 +517,8 @@ class NativeEngine:
                  average: bool = False, root_rank: int = 0,
                  prescale: float = 1.0,
                  compression: Optional[str] = None,
-                 donate: bool = False) -> int:
+                 donate: bool = False,
+                 deadline_ms: Optional[float] = None) -> int:
         # Fault site engine.submit (core/faultline.py) — in the python
         # shim, BEFORE the C++ enqueue, so both engines fail a submit at
         # the same point with the same observable shape.
@@ -509,6 +527,17 @@ class NativeEngine:
             raise EngineError(injected)
         if self._ptr is None:
             raise ShutdownError("engine is shut down")
+        if self._quiesced is not None:
+            # Admission closed (quiesce): same descriptive fail-fast as
+            # the python twin.
+            raise EngineError(
+                f"engine is draining ({self._quiesced}): submissions "
+                "are closed — the engine is completing in-flight work "
+                "before shutdown (quiesce)")
+        if deadline_ms is not None:
+            deadline_s = deadline_ms / 1000.0 if deadline_ms > 0 else 0.0
+        else:
+            deadline_s = self.default_deadline_s or 0.0
         tensor = np.asarray(tensor)
         donate = donate and tensor.flags["C_CONTIGUOUS"]
         if not donate:
@@ -539,7 +568,7 @@ class NativeEngine:
             self._ptr, _OPS[op], name.encode(), _DTYPE_CODE[tensor.dtype],
             tensor.dtype.itemsize, tensor.ctypes.data, shape, tensor.ndim,
             int(average), int(root_rank), float(prescale),
-            int(WIRE_CODES[wire]), int(donate), err)
+            int(WIRE_CODES[wire]), int(donate), float(deadline_s), err)
         if h < 0:
             # Rejected submit: the engine never took ownership — a
             # donated buffer we froze must become writable again.
@@ -563,29 +592,84 @@ class NativeEngine:
     def allreduce_async(self, name: str, tensor: np.ndarray, average: bool,
                         prescale: float = 1.0,
                         compression: Optional[str] = None,
-                        donate: bool = False) -> int:
+                        donate: bool = False,
+                        deadline_ms: Optional[float] = None) -> int:
         return self._enqueue("allreduce", name, tensor, average=average,
                              prescale=prescale, compression=compression,
-                             donate=donate)
+                             donate=donate, deadline_ms=deadline_ms)
 
     def allgather_async(self, name: str, tensor: np.ndarray,
-                        donate: bool = False) -> int:
-        return self._enqueue("allgather", name, tensor, donate=donate)
+                        donate: bool = False,
+                        deadline_ms: Optional[float] = None) -> int:
+        return self._enqueue("allgather", name, tensor, donate=donate,
+                             deadline_ms=deadline_ms)
 
     def broadcast_async(self, name: str, tensor: np.ndarray,
-                        root_rank: int, donate: bool = False) -> int:
+                        root_rank: int, donate: bool = False,
+                        deadline_ms: Optional[float] = None) -> int:
         return self._enqueue("broadcast", name, tensor, root_rank=root_rank,
-                             donate=donate)
+                             donate=donate, deadline_ms=deadline_ms)
+
+    def cancel(self, handle: int) -> bool:
+        """Cooperative cancel — same contract as the python twin's:
+        pre-announce entries retire locally, announced/executing ones
+        complete cross-rank and discard; ``synchronize`` then raises
+        :class:`CancelledError`. False = unknown or already done."""
+        if self._ptr is None:
+            return False
+        return self._lib.hvd_engine_cancel(self._ptr, handle) == 0
+
+    def quiesce(self, deadline_s: float,
+                reason: str = "quiesce requested"):
+        """Close admission (new submits fail fast; ``/healthz`` reports
+        ``draining``), complete in-flight work within ``deadline_s``,
+        report what drained — the python twin's quiesce over the C++
+        loop (admission is closed in this binding: every enqueue passes
+        through it)."""
+        already = self._quiesced is not None
+        if not already:
+            self._quiesced = reason
+        # Shared policy (core/engine.py quiesce_drain): drain loop,
+        # draining marker, report shape and log wording are ONE
+        # implementation for both engines. No waker needed — the C++
+        # loop ticks on its own cycle.
+        return quiesce_drain(reason, deadline_s, already,
+                             self._pending_names, lambda: None,
+                             min(self.cycle_time_s, 0.01))
+
+    def _pending_names(self):
+        """Names of the in-flight tensors, straight from the C++ table
+        (the quiesce report must NAME work like the python twin, not
+        count it). The C side truncates whole names at the buffer cap
+        and returns the TRUE count — grow until every name fits, or a
+        still-wedged tensor beyond the cutoff would be misreported as
+        drained (each call reads names+count under one lock, so the
+        per-call comparison is consistent)."""
+        if self._ptr is None:
+            return []
+        cap = 1 << 16
+        while True:
+            buf = ctypes.create_string_buffer(cap)
+            total = int(self._lib.hvd_engine_pending_names(
+                self._ptr, buf, cap))
+            raw = buf.value.decode()
+            names = raw.split(";") if raw else []
+            if len(names) >= total or cap >= (1 << 24):
+                return names
+            cap *= 2
 
     def poll(self, handle: int) -> bool:
         st = self._lib.hvd_engine_poll(self._ptr, handle)
         if st < 0:
             raise EngineError(f"unknown handle {handle}")
-        if st:
-            # Completion reached: the C++ entry no longer references a
+        if st == 1:
+            # CLEAN completion: the C++ entry no longer references a
             # donated buffer — release the pin here too, so poll-only
             # callers don't hold donated memory until shutdown (the
-            # python twin drops its reference at completion).
+            # python twin drops its reference at completion). Errored
+            # completions (st == 2) keep the pin until synchronize
+            # classifies them: a deadline expiry releases the waiter
+            # while the entry may still read the buffer in place.
             self._donated.pop(handle, None)
         return bool(st)
 
@@ -601,15 +685,29 @@ class NativeEngine:
             raise EngineError(f"unknown handle {handle}")
         dtype, name = self._meta.pop(handle,
                                      (np.dtype(np.float32), ""))
-        # Completion reached: the C++ entry no longer references a
-        # donated buffer — release the pin.
-        self._donated.pop(handle, None)
         if rc == 1:
             self._lib.hvd_engine_drop(self._ptr, handle)
             msg = err.value.decode()
+            if "exceeded its deadline" in msg:
+                # The waiter was released by the deadline sweep while
+                # the entry may STILL be in flight: a donated buffer
+                # stays pinned forever (the wedged executor may read it
+                # in place), and the expiry earns the attributed flight
+                # dump (rate-limited per reason — ONE dump per expiry).
+                buf = self._donated.pop(handle, None)
+                if buf is not None:
+                    self._parked_donations.append(buf)
+                self._dump_flight(msg)
+                raise CollectiveTimeout(msg)
+            self._donated.pop(handle, None)
+            if "was cancelled" in msg:
+                raise CancelledError(msg)
             if "shut down" in msg:
                 raise ShutdownError(msg)
             raise EngineError(msg)
+        # Clean completion: the C++ entry no longer references a donated
+        # buffer — release the pin.
+        self._donated.pop(handle, None)
         # Result buffer from the pool — recycled once the caller drops
         # the returned view.
         out = self._pool.checkout(int(nbytes.value), np.uint8)

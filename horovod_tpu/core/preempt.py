@@ -1,0 +1,315 @@
+"""Graceful preemption — the planned-eviction half of the fault story.
+
+The elastic stack (core/elastic.py) handles *death*; this module handles
+the platform politely asking for the machine back. At pod scale (MLPerf-
+class runs, arxiv 1909.09756) maintenance eviction is a routine event
+delivered as SIGTERM with a grace window before SIGKILL — the reference
+framework simply dies and re-trains from the last manual checkpoint
+(arxiv 1802.05799 has no preemption story). Here the ladder is:
+
+1. **Signal intake.** :func:`install` (the keras Trainer calls it at
+   ``fit`` start) chains a SIGTERM handler that records the request;
+   :func:`requested` is the cheap per-batch poll. The deterministic twin
+   is the ``preempt.signal`` faultline site (``core/faultline.py``):
+   armed identically on every rank, the lockstep batch count makes the
+   whole ladder testable without racing a real signal.
+2. **Step drain.** The trainer finishes the in-flight step, bounded by
+   ``HVD_PREEMPT_STEP_DEADLINE_S`` — a step wedged behind a dead peer is
+   deadline-ABORTED, not waited out (the launcher's ``--grace-s``
+   SIGKILL escalation is the backstop either way).
+3. **Engine quiesce.** ``engine.quiesce``: admission closes (submits
+   fail fast, ``/healthz`` says ``draining``), in-flight collectives
+   complete, the report says what drained.
+4. **Emergency checkpoint.** The trainer's crash-atomic save (tmp +
+   fsync + rename — a SIGKILL mid-save can never corrupt the newest
+   checkpoint), into the elastic/`HVD_CHECKPOINT_DIR` location the
+   relaunch already resumes from.
+5. **Drain barrier.** A KV rendezvous with a deadline
+   (``HVD_PREEMPT_BARRIER_S``): no rank exits while a peer still needs
+   it for the checkpoint's globalize collective; a peer that never
+   arrives (already dead) times the barrier out rather than wedging the
+   exit.
+6. **Exit 0** with a journaled ``preempted`` note under the elastic dir
+   (or ``HVD_PREEMPT_DIR``), so the supervisor/operator can tell a
+   graceful eviction from a crash at a glance.
+
+Everything here is stdlib-only on the intake path; jax and the KV plane
+are imported only when the ladder actually runs.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import signal
+import threading
+import time
+from typing import Optional
+
+from horovod_tpu.core import faultline as _flt
+from horovod_tpu.core import telemetry as _tele
+from horovod_tpu.core.sentinel import _env_float
+
+LOG = logging.getLogger("horovod_tpu.preempt")
+
+
+class PreemptRequested(Exception):
+    """Raised out of a training epoch when a preemption request landed;
+    the trainer catches it and runs the graceful ladder."""
+
+
+def step_deadline_s() -> float:
+    """Budget for finishing (or abandoning) the in-flight step and the
+    emergency checkpoint, each."""
+    return _env_float("HVD_PREEMPT_STEP_DEADLINE_S", 30.0)
+
+
+def barrier_s() -> float:
+    """Drain-barrier rendezvous deadline: how long an exiting rank waits
+    for its peers to reach the barrier before giving up and exiting
+    anyway (a dead peer must not wedge the graceful exit)."""
+    return _env_float("HVD_PREEMPT_BARRIER_S", 30.0)
+
+
+_requested = threading.Event()
+_request_reason: Optional[str] = None
+_install_lock = threading.Lock()
+_installed = False
+_prev_handler = None
+_counted = False
+
+
+def _count_request():
+    """Increment ``preempt.requested`` exactly once per request — OUT of
+    the signal handler: the telemetry registry's locks are non-reentrant
+    and the main thread (where CPython runs handlers) routinely holds
+    them mid-increment; touching them from the handler could deadlock
+    the rank exactly on the eviction path."""
+    global _counted
+    if _counted:
+        return
+    _counted = True
+    try:
+        _tele.REGISTRY.counter("preempt.requested").inc()
+    except Exception:
+        pass
+
+
+def _on_sigterm(signum, frame):
+    # Async-signal-safe on purpose: set the flag/reason and nothing
+    # else (no locks, no logging, no telemetry — _count_request runs
+    # later, from requested()/the ladder, in normal thread context).
+    global _request_reason
+    if not _requested.is_set():
+        _request_reason = "SIGTERM"
+        _requested.set()
+    if callable(_prev_handler):
+        # Chain an application handler (SIG_DFL/SIG_IGN are ints) — the
+        # graceful ladder is additive, never a replacement.
+        try:
+            _prev_handler(signum, frame)
+        except Exception:
+            pass
+
+
+def install():
+    """Install the SIGTERM intake (idempotent; main thread only — the
+    signal module's rule; elsewhere the request is still observable via
+    the faultline site and an earlier main-thread install)."""
+    global _installed, _prev_handler
+    with _install_lock:
+        if _installed:
+            return
+        try:
+            _prev_handler = signal.signal(signal.SIGTERM, _on_sigterm)
+            _installed = True
+        except (ValueError, AttributeError, OSError):
+            pass  # non-main thread, or a platform without SIGTERM
+
+
+def request(reason: str = "requested programmatically"):
+    """Arm the preemption request without a signal (tests, custom
+    schedulers)."""
+    global _request_reason
+    if not _requested.is_set():
+        _request_reason = reason
+        _requested.set()
+    _count_request()
+
+
+def reset():
+    """Tests only: clear a standing request."""
+    global _request_reason, _counted
+    _requested.clear()
+    _request_reason = None
+    _counted = False
+
+
+def requested() -> bool:
+    """The per-batch poll: True once a SIGTERM (or the deterministic
+    ``preempt.signal`` faultline site) asked this process to drain.
+    Zero-overhead when nothing is armed: an Event read plus faultline's
+    is-None fast path."""
+    if _requested.is_set():
+        _count_request()  # deferred from the signal handler
+        return True
+    if _flt.preempt_signal():
+        request("injected fault at preempt.signal")
+        return True
+    return False
+
+
+def reason() -> Optional[str]:
+    return _request_reason
+
+
+def bounded(fn, deadline_s_: float, what: str):
+    """Run ``fn`` on a worker thread, waiting at most ``deadline_s_``.
+    Returns (ok, value). A timed-out call is ABANDONED (the thread is
+    daemonic and parks — the leak-the-wedged doctrine): a step or
+    checkpoint wedged behind a dead peer must not wedge the exit; the
+    launcher's grace escalation is the backstop."""
+    box = {}
+    done = threading.Event()
+
+    def _run():
+        try:
+            box["value"] = fn()
+        except BaseException as exc:  # surfaced as a failed drain
+            box["error"] = exc
+        done.set()
+
+    t = threading.Thread(target=_run, name=f"hvd-preempt-{what}",
+                         daemon=True)
+    t.start()
+    if not done.wait(max(0.0, deadline_s_)):
+        LOG.error("graceful preemption: %s did not finish within %.1fs "
+                  "— abandoned (deadline-aborted)", what, deadline_s_)
+        return False, None
+    if "error" in box:
+        LOG.error("graceful preemption: %s failed: %s", what,
+                  box["error"])
+        return False, None
+    return True, box.get("value")
+
+
+def _barrier_kv():
+    """The KV plane for the drain barrier: the coordination-service KV
+    when reachable, else the elastic file plane, else None (single
+    process, or nothing to rendezvous through)."""
+    try:
+        from horovod_tpu.core import coordinator as _coord
+
+        return _coord.JaxKV()
+    except Exception:
+        pass
+    try:
+        from horovod_tpu.core import elastic as _elastic
+
+        d = _elastic.elastic_dir()
+        if d:
+            return _elastic.FileKV(os.path.join(d, "kv"))
+    except Exception:
+        pass
+    return None
+
+
+def drain_barrier(deadline_s_: Optional[float] = None) -> bool:
+    """Rendezvous with every peer before exiting: each process marks
+    ``hvd/preempt/<gen>/p<i>`` and polls for the others until the
+    deadline. True = every peer arrived; False = timed out (exit anyway
+    — a peer that never arrives is dead or was never preempted, and
+    wedging the exit would just convert a graceful drain into the
+    launcher's SIGKILL escalation)."""
+    if deadline_s_ is None:
+        deadline_s_ = barrier_s()
+    try:
+        from horovod_tpu.common import topology as _topo
+
+        if not _topo.is_initialized() or _topo.num_processes() <= 1:
+            return True
+        nproc = _topo.num_processes()
+        pid = _topo.process_index()
+    except Exception:
+        return True
+    kv = _barrier_kv()
+    if kv is None:
+        LOG.warning("graceful preemption: no KV plane for the drain "
+                    "barrier; exiting unbarriered")
+        return False
+    gen = os.environ.get("HVD_ELASTIC_GENERATION", "0")
+    ns = f"hvd/preempt/g{gen}"
+    stamp = str(round(time.time(), 3))
+    try:
+        # The coordination-service KV is insert-only: delete-then-set
+        # makes the mark idempotent; the file plane overwrites in place.
+        try:
+            kv.delete(f"{ns}/p{pid}")
+        except Exception:
+            pass
+        kv.set(f"{ns}/p{pid}", stamp)
+    except Exception as exc:
+        LOG.warning("graceful preemption: cannot publish the drain-"
+                    "barrier mark (%s); exiting unbarriered", exc)
+        return False
+    deadline = time.monotonic() + max(0.0, deadline_s_)
+    waiting = [p for p in range(nproc) if p != pid]
+    while waiting and time.monotonic() < deadline:
+        still = []
+        for p in waiting:
+            try:
+                if kv.try_get(f"{ns}/p{p}") is None:
+                    still.append(p)
+            except Exception:
+                still.append(p)
+        waiting = still
+        if waiting:
+            time.sleep(0.05)
+    if waiting:
+        LOG.warning("graceful preemption: drain barrier timed out after "
+                    "%.1fs still waiting for process(es) %s; exiting "
+                    "anyway", deadline_s_, waiting)
+        return False
+    return True
+
+
+def journal_note(**extra) -> Optional[str]:
+    """Write the per-rank ``preempted`` note (the supervisor/operator's
+    evidence that this exit was a graceful eviction, not a crash) under
+    ``<elastic dir>/preempt/`` or ``HVD_PREEMPT_DIR``. Returns the path
+    or None."""
+    base = None
+    try:
+        from horovod_tpu.core import elastic as _elastic
+
+        base = _elastic.elastic_dir()
+    except Exception:
+        pass
+    base = os.environ.get("HVD_PREEMPT_DIR") or base
+    if not base:
+        return None
+    pid = 0
+    try:
+        from horovod_tpu.core import timeline as _tl
+
+        pid = _tl._process_index()
+    except Exception:
+        pass
+    note = dict(kind="preempted", process=pid,
+                reason=_request_reason or "unknown",
+                wall=round(time.time(), 3), **extra)
+    try:
+        d = os.path.join(base, "preempt")
+        os.makedirs(d, exist_ok=True)
+        path = os.path.join(d, f"p{pid}.json")
+        tmp = f"{path}.{os.getpid()}.tmp"
+        with open(tmp, "w") as fh:
+            json.dump(note, fh)
+            fh.flush()
+            os.fsync(fh.fileno())
+        os.replace(tmp, path)
+        return path
+    except OSError as exc:
+        LOG.warning("cannot write the preempted journal note: %s", exc)
+        return None

@@ -757,6 +757,7 @@ class Sentinel:
         exists to catch. (A run that legitimately left its training
         loop — eval, checkpointing — also reads warn until steps
         resume: the endpoint measures training liveness.)"""
+        draining = _draining_reason()
         now = time.time()
         age = (round(now - self.last_step_wall, 3)
                if self.last_step_wall else None)
@@ -778,7 +779,13 @@ class Sentinel:
         # a numerics verdict can fire from the engine path before any
         # training step is observed (core/numerics.py), and /healthz
         # must degrade on it regardless.
-        if recent_verdict or recent_stall:
+        if draining is not None:
+            # Deliberate drain (engine quiesce / graceful preemption):
+            # load balancers must stop routing here NOW — the endpoint
+            # serves non-200 for it (telemetry_http treats everything
+            # outside ok/init as 503), and the payload says why.
+            status = "draining"
+        elif recent_verdict or recent_stall:
             status = "warn"
         elif age is None:
             status = "init"
@@ -807,6 +814,7 @@ class Sentinel:
             status = "warn"
         return {
             "status": status,
+            "draining": draining,
             "world": world,
             "rank": tl._process_index(),
             "pid": os.getpid(),
@@ -856,6 +864,27 @@ def note_stall(reason: str, rank: Optional[int] = None):
         get_sentinel().note_stall(reason, rank)
     except Exception:  # pragma: no cover - defensive
         pass
+
+
+# Deliberate-drain marker (engine quiesce / graceful preemption): module
+# state, not Sentinel state — a drain survives a sentinel reset and must
+# be visible before any sentinel was ever built.
+_draining: Optional[str] = None
+_draining_lock = threading.Lock()
+
+
+def note_draining(reason: Optional[str]):
+    """Mark this process as draining (``/healthz`` answers ``draining``
+    with a non-200 status until cleared with None). The engines' quiesce
+    and the graceful-preemption ladder call it. Never raises."""
+    global _draining
+    with _draining_lock:
+        _draining = str(reason) if reason is not None else None
+
+
+def _draining_reason() -> Optional[str]:
+    with _draining_lock:
+        return _draining
 
 
 def note_loss(loss):

@@ -53,6 +53,15 @@ MEMCPY_OUT_FUSION_BUFFER = "MEMCPY_OUT_FUSION_BUFFER"
 # (reference: the per-rank readiness events timeline.cc:106-130 records
 # while a tensor is NEGOTIATING — the trace then shows who was late).
 RANK_READY = "RANK_READY"
+# A cooperatively-cancelled collective: pre-announce entries retire
+# locally under this span; post-agreement entries complete cross-rank
+# (a fused batch cannot be torn) and the span marks the discarded
+# result. Both engines' writers spell it (hvdcheck parity-spans).
+CANCELLED = "CANCELLED"
+# Instant stamped when a per-request deadline fires: args carry the
+# phase the entry was stuck in (QUEUE/NEGOTIATE/ALLREDUCE/...) and its
+# age — the attribution the CollectiveTimeout error repeats.
+DEADLINE_EXCEEDED = "DEADLINE_EXCEEDED"
 # Clock metadata event: maps this trace's timeline clock onto the common
 # time base (utils/trace.py merge). args: rank, epoch_wall_us (wall-clock
 # µs at trace ts 0), offset_us (subtract from epoch_wall_us+ts to land on
@@ -391,6 +400,10 @@ def dump_flight_recorder(events: List[dict], reason: str,
             f"{payload['wall_us']}.json")
     tmp = f"{path}.tmp"
     try:
+        if prune_dir is not None:
+            # An operator-set HVD_FLIGHT_DIR need not pre-exist: a lost
+            # post-mortem is far worse than a mkdir on the dump path.
+            os.makedirs(prune_dir, exist_ok=True)
         with open(tmp, "w") as fh:
             json.dump(payload, fh)
         os.replace(tmp, path)

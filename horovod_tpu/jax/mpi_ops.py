@@ -58,26 +58,33 @@ def _np_of(tensor) -> np.ndarray:
 def allreduce_async(tensor, average: bool = True,
                     name: Optional[str] = None,
                     compression: Optional[str] = None,
-                    donate: bool = False) -> int:
+                    donate: bool = False,
+                    deadline_ms: Optional[float] = None) -> int:
     """Enqueue an allreduce; returns a handle for :func:`synchronize`.
     ``compression`` is the per-request engine wire policy ('int8'/'fp8');
-    ``donate=True`` skips the submit snapshot (ownership handoff)."""
+    ``donate=True`` skips the submit snapshot (ownership handoff);
+    ``deadline_ms`` bounds the wait — an overdue request fails its
+    waiter with an attributed :class:`CollectiveTimeout` (overrides the
+    engine-wide ``HVD_COLLECTIVE_DEADLINE_S`` default)."""
     return get_engine().allreduce_async(
         _auto_name("allreduce", name), _np_of(tensor), average,
-        compression=compression, donate=donate)
+        compression=compression, donate=donate, deadline_ms=deadline_ms)
 
 
 def allgather_async(tensor, name: Optional[str] = None,
-                    donate: bool = False) -> int:
+                    donate: bool = False,
+                    deadline_ms: Optional[float] = None) -> int:
     return get_engine().allgather_async(
-        _auto_name("allgather", name), _np_of(tensor), donate=donate)
+        _auto_name("allgather", name), _np_of(tensor), donate=donate,
+        deadline_ms=deadline_ms)
 
 
 def broadcast_async(tensor, root_rank: int, name: Optional[str] = None,
-                    donate: bool = False) -> int:
+                    donate: bool = False,
+                    deadline_ms: Optional[float] = None) -> int:
     return get_engine().broadcast_async(
         _auto_name("broadcast", name), _np_of(tensor), root_rank,
-        donate=donate)
+        donate=donate, deadline_ms=deadline_ms)
 
 
 def poll(handle: int) -> bool:

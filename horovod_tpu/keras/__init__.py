@@ -14,6 +14,7 @@ from __future__ import annotations
 
 import collections.abc
 import math
+import os
 import queue as _queue
 import threading
 import time
@@ -48,8 +49,10 @@ from horovod_tpu.jax.sharded import (
 )
 from horovod_tpu.core import elastic as _elastic
 from horovod_tpu.core import numerics as _numerics
+from horovod_tpu.core import preempt as _preempt
 from horovod_tpu.core import sentinel as _sentinel
 from horovod_tpu.core import telemetry as _tele
+from horovod_tpu.core import timeline as _tl
 from horovod_tpu.keras import callbacks  # noqa: F401
 from horovod_tpu.ops import collectives as _ops
 from horovod_tpu.ops.collectives import HVD_AXIS
@@ -519,6 +522,11 @@ class Trainer:
         history: dict = {}
         for cb in callbacks:
             cb.on_train_begin()
+        # Graceful preemption intake (core/preempt.py): SIGTERM — the
+        # TPU maintenance/eviction signal — is polled at every batch
+        # boundary; when it lands, the epoch raises and the ladder below
+        # drains the step, checkpoints, barriers, and exits 0.
+        _preempt.install()
         elastic_on = _elastic.active()
         if elastic_on:
             # A new fit revokes any standing completion mark (peers
@@ -531,6 +539,8 @@ class Trainer:
                 self._run_epoch(epoch, x, y, batch_size, shuffle,
                                 callbacks, validation_data, history,
                                 verbose, elastic_on)
+            except _preempt.PreemptRequested:
+                self._graceful_preempt(epoch)  # exits 0; no return
             except _elastic.WorldChanged:
                 if not elastic_on:
                     raise
@@ -563,6 +573,11 @@ class Trainer:
         nxt, b = next(batches, None), 0
         prev_step = None  # elastic: last step's device loss (readiness)
         while nxt is not None:
+            if _preempt.requested():
+                # Batch boundary: the last dispatched step is the one
+                # the ladder drains; no new work is dispatched into a
+                # world about to be evicted.
+                raise _preempt.PreemptRequested()
             xb, yb = nxt
             for cb in callbacks:
                 cb.on_batch_begin(b)
@@ -809,6 +824,78 @@ class Trainer:
         req = _elastic.get_world().restart_requested()
         if req:
             _elastic.get_world().exit_for_restart(req)
+
+    # -- graceful preemption (core/preempt.py) -------------------------------
+
+    def _graceful_preempt(self, epoch: int):
+        """The planned-eviction ladder: finish (or deadline-abort) the
+        in-flight step, quiesce the engine (admission closed, /healthz
+        ``draining``), write the crash-atomic emergency checkpoint,
+        rendezvous with the peers at the drain barrier, journal a
+        ``preempted`` note, and exit 0. Every rung is bounded — a rung
+        wedged behind a dead peer is abandoned, never waited out (the
+        launcher's ``--grace-s`` SIGKILL escalation is the backstop).
+        Does not return."""
+        why = _preempt.reason() or "preemption requested"
+        deadline = _preempt.step_deadline_s()
+        _ELASTIC_LOG.warning(
+            "graceful preemption (%s): draining the current step, "
+            "checkpointing, and exiting cleanly", why)
+        state = (self.params, self.batch_stats, self.opt_state)
+        drained, _ = _preempt.bounded(
+            lambda: jax.block_until_ready(state), deadline,
+            "in-flight step drain")
+        from horovod_tpu.core import engine as _eng
+
+        _eng.quiesce_engine(min(deadline, 5.0),
+                            reason=f"graceful preemption ({why})")
+        ckpt_dir = _elastic.checkpoint_dir()
+        ckpt_path = None
+        if ckpt_dir:
+            # Crash-atomic by construction (utils/checkpoint.py: tmp +
+            # fsync + rename): an escalated SIGKILL mid-save can never
+            # corrupt the newest checkpoint a relaunch resumes from.
+            ok, ckpt_path = _preempt.bounded(
+                lambda: self.save(ckpt_dir, step=epoch), deadline,
+                "emergency checkpoint")
+            if not ok:
+                _ELASTIC_LOG.error(
+                    "graceful preemption: emergency checkpoint did not "
+                    "complete; the relaunch resumes from the previous "
+                    "one")
+        else:
+            _ELASTIC_LOG.warning(
+                "graceful preemption: no checkpoint dir configured "
+                "(HVD_CHECKPOINT_DIR / HVD_ELASTIC_DIR) — exiting "
+                "without an emergency checkpoint")
+        if _elastic.active():
+            # A preempting rank going silent must read as a PLANNED
+            # exit to its peers' lease, not a casualty.
+            _elastic.get_world().announce_done()
+        barriered = _preempt.drain_barrier()
+        note = _preempt.journal_note(
+            epoch=epoch, step=self._gstep,
+            checkpoint=ckpt_path, step_drained=bool(drained),
+            barrier_ok=bool(barriered))
+        _ELASTIC_LOG.warning(
+            "graceful preemption complete: step_drained=%s checkpoint=%s"
+            " barrier_ok=%s note=%s — exiting 0", bool(drained),
+            ckpt_path or "none", bool(barriered), note or "none")
+        # The stdout marker the launcher/operator (and the chaos tier)
+        # greps for; os._exit because interpreter teardown in a
+        # multi-process world mid-eviction can hang in distributed-
+        # client destructors (the exit_for_restart precedent).
+        print(f"PREEMPTED rank={_tl._process_index()} epoch={epoch} "
+              f"ckpt={'yes' if ckpt_path else 'no'} exiting=0",
+              flush=True)
+        try:
+            import sys
+
+            sys.stdout.flush()
+            sys.stderr.flush()
+        except Exception:
+            pass
+        os._exit(0)
 
     def _elastic_recover(self, x_sample) -> int:
         """Death-verdict recovery: reconfigure the world (in-place

@@ -79,24 +79,110 @@ def _exit_status(code: int) -> int:
 RESTART_EXIT_CODE = 77
 
 
+def _graceful_stop(procs, grace_s: float, signum: int) -> int:
+    """Graceful preemption drain (the launcher half of the ladder in
+    core/preempt.py): forward SIGTERM to every live child, wait up to
+    ``grace_s`` for them to drain/checkpoint/exit on their own, and
+    escalate to SIGKILL only for the stragglers — reporting which
+    children exited clean vs were escalated. Returns the launcher
+    status: 0 when every child exited 0 (a fully clean eviction),
+    128+signum otherwise."""
+    alive = [i for i, p in enumerate(procs) if p.poll() is None]
+    sys.stderr.write(
+        f"[launcher] {signal.Signals(signum).name} received: forwarding "
+        f"to {len(alive)} child(ren) and draining up to "
+        f"{grace_s:.0f}s before escalating\n")
+    for i in alive:
+        try:
+            procs[i].terminate()  # SIGTERM: the child's graceful ladder
+        except OSError:
+            pass
+    deadline = time.monotonic() + max(0.0, grace_s)
+    reported: set = set()
+    while time.monotonic() < deadline:
+        for i, p in enumerate(procs):
+            if i in reported or p.poll() is None:
+                continue
+            reported.add(i)
+            if p.returncode == 0:
+                sys.stderr.write(f"[launcher] rank {i} (pid {p.pid}) "
+                                 "exited clean during the drain\n")
+            else:
+                sys.stderr.write(
+                    "[launcher] "
+                    + _describe_exit(i, p.pid, p.returncode)
+                    + " during the drain\n")
+        if all(p.poll() is not None for p in procs):
+            break
+        time.sleep(0.05)
+    escalated = [i for i, p in enumerate(procs) if p.poll() is None]
+    for i in escalated:
+        sys.stderr.write(
+            f"[launcher] rank {i} (pid {procs[i].pid}) did not exit "
+            f"within --grace-s={grace_s:.0f}; escalating to SIGKILL\n")
+        try:
+            procs[i].kill()
+        except OSError:
+            pass
+    for p in procs:
+        try:
+            p.wait(timeout=10)
+        except Exception:
+            pass
+    clean = all(p.returncode == 0 for p in procs)
+    sys.stderr.write(
+        f"[launcher] drain complete: "
+        f"{sum(1 for p in procs if p.returncode == 0)} clean, "
+        f"{len(escalated)} escalated\n")
+    return 0 if clean else 128 + signum
+
+
 def _run_failfast(args, spawn_world) -> int:
     """mpirun parity: first child death tears the world down — after an
     attributed report of who died and how. A sequential wait() would
     never observe a higher-index child dying while process 0 blocks in a
-    collective, hence the poll loop."""
+    collective, hence the poll loop. SIGTERM (the platform's eviction
+    signal) is NOT a teardown: it is forwarded and the children get
+    ``--grace-s`` to drain before the SIGKILL escalation."""
     procs, threads = spawn_world({})
 
     def _kill_all(signum=None, frame=None):
+        # Casualty/interactive teardown: SIGTERM first, but children now
+        # TRAP it for the graceful-preemption ladder — a survivor blocked
+        # inside a cross-rank collective never reaches the batch-boundary
+        # poll, so escalate to SIGKILL after a SHORT window. This is a
+        # crash teardown, not an eviction: nobody gets --grace-s here
+        # (mpirun parity — quick, bounded, never wedged).
         for p in procs:
             if p.poll() is None:
                 p.terminate()
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline:
+            if all(p.poll() is not None for p in procs):
+                return
+            time.sleep(0.05)
+        for p in procs:
+            if p.poll() is None:
+                try:
+                    p.kill()
+                except OSError:
+                    pass
+
+    sigterm = []
+
+    def _on_term(signum, frame):
+        sigterm.append(signum)  # handled by the poll loop, not inline
 
     signal.signal(signal.SIGINT, _kill_all)
-    signal.signal(signal.SIGTERM, _kill_all)
+    signal.signal(signal.SIGTERM, _on_term)
 
     rc = 0
     pending = set(range(len(procs)))
     while pending:
+        if sigterm:
+            rc = _graceful_stop(procs, args.grace_s, sigterm[0])
+            pending.clear()
+            break
         exited = [i for i in pending if procs[i].poll() is not None]
         for i in exited:
             pending.discard(i)
@@ -363,9 +449,28 @@ def _supervise_elastic(args, spawn_world) -> int:
                         f"[launcher] cannot file rejoin request: {exc}\n")
             time.sleep(0.05)
         if interrupted:
+            if signal.SIGTERM in interrupted:
+                # Platform eviction: forward, grace-drain, escalate —
+                # same ladder as the non-elastic launcher.
+                return _graceful_stop(procs, args.grace_s,
+                                      signal.SIGTERM)
+            # SIGINT (interactive): quick teardown — children trap
+            # SIGTERM (preempt intake), so a short SIGKILL escalation
+            # keeps "quick" true instead of leaving drain-laddering
+            # orphans behind the returned prompt.
             for p in procs:
                 if p.poll() is None:
                     p.terminate()
+            deadline = time.monotonic() + 5.0
+            while (time.monotonic() < deadline
+                   and any(p.poll() is None for p in procs)):
+                time.sleep(0.05)
+            for p in procs:
+                if p.poll() is None:
+                    try:
+                        p.kill()
+                    except OSError:
+                        pass
             return 130
         for t in threads:
             t.join(timeout=5)
@@ -439,6 +544,12 @@ def main(argv=None):
                     help="elastic: per-rank readmissions and full-world "
                          "relaunches allowed before giving up "
                          "(default 3)")
+    ap.add_argument("--grace-s", type=float, default=30.0, metavar="S",
+                    help="graceful preemption: on SIGTERM, forward the "
+                         "signal to every child and wait S seconds for "
+                         "them to drain/checkpoint/exit 0 before "
+                         "escalating to SIGKILL (default 30; both "
+                         "elastic and plain modes)")
     ap.add_argument("--faults", action="append", metavar="RANK:SPEC",
                     default=None,
                     help="fault injection (core/faultline.py): arm "

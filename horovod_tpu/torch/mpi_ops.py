@@ -110,7 +110,8 @@ def synchronize(handle: int) -> torch.Tensor:
 def allreduce_async(tensor: torch.Tensor, average: bool = True,
                     name: Optional[str] = None,
                     compression: Optional[str] = None,
-                    donate: bool = False) -> int:
+                    donate: bool = False,
+                    deadline_ms: Optional[float] = None) -> int:
     # `compression` here is the per-request ENGINE wire-format name
     # ('int8'/'fp8' — a Compressor's .engine_wire); cast compressors are
     # applied by the caller around the collective as in the reference.
@@ -120,10 +121,13 @@ def allreduce_async(tensor: torch.Tensor, average: bool = True,
     # unwriteable, but torch can still write through its own reference:
     # mutating a donated tensor before synchronize() is undefined
     # behavior, the caller's promise to keep (see docs/running.md).
+    # `deadline_ms` bounds the wait: an overdue request fails its waiter
+    # with an attributed CollectiveTimeout (overrides the engine-wide
+    # HVD_COLLECTIVE_DEADLINE_S default).
     out = torch.empty_like(tensor)
     h = get_engine().allreduce_async(
         _auto_name("allreduce", name), _np_of(tensor), average,
-        compression=compression, donate=donate
+        compression=compression, donate=donate, deadline_ms=deadline_ms
     )
     _register(h, tensor, out)
     return h
@@ -131,10 +135,21 @@ def allreduce_async(tensor: torch.Tensor, average: bool = True,
 
 def allreduce_async_(tensor: torch.Tensor, average: bool = True,
                      name: Optional[str] = None,
-                     compression: Optional[str] = None) -> int:
+                     compression: Optional[str] = None,
+                     donate: bool = False,
+                     deadline_ms: Optional[float] = None) -> int:
+    # In-place + donation (PR 13 follow-up): the engine references the
+    # tensor's host buffer in place and only READS it — the reduced
+    # result lands in engine-pooled buffers and is copied back into the
+    # tensor at synchronize(), AFTER the engine dropped its reference,
+    # so the in-place write-back never races the zero-copy read. The
+    # contract is the same read-only/frozen-view one as the out-of-place
+    # variant: the numpy view is flagged unwriteable, and a caller that
+    # writes through the torch reference before completion breaks its
+    # own donation (documented UB, docs/running.md).
     h = get_engine().allreduce_async(
         _auto_name("allreduce", name), _np_of(tensor), average,
-        compression=compression
+        compression=compression, donate=donate, deadline_ms=deadline_ms
     )
     _register(h, tensor, tensor)
     return h
@@ -163,8 +178,13 @@ def allreduce(tensor: torch.Tensor, average: bool = True,
 
 
 def allreduce_(tensor: torch.Tensor, average: bool = True,
-               name: Optional[str] = None) -> torch.Tensor:
-    return synchronize(allreduce_async_(tensor, average, name))
+               name: Optional[str] = None,
+               donate: bool = False) -> torch.Tensor:
+    # donate=True is safe here even for an impatient caller: this
+    # blocking variant cannot touch the tensor between submit and
+    # synchronize by construction.
+    return synchronize(allreduce_async_(tensor, average, name,
+                                        donate=donate))
 
 
 # ---------------------------------------------------------------------------
@@ -172,9 +192,11 @@ def allreduce_(tensor: torch.Tensor, average: bool = True,
 # ---------------------------------------------------------------------------
 
 def allgather_async(tensor: torch.Tensor, name: Optional[str] = None,
-                    donate: bool = False) -> int:
+                    donate: bool = False,
+                    deadline_ms: Optional[float] = None) -> int:
     h = get_engine().allgather_async(_auto_name("allgather", name),
-                                     _np_of(tensor), donate=donate)
+                                     _np_of(tensor), donate=donate,
+                                     deadline_ms=deadline_ms)
     _register(h, tensor, None)
     return h
 
@@ -206,20 +228,26 @@ def allgather(tensor: torch.Tensor, name: Optional[str] = None) -> torch.Tensor:
 
 def broadcast_async(tensor: torch.Tensor, root_rank: int,
                     name: Optional[str] = None,
-                    donate: bool = False) -> int:
+                    donate: bool = False,
+                    deadline_ms: Optional[float] = None) -> int:
     out = torch.empty_like(tensor)
     h = get_engine().broadcast_async(
         _auto_name("broadcast", name), _np_of(tensor), root_rank,
-        donate=donate
+        donate=donate, deadline_ms=deadline_ms
     )
     _register(h, tensor, out)
     return h
 
 
 def broadcast_async_(tensor: torch.Tensor, root_rank: int,
-                     name: Optional[str] = None) -> int:
+                     name: Optional[str] = None,
+                     donate: bool = False,
+                     deadline_ms: Optional[float] = None) -> int:
+    # Same in-place donation contract as allreduce_async_: zero-copy
+    # read by the engine, result written back at synchronize().
     h = get_engine().broadcast_async(
-        _auto_name("broadcast", name), _np_of(tensor), root_rank
+        _auto_name("broadcast", name), _np_of(tensor), root_rank,
+        donate=donate, deadline_ms=deadline_ms
     )
     _register(h, tensor, tensor)
     return h
@@ -245,5 +273,7 @@ def broadcast(tensor: torch.Tensor, root_rank: int,
 
 
 def broadcast_(tensor: torch.Tensor, root_rank: int,
-               name: Optional[str] = None) -> torch.Tensor:
-    return synchronize(broadcast_async_(tensor, root_rank, name))
+               name: Optional[str] = None,
+               donate: bool = False) -> torch.Tensor:
+    return synchronize(broadcast_async_(tensor, root_rank, name,
+                                        donate=donate))

@@ -1,0 +1,80 @@
+"""Graceful-preemption chaos worker — run under the launcher:
+
+    python -m horovod_tpu.run -np 2 --cpu -- python preempt_worker.py
+
+Phase 1 (the eviction): every rank arms the deterministic
+``preempt.signal`` faultline site (same spec, lockstep batch count), so
+the whole world "receives SIGTERM" at the same batch boundary — the
+trainer must drain the step, write the crash-atomic emergency
+checkpoint, quiesce the engine, pass the drain barrier, journal a
+``preempted`` note, and exit 0 (the ``PREEMPTED rank=...`` marker).
+
+Phase 2 (the relaunch — a second launcher run with no faults): resumes
+from the newest checkpoint and finishes the remaining epochs; per-epoch
+losses land in ``$HVD_PREEMPT_TEST_DIR/losses.rank<N>.jsonl`` across
+BOTH phases so the pytest driver can assert the curve is continuous
+(no restart-from-scratch jump)."""
+
+import json
+import os
+import sys
+import time
+
+RANK = int(os.environ.get("HVD_PROCESS_ID", "0"))
+OUT = os.environ["HVD_PREEMPT_TEST_DIR"]
+EPOCHS = int(os.environ.get("HVD_TEST_EPOCHS", "6"))
+
+import numpy as np  # noqa: E402
+
+import horovod_tpu as hvd  # noqa: E402
+import horovod_tpu.keras as hk  # noqa: E402
+from horovod_tpu.core import elastic  # noqa: E402
+
+hvd.init()
+print(f"WORLD rank={hvd.process_index()} np={hvd.num_processes()} "
+      f"size={hvd.size()}", flush=True)
+
+import flax.linen as nn  # noqa: E402
+import optax  # noqa: E402
+
+
+class MLP(nn.Module):
+    @nn.compact
+    def __call__(self, x, train=False):
+        h = nn.relu(nn.Dense(16)(x))
+        return nn.Dense(4)(h)
+
+
+rng = np.random.default_rng(0)
+N, BS = 256, 4
+x = rng.normal(size=(N, 8)).astype(np.float32)
+w_true = rng.normal(size=(8, 4)).astype(np.float32)
+y = (x @ w_true).argmax(axis=1).astype(np.int32)
+
+
+class Log(hk.callbacks.Callback):
+    def on_epoch_end(self, epoch, logs=None):
+        rec = {"rank": RANK, "epoch": epoch,
+               "loss": float(logs.get("loss", -1.0)),
+               "size": hvd.size(), "wall": round(time.time(), 3)}
+        with open(os.path.join(OUT, f"losses.rank{RANK}.jsonl"),
+                  "a") as fh:
+            fh.write(json.dumps(rec) + "\n")
+        print(f"EPOCH rank={RANK} epoch={epoch} "
+              f"loss={rec['loss']:.4f}", flush=True)
+
+
+trainer = hk.Trainer(MLP(), optax.sgd(0.02, momentum=0.9), rng=0)
+x_sample = x[:BS * hvd.local_size()]
+initial_epoch = elastic.maybe_restore(trainer, x_sample)
+if initial_epoch:
+    print(f"RESUMED rank={RANK} at epoch {initial_epoch}", flush=True)
+
+trainer.fit(x, y, batch_size=BS, epochs=EPOCHS, shuffle=False,
+            initial_epoch=initial_epoch, callbacks=[Log()])
+
+print(f"PREEMPT_TEST DONE rank={RANK} epochs={EPOCHS}", flush=True)
+sys.stdout.flush()
+# Same exit discipline as the other world workers: interpreter teardown
+# barriers can hang if a peer left first.
+os._exit(0)

@@ -118,8 +118,8 @@ def test_abi_catches_skewed_ctypes_field(tmp_path):
 
 def test_abi_catches_new_c_field_missing_from_mirror(tmp_path):
     root = _mini_root(tmp_path)
-    _edit(root, _CC, "long long pool_bytes_resident;\n};",
-          "long long pool_bytes_resident;\n  long long new_counter;\n};")
+    _edit(root, _CC, "long long cancelled;\n};",
+          "long long cancelled;\n  long long new_counter;\n};")
     findings = abi.check(root)
     assert any(f.rule == "abi-struct" and "new_counter" in f.message
                for f in findings), findings
@@ -366,6 +366,84 @@ def test_rule_entrypoint_imports_clean_on_live_entrypoints():
     assert invariants.check_entrypoint_imports(REPO) == []
 
 
+def test_rule_fault_site_registry_clean_on_live_tree():
+    assert invariants.check_fault_sites(REPO) == []
+
+
+def _fault_root(tmp_path):
+    """A mini root with the real faultline.py + one consumer + one
+    chaos-spec reference, for seeding registry skews."""
+    core = tmp_path / "horovod_tpu" / "core"
+    core.mkdir(parents=True)
+    shutil.copy(os.path.join(REPO, "horovod_tpu", "core", "faultline.py"),
+                core)
+    (core / "consumer.py").write_text(
+        "from horovod_tpu.core import faultline as flt\n\n\n"
+        "def submit(name):\n"
+        "    injected = flt.engine_submit(name)\n"
+        "    flt.kv_get(name)\n"
+        "    flt.kv_set(name, 'v')\n"
+        "    flt.kv_try_get(name)\n"
+        "    flt.heartbeat()\n"
+        "    flt.engine_exec('allreduce')\n"
+        "    flt.pool_exhausted()\n"
+        "    flt.ckpt_write()\n"
+        "    flt.preempt_signal()\n")
+    tests = tmp_path / "tests"
+    tests.mkdir()
+    (tests / "test_chaos.py").write_text(
+        "SPEC = 'hb.beat:skip:*@8'\n")
+    return str(tmp_path)
+
+
+def test_rule_fault_site_registry_catches_renamed_site(tmp_path):
+    """The satellite's canonical seed: a site renamed in the registry
+    while a chaos spec still references the old name — the spec would
+    silently inject nothing."""
+    root = _fault_root(tmp_path)
+    assert invariants.check_fault_sites(root) == []
+    _edit(root, os.path.join("horovod_tpu", "core", "faultline.py"),
+          '"hb.beat"', '"hb.pulse"')
+    findings = invariants.check_fault_sites(root)
+    assert any("hb.beat" in f.message and f.rule == "fault-site-registry"
+               for f in findings), findings
+
+
+def test_rule_fault_site_registry_catches_unknown_mode(tmp_path):
+    root = _fault_root(tmp_path)
+    with open(os.path.join(root, "tests", "test_chaos.py"), "a") as fh:
+        # (Assembled so the LIVE tree's scan of this very test file
+        # does not see a bad spec literal.)
+        fh.write("BAD = '" + "engine.exec" + ":explode:1'\n")
+    findings = invariants.check_fault_sites(root)
+    assert any("'explode'" in f.message for f in findings), findings
+
+
+def test_rule_fault_site_registry_catches_unthreaded_site(tmp_path):
+    """A site whose guard helper is never called from source is declared
+    but inert — chaos specs naming it test nothing."""
+    root = _fault_root(tmp_path)
+    _edit(root, os.path.join("horovod_tpu", "core", "consumer.py"),
+          "    flt.ckpt_write()\n", "")
+    findings = invariants.check_fault_sites(root)
+    assert any("ckpt.write" in f.message and "not threaded" in f.message
+               for f in findings), findings
+
+
+def test_rule_fault_site_registry_exempts_negative_fixtures(tmp_path):
+    """Deliberately-invalid specs inside FaultSpecError rejection tests
+    are negative fixtures, not site references."""
+    root = _fault_root(tmp_path)
+    with open(os.path.join(root, "tests", "test_chaos.py"), "a") as fh:
+        fh.write(
+            "import pytest\n"
+            "from horovod_tpu.core import faultline as flt\n\n\n"
+            "def test_bad_spec_rejected():\n"
+            "    with pytest.raises(flt.FaultSpecError):\n"
+            "        flt.configure('no.such" + ":delay:1')\n")
+    assert invariants.check_fault_sites(root) == []
+
+
 # ---------------------------------------------------------------------------
 # CLI contract
 # ---------------------------------------------------------------------------
@@ -450,4 +528,37 @@ def test_tsan_native_engine_smoke():
                                   proc.stderr[-4000:])
     assert "TSAN_SMOKE_OK" in proc.stdout
     assert "WARNING: ThreadSanitizer" not in proc.stderr, \
+        proc.stderr[-4000:]
+
+
+@pytest.mark.slow
+@pytest.mark.skipif(not _slow_on,
+                    reason="ASan smoke is the opt-in tier: "
+                           "HVD_SLOW_TESTS=1 to run")
+def test_asan_native_engine_smoke():
+    """HVD_SANITIZE=address produces a working instrumented build, and
+    the same multi-threaded native-engine workout as the TSan smoke
+    reports ZERO AddressSanitizer errors (PR 14 follow-up — the
+    ASan-tier mirror). Leak detection stays OFF: the engine leaks
+    by DOCTRINE (quiesce-then-leak, parked donations), and the
+    uninstrumented CPython host would drown the report regardless —
+    this smoke is about overflows/use-after-free in the C++ core."""
+    from horovod_tpu.core import native
+
+    lib = native.build_library(mode="address")
+    runtime = native.sanitizer_runtime("address")
+    env = dict(os.environ)
+    env["LD_PRELOAD"] = runtime
+    env["HVD_SANITIZE"] = "address"
+    env["ASAN_OPTIONS"] = ("detect_leaks=0 abort_on_error=0 "
+                           "exitcode=66 allocator_may_return_null=1")
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tests",
+                                      "tsan_smoke_worker.py")],
+        capture_output=True, text=True, timeout=600, env=env, cwd=REPO)
+    assert os.path.exists(lib)
+    assert proc.returncode == 0, (proc.returncode, proc.stdout[-2000:],
+                                  proc.stderr[-4000:])
+    assert "TSAN_SMOKE_OK" in proc.stdout  # same worker, same marker
+    assert "ERROR: AddressSanitizer" not in proc.stderr, \
         proc.stderr[-4000:]

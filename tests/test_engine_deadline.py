@@ -1,0 +1,460 @@
+"""Deadlines, cooperative cancel, and quiesce — the bounded-waiting /
+planned-eviction plane of the collective engine (ISSUE 15 tentpole),
+pinned for BOTH engines:
+
+- per-request deadlines fail the WAITER with an attributed
+  CollectiveTimeout naming the stuck phase (QUEUE / NEGOTIATE_* /
+  ALLREDUCE) plus ONE flight dump, while the entry itself may still be
+  in flight;
+- cancel() retires pre-announce entries locally and discards the result
+  of already-announced/executing ones (CancelledError either way);
+- quiesce() closes admission with a descriptive error, drains in-flight
+  work within a deadline, reports what drained, and flips /healthz to
+  ``draining``;
+- no deadline set = zero new hot-path work (the sweep short-circuits).
+"""
+
+import glob
+import json
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from horovod_tpu.core import engine as eng
+from horovod_tpu.core import telemetry as tele
+from horovod_tpu.core import timeline as tl
+from horovod_tpu.core.native_engine import NativeEngine
+
+
+class GatedExecutor:
+    """Local data plane whose allreduce can be held open (the wedged-
+    collective stand-in the deadline plane exists for)."""
+
+    measure_staging = False
+    last_stage_s = 0.0
+    pool = None
+    wire_policy = "none"
+    last_wire_bytes = 0
+    last_wire_compressed = 0
+
+    def __init__(self, world=8):
+        self.world = world
+        self.gate = threading.Event()
+        self.gate.set()  # open by default; tests close it to wedge
+        self.calls = []
+
+    def allreduce(self, flat, average):
+        self.calls.append(flat.size)
+        assert self.gate.wait(10.0), "executor gate never released"
+        return flat if average else flat * self.world
+
+    def allgather(self, t):
+        return np.tile(t, (self.world,) + (1,) * (t.ndim - 1))
+
+    def broadcast(self, t, root):
+        return t.copy()
+
+
+def _mk_py(executor=None, **kw):
+    kw.setdefault("cycle_time_s", 0.002)
+    kw.setdefault("stall_warning_s", 0.2)
+    kw.setdefault("timeline", tl.Timeline(None))
+    return eng.Engine(executor=executor or GatedExecutor(), **kw)
+
+
+def _mk_native(executor=None, **kw):
+    kw.setdefault("cycle_time_s", 0.002)
+    kw.setdefault("stall_warning_s", 0.2)
+    kw.setdefault("timeline_path", "")
+    return NativeEngine(executor=executor or GatedExecutor(), **kw)
+
+
+ENGINES = [("python", _mk_py), ("native", _mk_native)]
+
+
+# ---------------------------------------------------------------------------
+# deadline expiry per phase
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("impl,mk", ENGINES)
+def test_deadline_fails_waiter_in_exec_phase(impl, mk, tmp_path,
+                                             monkeypatch):
+    """An entry wedged INSIDE the executor call: the watchdog-side sweep
+    fails the waiter promptly with the op-phase attribution, one flight
+    dump lands, and the late completion is discarded (not delivered)."""
+    monkeypatch.setenv("HVD_FLIGHT_DIR", str(tmp_path))
+    monkeypatch.setenv("HVD_FLIGHT_MIN_INTERVAL", "0")
+    ex = GatedExecutor()
+    ex.gate.clear()  # wedge the collective
+    e = mk(ex)
+    try:
+        before = tele.REGISTRY.counter("engine.deadline_exceeded").value
+        h = e.allreduce_async("wedge", np.ones(8, np.float32), False,
+                              deadline_ms=150)
+        t0 = time.monotonic()
+        with pytest.raises(eng.CollectiveTimeout) as ei:
+            e.synchronize(h)
+        took = time.monotonic() - t0
+        assert took < 5.0, took  # failed fast, not the stall horizon
+        msg = str(ei.value)
+        assert "wedge" in msg and "ALLREDUCE" in msg, msg
+        assert "exceeded its deadline" in msg
+        assert tele.REGISTRY.counter(
+            "engine.deadline_exceeded").value == before + 1
+        # ONE attributed flight dump names the stuck phase (written by
+        # the sweep thread right after it wakes the waiter — poll).
+        deadline = time.monotonic() + 3.0
+        mine = []
+        while not mine and time.monotonic() < deadline:
+            dumps = []
+            for path in glob.glob(os.path.join(str(tmp_path), "*.json")):
+                try:
+                    dumps.append(json.load(open(path)))
+                except (OSError, ValueError):
+                    continue
+            mine = [d for d in dumps if "deadline" in d.get("reason", "")]
+            if not mine:
+                time.sleep(0.02)
+        assert len(mine) == 1, [d.get("reason") for d in dumps]
+        assert "ALLREDUCE" in mine[0]["reason"] or \
+            "wedge" in mine[0]["reason"], mine[0]["reason"]
+    finally:
+        ex.gate.set()
+        time.sleep(0.05)  # let the late completion retire the entry
+        e.shutdown()
+
+
+@pytest.mark.parametrize("impl,mk", ENGINES)
+def test_deadline_fires_under_default_watchdog_cadence(impl, mk):
+    """Regression: with the DEFAULT stall cadence (60 s -> 12 s watchdog
+    tick) the tightened sweep tick alone only takes effect on the NEXT
+    watchdog wait — a deadline'd submit must KICK the watchdog out of an
+    already-started coarse sleep, or an exec-wedged request waits out
+    the executor instead of its deadline. Found by driving the default
+    config; the other tests mask it with stall_warning_s=0.2."""
+    ex = GatedExecutor()
+    ex.gate.clear()  # wedge the collective
+    e = mk(ex, stall_warning_s=60.0)
+    try:
+        # Let the watchdog settle into its coarse (12 s) sleep first.
+        time.sleep(0.3)
+        h = e.allreduce_async("kick", np.ones(8, np.float32), False,
+                              deadline_ms=150)
+        t0 = time.monotonic()
+        with pytest.raises(eng.CollectiveTimeout):
+            e.synchronize(h)
+        took = time.monotonic() - t0
+        assert took < 2.0, took  # kicked awake, not the 12 s tick
+    finally:
+        ex.gate.set()
+        time.sleep(0.05)
+        e.shutdown()
+
+
+@pytest.mark.parametrize("impl,mk", ENGINES)
+def test_deadline_fails_waiter_in_queue_phase(impl, mk):
+    """An entry stuck behind a wedged cycle, never executed: QUEUE-phase
+    attribution (the loop thread is busy, the watchdog sweep fires)."""
+    ex = GatedExecutor()
+    e = mk(ex)
+    try:
+        ex.gate.clear()
+        h_plug = e.allreduce_async("plug", np.ones(4, np.float32), False)
+        time.sleep(0.05)  # plug is inside the executor; queue is wedged
+        h = e.allreduce_async("queued", np.ones(4, np.float32), False,
+                              deadline_ms=120)
+        with pytest.raises(eng.CollectiveTimeout, match="QUEUE"):
+            e.synchronize(h)
+        ex.gate.set()
+        np.testing.assert_allclose(e.synchronize(h_plug),
+                                   np.full(4, 8.0))
+    finally:
+        ex.gate.set()
+        e.shutdown()
+
+
+def test_deadline_negotiate_phase_python_engine():
+    """Multi-controller attribution: an entry announced to a coordinator
+    that never resolves it is stuck in NEGOTIATE_* — the per-cycle sweep
+    names the phase (python engine; the native twin shares the literal
+    via the parity-checked span vocabulary)."""
+    from horovod_tpu.core import coordinator as coord
+
+    class StallingCoord:
+        clock_ready = False
+        last_tables = None
+        cycle_time_s = 0.002
+        fusion_threshold = 1 << 26
+        waiting_on = None
+        dead = None
+
+        def negotiate(self, metas):
+            # Peers never agree: nothing resolves, nothing errors.
+            return coord.Decision(groups=[])
+
+        def missing_processes(self, name):
+            return []
+
+        def close(self):
+            pass
+
+    ex = GatedExecutor()
+    e = _mk_py(ex)
+    try:
+        e._coordinator = StallingCoord()
+        h = e.allreduce_async("negotiating", np.ones(4, np.float32),
+                              False, deadline_ms=120)
+        with pytest.raises(eng.CollectiveTimeout,
+                           match="NEGOTIATE_ALLREDUCE"):
+            e.synchronize(h)
+    finally:
+        e._coordinator = None
+        e.shutdown()
+
+
+@pytest.mark.parametrize("impl,mk", ENGINES)
+def test_env_default_deadline(impl, mk, monkeypatch):
+    """HVD_COLLECTIVE_DEADLINE_S arms every request; per-request
+    deadline_ms <= 0 opts a single request back out."""
+    monkeypatch.setenv("HVD_COLLECTIVE_DEADLINE_S", "0.15")
+    ex = GatedExecutor()
+    ex.gate.clear()
+    e = mk(ex)
+    try:
+        assert e.default_deadline_s == pytest.approx(0.15)
+        h = e.allreduce_async("defaulted", np.ones(4, np.float32), False)
+        with pytest.raises(eng.CollectiveTimeout):
+            e.synchronize(h)
+    finally:
+        ex.gate.set()
+        time.sleep(0.05)
+        e.shutdown()
+
+
+def test_bad_deadline_env_fails_fast(monkeypatch):
+    monkeypatch.setenv("HVD_COLLECTIVE_DEADLINE_S", "soon")
+    with pytest.raises(eng.EngineError, match="HVD_COLLECTIVE_DEADLINE_S"):
+        eng.collective_deadline_from_env()
+
+
+def test_no_deadline_means_no_sweep_work():
+    """The acceptance's zero-new-hot-path-work clause: with no deadline
+    armed, the sweep is a counter check and nothing else."""
+    e = _mk_py()
+    try:
+        assert e._deadline_count == 0
+        h = e.allreduce_async("plain", np.ones(4, np.float32), False)
+        assert e._deadline_count == 0
+        e.synchronize(h)
+        before = tele.REGISTRY.counter("engine.deadline_exceeded").value
+        e._sweep_deadlines()  # must be a no-op
+        assert tele.REGISTRY.counter(
+            "engine.deadline_exceeded").value == before
+    finally:
+        e.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# cooperative cancel
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("impl,mk", ENGINES)
+def test_cancel_before_execution_retires_locally(impl, mk):
+    """A cancel that lands while the entry is still queued: the entry
+    never reaches the executor; synchronize raises CancelledError and
+    engine.cancelled counts it."""
+    ex = GatedExecutor()
+    e = mk(ex)
+    try:
+        before = tele.REGISTRY.counter("engine.cancelled").value
+        ex.gate.clear()
+        h_plug = e.allreduce_async("plug", np.ones(4, np.float32), False)
+        time.sleep(0.05)
+        h = e.allreduce_async("victim", np.ones(4, np.float32), False)
+        assert e.cancel(h) is True
+        ex.gate.set()
+        with pytest.raises(eng.CancelledError, match="victim"):
+            e.synchronize(h)
+        e.synchronize(h_plug)
+        # The victim never executed (only the plug hit the data plane).
+        assert len(ex.calls) == 1, ex.calls
+        if hasattr(e, "_collect_stats"):
+            e._collect_stats()  # native: fold the C++ counters in
+        assert tele.REGISTRY.counter(
+            "engine.cancelled").value == before + 1
+        # The name is free again after the cancelled retirement.
+        h2 = e.allreduce_async("victim", np.ones(4, np.float32), False)
+        np.testing.assert_allclose(e.synchronize(h2), np.full(4, 8.0))
+    finally:
+        ex.gate.set()
+        e.shutdown()
+
+
+@pytest.mark.parametrize("impl,mk", ENGINES)
+def test_cancel_mid_execution_completes_and_discards(impl, mk):
+    """A cancel AFTER the entry reached the executor (the post-agreement
+    shape: a fused/negotiated batch cannot be torn): execution completes
+    cross-rank, the result is discarded, the waiter sees
+    CancelledError."""
+    ex = GatedExecutor()
+    e = mk(ex)
+    try:
+        ex.gate.clear()
+        h = e.allreduce_async("midflight", np.ones(4, np.float32), False)
+        deadline = time.monotonic() + 5
+        while not ex.calls:  # wait until it is INSIDE the executor
+            assert time.monotonic() < deadline
+            time.sleep(0.002)
+        assert e.cancel(h) is True
+        ex.gate.set()  # the collective completes...
+        with pytest.raises(eng.CancelledError):  # ...and is discarded
+            e.synchronize(h)
+        assert len(ex.calls) == 1  # it DID execute (coherence preserved)
+    finally:
+        ex.gate.set()
+        e.shutdown()
+
+
+@pytest.mark.parametrize("impl,mk", ENGINES)
+def test_cancel_unknown_or_done_returns_false(impl, mk):
+    e = mk()
+    try:
+        h = e.allreduce_async("done", np.ones(2, np.float32), False)
+        e.synchronize(h)
+        assert e.cancel(h) is False
+        assert e.cancel(10_000) is False
+    finally:
+        e.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# quiesce (admission close + bounded drain)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("impl,mk", ENGINES)
+def test_quiesce_drains_and_closes_admission(impl, mk):
+    from horovod_tpu.core import sentinel
+
+    ex = GatedExecutor()
+    e = mk(ex)
+    try:
+        hs = [e.allreduce_async(f"drain/{i}", np.ones(4, np.float32),
+                                False) for i in range(3)]
+        report = e.quiesce(2.0, reason="test drain")
+        assert report["deadline_hit"] is False
+        # Everything in flight completed...
+        for h in hs:
+            np.testing.assert_allclose(e.synchronize(h), np.full(4, 8.0))
+        # ...and new work fails fast with the descriptive error.
+        with pytest.raises(eng.EngineError, match="draining.*quiesce"):
+            e.allreduce_async("late", np.ones(2, np.float32), False)
+        # /healthz reports draining (non-200 at the endpoint).
+        h = sentinel.health()
+        assert h["status"] == "draining"
+        assert "test drain" in h["draining"]
+    finally:
+        sentinel.note_draining(None)
+        e.shutdown()
+
+
+@pytest.mark.parametrize("impl,mk", ENGINES)
+def test_quiesce_deadline_reports_wedged_work(impl, mk):
+    """Work wedged behind a dead peer cannot be drained — the report
+    NAMES it instead of hanging (both engines: the report shape — name
+    lists, not counts — is part of the same-observable-semantics
+    contract; the native binding reads the names off the C++ table via
+    hvd_engine_pending_names)."""
+    from horovod_tpu.core import sentinel
+
+    ex = GatedExecutor()
+    ex.gate.clear()
+    e = mk(ex)
+    try:
+        e.allreduce_async("wedged", np.ones(4, np.float32), False)
+        time.sleep(0.03)
+        t0 = time.monotonic()
+        report = e.quiesce(0.3, reason="bounded")
+        assert time.monotonic() - t0 < 2.0
+        assert report["deadline_hit"] is True
+        assert "wedged" in report["still_pending"]
+        assert report["drained"] == []
+    finally:
+        sentinel.note_draining(None)
+        ex.gate.set()
+        e.shutdown()
+
+
+def test_quiesce_engine_module_helper_without_engine():
+    """The module-level helper is a no-op when no engine singleton was
+    ever built (the elastic-shrink call site must never build one just
+    to drain it)."""
+    assert eng._engine is None or True  # document intent
+    # Force-check the None path against a private copy of the global.
+    saved = eng._engine
+    try:
+        eng._engine = None
+        assert eng.quiesce_engine(0.1) is None
+    finally:
+        eng._engine = saved
+
+
+# ---------------------------------------------------------------------------
+# timeline/flight surface
+# ---------------------------------------------------------------------------
+
+
+def test_cancel_and_deadline_events_in_ring():
+    """The CANCELLED span and the DEADLINE_EXCEEDED instant (with phase
+    args) land in the flight-recorder ring — the post-mortem surface the
+    parity checker pins across both writers."""
+    ex = GatedExecutor()
+    e = _mk_py(ex)
+    try:
+        ex.gate.clear()
+        h_plug = e.allreduce_async("plug", np.ones(4, np.float32), False)
+        time.sleep(0.05)
+        h = e.allreduce_async("victim", np.ones(4, np.float32), False)
+        e.cancel(h)
+        hd = e.allreduce_async("overdue", np.ones(4, np.float32), False,
+                               deadline_ms=80)
+        with pytest.raises(eng.CollectiveTimeout):
+            e.synchronize(hd)
+        ex.gate.set()
+        with pytest.raises(eng.CancelledError):
+            e.synchronize(h)
+        e.synchronize(h_plug)
+        events = e.timeline.recent()
+        names = {ev.get("name") for ev in events}
+        assert tl.CANCELLED in names, sorted(names)
+        dl = [ev for ev in events
+              if ev.get("name") == tl.DEADLINE_EXCEEDED]
+        assert dl and "phase" in dl[0].get("args", {}), dl
+    finally:
+        ex.gate.set()
+        e.shutdown()
+
+
+def test_native_ring_carries_deadline_instant(tmp_path, monkeypatch):
+    monkeypatch.setenv("HVD_FLIGHT_DIR", str(tmp_path))
+    ex = GatedExecutor()
+    ex.gate.clear()
+    e = _mk_native(ex)
+    try:
+        h = e.allreduce_async("overdue", np.ones(4, np.float32), False,
+                              deadline_ms=80)
+        with pytest.raises(eng.CollectiveTimeout):
+            e.synchronize(h)
+        events = e.recent_events()
+        dl = [ev for ev in events
+              if ev.get("name") == "DEADLINE_EXCEEDED"]
+        assert dl and dl[0].get("args", {}).get("phase"), events[-5:]
+    finally:
+        ex.gate.set()
+        time.sleep(0.05)
+        e.shutdown()

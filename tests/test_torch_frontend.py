@@ -32,6 +32,41 @@ def test_allreduce_inplace():
     np.testing.assert_allclose(x.numpy(), np.full((5,), float(hvt.size())))
 
 
+def test_allreduce_inplace_donate():
+    """PR 13 follow-up: the in-place variants take the donation path —
+    the engine references the tensor's host buffer in place (read-only)
+    and the reduced result is written back at synchronize, AFTER the
+    engine dropped its reference. Same read-only/frozen-view contract
+    as the out-of-place donate."""
+    x = torch.arange(8, dtype=torch.float32) + 1.0
+    out = hvt.allreduce_(x, average=False, donate=True)
+    assert out is x
+    np.testing.assert_allclose(
+        x.numpy(), (np.arange(8, dtype=np.float32) + 1.0) * hvt.size())
+    # The buffer is usable (writable) again after completion: a second
+    # round through the same tensor must work.
+    out = hvt.allreduce_(x, average=True)
+    assert out is x
+
+
+def test_allreduce_async_inplace_donate_poll():
+    from horovod_tpu.torch import mpi_ops
+
+    x = torch.full((6,), 2.0)
+    h = mpi_ops.allreduce_async_(x, average=False, donate=True)
+    out = mpi_ops.synchronize(h)
+    assert out is x
+    np.testing.assert_allclose(x.numpy(),
+                               np.full((6,), 2.0 * hvt.size()))
+
+
+def test_broadcast_inplace_donate():
+    x = torch.arange(5, dtype=torch.float32)
+    out = hvt.broadcast_(x, 0, donate=True)
+    assert out is x
+    np.testing.assert_allclose(x.numpy(), np.arange(5, dtype=np.float32))
+
+
 def test_allreduce_async_poll_synchronize():
     x = torch.ones(4)
     h = hvt.allreduce_async(x, average=False)
