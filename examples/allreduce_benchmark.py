@@ -277,6 +277,92 @@ def run_engine(args, tl_path):
             "rows": rows}
 
 
+def run_small(args, tl_path):
+    """Small-tensor submit→complete throughput (tensors/s): ``--tensors
+    N --bytes B`` — N stable names x B bytes per iteration, submitted
+    through ONE batched engine call (``submit_n`` /
+    ``hvd_engine_enqueue_n``) by default, or per-tensor with
+    ``--per-tensor`` for the baseline this PR's acceptance compares
+    against. The metric is what a gradient bucket of hundreds of small
+    tensors experiences: per-tensor submit OVERHEAD, not bandwidth.
+
+    Two phases keep the timed window honest: throughput is measured with
+    the timeline OFF, then (for ``--json``) a short timeline'd rerun on
+    a fresh engine supplies ``phase_medians`` — with batching working,
+    QUEUE (not MEMCPY) is the residual phase."""
+    import hashlib
+    import os as _os
+
+    from horovod_tpu.core import engine as eng
+
+    e = eng.get_engine()
+    kind = type(e).__name__
+    n = args.tensors
+    elems = max(1, args.bytes // 4)
+    names = [f"bench/{i}" for i in range(n)]
+    tensors = [np.full((elems,), 1.0, np.float32) for _ in range(n)]
+    submit_mode = "per-tensor" if args.per_tensor else "batched"
+    print(f"# small-tensor mode ({kind}, {submit_mode}): "
+          f"{n} x {args.bytes}B per iteration, stable names")
+
+    def one_iter(engine):
+        t_sub0 = time.perf_counter()
+        if args.per_tensor:
+            handles = [engine.allreduce_async(nm, t, average=False)
+                       for nm, t in zip(names, tensors)]
+        else:
+            handles = engine.submit_n("allreduce", [
+                eng.SubmitRequest(nm, t, average=False)
+                for nm, t in zip(names, tensors)])
+        t_sub = time.perf_counter() - t_sub0
+        return [engine.synchronize(h) for h in handles], t_sub
+
+    for _ in range(args.warmup):
+        one_iter(e)
+    submit_s = 0.0
+    t0 = time.perf_counter()
+    for _ in range(args.iters):
+        submit_s += one_iter(e)[1]
+    wall = time.perf_counter() - t0
+    per_iter = wall / args.iters
+    tps = n / per_iter
+    # The submit PLANE alone (handles-in-hand rate): what this PR's
+    # batched ABI actually changes — the backend (negotiate + execute +
+    # drain) is a floor both submit modes share.
+    submit_per_iter = submit_s / args.iters
+    submit_tps = n / submit_per_iter if submit_per_iter > 0 else 0.0
+    # Untimed extra iteration for the reduction digest — the
+    # batch-vs-singles / python-vs-C++ bit-identity check.
+    outs = one_iter(e)[0]
+    digest = hashlib.sha256(
+        b"".join(np.ascontiguousarray(o).tobytes()
+                 for o in outs)).hexdigest()
+    print(f"#   {tps:12,.0f} tensors/s  "
+          f"({per_iter * 1e3:.2f} ms per {n}-tensor iteration)")
+    print(f"#   {submit_tps:12,.0f} tensors/s submit-plane  "
+          f"({submit_per_iter * 1e3:.2f} ms to handles-in-hand)")
+    result = {"mode": "engine-small", "engine": kind,
+              "submit": submit_mode, "tensors": n, "bytes": args.bytes,
+              "iters": args.iters, "tensors_per_s": round(tps, 1),
+              "ms_per_iter": round(per_iter * 1e3, 3),
+              "submit_tensors_per_s": round(submit_tps, 1),
+              "submit_ms_per_iter": round(submit_per_iter * 1e3, 3),
+              "digest": digest}
+    if tl_path:
+        # Timeline'd rerun on a fresh engine (2 iterations: one binds
+        # the names, one steady-state) — phase medians only; the timed
+        # numbers above never paid for timeline writes.
+        _os.environ["HVD_TIMELINE"] = tl_path
+        eng.shutdown_engine()
+        e2 = eng.get_engine()
+        for _ in range(2):
+            one_iter(e2)
+        eng.shutdown_engine()  # flush for parsing
+        _os.environ.pop("HVD_TIMELINE", None)
+        result["decompose"] = _decompose_timeline(tl_path, 2 * n)
+    return result
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--sizes-mb", type=float, nargs="+",
@@ -289,9 +375,21 @@ def main():
     ap.add_argument("--sizes-kb", type=float, nargs="+",
                     default=[1, 16, 64, 256, 1024, 16384, 65536, 262144],
                     help="per-tensor sizes for --engine (kB)")
-    ap.add_argument("--tensors", type=int, default=1,
+    ap.add_argument("--tensors", type=int, default=None,
                     help="tensors submitted together per iteration "
-                         "(--engine; exercises runtime fusion)")
+                         "(--engine; exercises runtime fusion; default 1, "
+                         "or 10000 in --bytes small-tensor mode)")
+    ap.add_argument("--bytes", type=int, default=None,
+                    help="with --engine: small-tensor mode — --tensors N "
+                         "stable names x this many bytes each per "
+                         "iteration (default 10000 x 4096), submitted "
+                         "through ONE batched engine call; reports "
+                         "submit→complete throughput in tensors/s and "
+                         "(with --json) phase_medians")
+    ap.add_argument("--per-tensor", action="store_true",
+                    help="with --bytes: submit per-tensor (loop of "
+                         "*_async) instead of batched — the baseline the "
+                         "batched-submit speedup is measured against")
     ap.add_argument("--donate", action="store_true",
                     help="with --engine: submit with donate=True — the "
                          "zero-copy ownership handoff that skips the "
@@ -334,6 +432,19 @@ def main():
 
     import os
 
+    if args.engine and args.bytes:
+        # Small-tensor mode defaults (10k x 4KB) — and the steady state
+        # needs every name to fit the control/data-plane working sets:
+        # a pre-bound pool slab per name, and a response-cache entry per
+        # name (a cache smaller than the working set thrashes — all
+        # misses, every round full-table — and the run measures cache
+        # churn, not submit cost). Explicit env values still win.
+        args.tensors = args.tensors or 10000
+        os.environ.setdefault("HVD_POOL_BIND_MAX", str(args.tensors))
+        os.environ.setdefault("HVD_CACHE_CAPACITY",
+                              str(max(2 * args.tensors, 1024)))
+    else:
+        args.tensors = args.tensors or 1
     if args.hierarchical:
         os.environ["HVD_HIERARCHICAL_ALLREDUCE"] = "1"
     if args.compression and args.compression != "none":
@@ -341,18 +452,23 @@ def main():
         # engine, which reads the wire policy at construction.
         os.environ["HVD_COMPRESSION"] = args.compression
     tl_path = None
-    if args.engine and args.decompose:
+    small = args.engine and bool(args.bytes)
+    if args.engine and (args.decompose or (small and args.json)):
         # Must be in the env BEFORE hvd.init(): multi-controller init
         # eagerly creates the engine (negotiation liveness), and only
-        # engine construction reads HVD_TIMELINE.
+        # engine construction reads HVD_TIMELINE. Small-tensor mode
+        # instead enables it AFTER the timed window, on a fresh engine
+        # (run_small) — timeline writes must not distort tensors/s.
         import tempfile
 
         tl_path = os.path.join(tempfile.mkdtemp(prefix="hvd_tl_"),
                                "timeline.json")
-        os.environ["HVD_TIMELINE"] = tl_path
+        if not small:
+            os.environ["HVD_TIMELINE"] = tl_path
     hvd.init()
     if args.engine:
-        result = run_engine(args, tl_path)
+        result = (run_small(args, tl_path) if small
+                  else run_engine(args, tl_path))
         if args.json:
             import json as _json
 

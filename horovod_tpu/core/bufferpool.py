@@ -27,6 +27,13 @@ bytes_resident`` gauge.
 Knobs: ``HVD_POOL_MAX_BYTES`` caps the resident slab bytes per pool
 (default 1 GiB; ``0`` disables pooling entirely — every checkout is a
 plain allocation, the measured "before" of docs/benchmarks.md).
+``HVD_POOL_BIND_MAX`` caps how many tensor NAMES may hold a pre-bound
+slab (:meth:`BufferPool.snapshot_bound`; default 1024) — a steady-state
+per-step gradient reuses the same slab by name and skips even the
+bucket scan. ``HVD_POOL_PROBE_LIMIT`` bounds how many slabs one
+checkout may examine for freeness (default 32): probing is O(1), not
+O(live views), so a caller draining thousands of small results never
+turns the pool scan quadratic.
 """
 
 from __future__ import annotations
@@ -86,10 +93,28 @@ class BufferPool:
         # (dtype, class bytes) -> slabs. Every slab the pool ever retained
         # stays listed; a slab is FREE exactly when only the list holds it.
         self._slabs: Dict[Tuple[np.dtype, int], List[np.ndarray]] = {}
+        # Per-bucket rotating scan cursor. Checkout probes at most
+        # _probe_limit slabs starting here: a full scan would be O(live
+        # slabs) per checkout, and a 10k-handle synchronize drain piles
+        # its still-held result views at the bucket head — the scan then
+        # walks every one of them per checkout, O(n^2) per drain
+        # (measured: a 10k x 4 KiB drain cost seconds, growing across
+        # iterations). Bounded probing keeps checkout O(1); the cursor
+        # advance past busy slabs makes freed ones reachable within one
+        # bucket revolution.
+        self._cursor: Dict[Tuple[np.dtype, int], int] = {}
+        self._probe_limit = int(
+            os.environ.get("HVD_POOL_PROBE_LIMIT") or 32)
+        # name -> slab pre-bound to that tensor name (snapshot_bound).
+        # A bound slab lives ONLY here (never in _slabs), so the same
+        # getrefcount probe decides freeness: dict + local + argument.
+        self._bound: Dict[str, np.ndarray] = {}
+        self._bind_max = int(os.environ.get("HVD_POOL_BIND_MAX") or 1024)
         self._poisoned = False
         self.hits = 0
         self.misses = 0
         self.checkouts = 0
+        self.bound_hits = 0
         self.bytes_resident = 0
         # Registry objects cached once: the checkout path must not pay a
         # name lookup per call (both engines feed these same names — the
@@ -98,6 +123,7 @@ class BufferPool:
         self._c_hits = tele.REGISTRY.counter("engine.pool.hits")
         self._c_misses = tele.REGISTRY.counter("engine.pool.misses")
         self._c_checkouts = tele.REGISTRY.counter("engine.pool.checkouts")
+        self._c_bound_hits = tele.REGISTRY.counter("engine.pool.bound_hits")
         self._g_resident = tele.REGISTRY.gauge("engine.pool.bytes_resident")
 
     def checkout(self, count: int, dtype) -> np.ndarray:
@@ -130,18 +156,34 @@ class BufferPool:
         # happens outside it — the submit thread and the engine loop
         # share this pool, and a fat critical section would turn every
         # checkout into a GIL/lock handoff between them.
+        key = (dtype, cls)
         with self._lock:
-            bucket = self._slabs.get((dtype, cls))
+            bucket = self._slabs.get(key)
             if bucket:
-                for slab in bucket:
+                # Bounded probe from the rotating cursor (see __init__):
+                # at most _probe_limit slabs examined, so checkout stays
+                # O(1) even when thousands of views are live in this
+                # class. All-busy after the limit falls through to a
+                # fresh allocation (an honest miss — everything WAS
+                # busy); the cursor lands past the probed busy run so
+                # the next checkout resumes where this one gave up.
+                k = len(bucket)
+                start = self._cursor.get(key, 0) % k
+                for j in range(min(k, self._probe_limit)):
+                    i = start + j
+                    if i >= k:
+                        i -= k
+                    slab = bucket[i]
                     # Free slab: referenced only by the bucket entry, the
-                    # loop variable and getrefcount's argument. Any live
-                    # view (numpy collapses view chains onto the owning
+                    # local and getrefcount's argument. Any live view
+                    # (numpy collapses view chains onto the owning
                     # array) raises the count and skips it.
                     if sys.getrefcount(slab) == 3:
+                        self._cursor[key] = i + 1
                         self.hits += 1
                         self._c_hits.inc()
                         return slab[:count], True
+                self._cursor[key] = start + min(k, self._probe_limit)
         self.misses += 1
         self._c_misses.inc()
         with self._lock:
@@ -175,6 +217,72 @@ class BufferPool:
         np.copyto(out, a)
         return out, tracked
 
+    def snapshot_bound(self, name: str, arr):
+        """:meth:`snapshot` with name pre-binding: the first submit of a
+        stable tensor name dedicates a full-shape slab to that name, and
+        every later steady-state submit re-hits it with ONE dict probe —
+        no bucket scan, no reshape, no checkout bookkeeping. The slab is
+        free again as soon as the engine retires its entry (the engines
+        drop their snapshot reference at completion), so a per-step
+        gradient reuses one slab forever. Shape or dtype drift retires
+        the stale binding and rebinds. The C++ pool's twin is
+        GetBound/PutBound in hvdcore.cc."""
+        a = np.asarray(arr)
+        if not (self.enabled and not self._poisoned):
+            return self.snapshot(a)
+        with self._lock:
+            slab = self._bound.get(name)
+            hit = (slab is not None and slab.dtype == a.dtype
+                   and slab.shape == a.shape
+                   # Free binding: dict entry + local + getrefcount arg.
+                   # A live view (the previous submit still in flight)
+                   # raises the count and forces the unbound path.
+                   and sys.getrefcount(slab) == 3)
+            if hit:
+                self.checkouts += 1
+                self.hits += 1
+                self.bound_hits += 1
+        if hit:
+            self._c_checkouts.inc()
+            self._c_hits.inc()
+            self._c_bound_hits.inc()
+            # Copy outside the lock: only snapshot_bound touches _bound,
+            # and a bound slab observed free here cannot be checked out
+            # by any other path before this copy lands.
+            np.copyto(slab, a)
+            return slab, True
+        cls = class_bytes(a.nbytes)
+        with self._lock:
+            stale = self._bound.get(name)
+            stale_cls = class_bytes(stale.nbytes) if stale is not None else 0
+            ok = ((stale is not None or len(self._bound) < self._bind_max)
+                  and self.bytes_resident - stale_cls + cls <= self.max_bytes)
+        if not ok:
+            # Bind table full or cap reached: plain pooled snapshot.
+            return self.snapshot(a)
+        # Dedicated full-shape slab (bypasses the pow2 buckets so the
+        # refcount probe above stays exact); allocated outside the lock.
+        slab = np.empty(a.shape, a.dtype)
+        np.copyto(slab, a)
+        self.checkouts += 1
+        self.misses += 1
+        self._c_checkouts.inc()
+        self._c_misses.inc()
+        with self._lock:
+            if self._poisoned:
+                return slab, False
+            stale = self._bound.pop(name, None)
+            if stale is not None:
+                self.bytes_resident -= class_bytes(stale.nbytes)
+            if (len(self._bound) < self._bind_max
+                    and self.bytes_resident + cls <= self.max_bytes):
+                self._bound[name] = slab
+                self.bytes_resident += cls
+                if self._own_gauge:
+                    self._g_resident.set(self.bytes_resident)
+                return slab, True
+        return slab, False
+
     def poison(self):
         """Elastic teardown (Engine.abandon): drop every slab reference so
         nothing checked out by the dying engine can ever be handed to a
@@ -184,6 +292,8 @@ class BufferPool:
         with self._lock:
             self._poisoned = True
             self._slabs.clear()
+            self._bound.clear()
+            self._cursor.clear()
             self.bytes_resident = 0
             if self._own_gauge:
                 self._g_resident.set(0)
@@ -196,6 +306,7 @@ class BufferPool:
         with self._lock:
             return {"hits": self.hits, "misses": self.misses,
                     "checkouts": self.checkouts,
+                    "bound_hits": self.bound_hits,
                     "bytes_resident": self.bytes_resident}
 
 

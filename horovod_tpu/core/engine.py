@@ -29,7 +29,7 @@ import queue
 import threading
 import time
 from dataclasses import dataclass, field
-from typing import Callable, Dict, Optional
+from typing import Callable, Dict, List, Optional
 
 import numpy as np
 
@@ -211,6 +211,11 @@ class _Entry:
     phase: str = tl.QUEUE
     fired: bool = False
     cancelled: bool = False
+    # Size of the batched submit this entry rode in on (submit_n /
+    # hvd_engine_enqueue_n); 1 for a per-tensor submit. Carried onto the
+    # QUEUE/MEMCPY span args so the trace critical path can attribute a
+    # batch's queue share per member, not N x.
+    batch_n: int = 1
 
 
 class _Handle:
@@ -221,6 +226,34 @@ class _Handle:
         self.result = None
         self.error: Optional[Exception] = None
         self.name = name  # numerics attribution at synchronize
+
+
+class SubmitRequest:
+    """One request of a batched submit (``Engine.submit_n`` /
+    ``NativeEngine.submit_n``): the per-tensor arguments of the
+    ``*_async`` verbs as one value, so a frontend holding a whole
+    gradient bucket can hand it over in ONE engine call. Fields that a
+    given op ignores (``root_rank`` for allreduce, ``average`` for
+    broadcast, ...) are simply unused — exactly as the per-tensor verbs
+    treat them. A plain-slots class, not a dict: the span-args
+    vocabulary lint (hvdcheck span parity) sweeps dict literals in this
+    module."""
+
+    __slots__ = ("name", "tensor", "average", "root_rank", "prescale",
+                 "compression", "donate", "deadline_ms")
+
+    def __init__(self, name: str, tensor, *, average: bool = False,
+                 root_rank: int = 0, prescale: float = 1.0,
+                 compression: Optional[str] = None, donate: bool = False,
+                 deadline_ms: Optional[float] = None):
+        self.name = name
+        self.tensor = tensor
+        self.average = average
+        self.root_rank = root_rank
+        self.prescale = prescale
+        self.compression = compression
+        self.donate = donate
+        self.deadline_ms = deadline_ms
 
 
 class JaxExecutor:
@@ -522,6 +555,35 @@ def record_submit(op: str, nbytes: int, queue_depth: int):
     tele.REGISTRY.gauge("engine.queue_depth").set(queue_depth)
 
 
+def record_submit_batch(op: str, sizes, queue_depth: Optional[int],
+                        ring_full: int = 0, ring_spins: int = 0):
+    """Submit-side telemetry for ONE batched submit of ``len(sizes)``
+    requests — the whole batch folds into one pass over the registry
+    (one ``inc(n)`` per counter, one :meth:`Histogram.observe_many`)
+    instead of N per-tensor ``record_submit`` calls, so instrumentation
+    does not hand back the lock round-trips the batched ABI removed.
+    Shared by both engines (the native engine's ring pressure counters
+    arrive through its stats sync instead — it passes no ring args; the
+    python twin has no ring, so the pair stays 0 and merely pins the
+    counter names into existence for cross-engine parity).
+    ``queue_depth=None`` skips the gauge: the native engine's batched
+    path must NOT read its pending count here — that takes the engine
+    mutex (and folds the submit ring), re-locking the very fast path the
+    ring exists to unlock; its periodic stats sync owns the gauge."""
+    n = len(sizes)
+    total = int(sum(sizes))
+    tele.REGISTRY.counter(f"engine.submitted.{op}").inc(n)
+    tele.REGISTRY.counter("engine.submitted.bytes").inc(total)
+    tele.REGISTRY.counter("engine.submit.batched").inc(n)
+    tele.REGISTRY.counter("engine.ring.full").inc(ring_full)
+    tele.REGISTRY.counter("engine.ring.spins").inc(ring_spins)
+    tele.REGISTRY.histogram(
+        "engine.tensor_bytes",
+        tele.BYTES_BUCKETS).observe_many([int(s) for s in sizes])
+    if queue_depth is not None:
+        tele.REGISTRY.gauge("engine.queue_depth").set(queue_depth)
+
+
 def record_wire(executor):
     """Wire-byte telemetry after one executor call: engine.wire_bytes =
     bytes the mesh collective actually shipped (int8 payload + f32
@@ -777,6 +839,126 @@ class Engine:
                    donated=donated,
                    deadline=self._abs_deadline(deadline_ms)),
             span, flipped)
+
+    def submit_n(self, op: str, requests) -> List[int]:
+        """Batched submit — the python twin of ``hvd_engine_enqueue_n``:
+        one validation pass, one snapshot pass (name-bound pool slabs,
+        :meth:`BufferPool.snapshot_bound`), ONE lock acquisition and one
+        wakeup for N :class:`SubmitRequest` of a single collective op.
+        Returns N handles in request order; per-request ``deadline_ms``
+        / ``compression`` / ``donate`` are preserved.
+
+        The duplicate-name contract is DEFERRED: a request whose name is
+        already in flight does not fail the batch — that handle alone
+        fails, and its ``synchronize`` raises
+        :class:`DuplicateNameError`. (The C++ engine admits
+        ring-published batches asynchronously on the loop thread, where
+        a synchronous per-request verdict no longer exists; the python
+        twin owes the same observable semantics.) Mixed-op batches,
+        empty batches and intra-batch duplicate names are rejected
+        synchronously — those are caller bugs, not races."""
+        if op not in ("allreduce", "allgather", "broadcast"):
+            raise EngineError(f"batched submit: unsupported op {op!r}")
+        reqs = list(requests)
+        n = len(reqs)
+        if n == 0:
+            raise EngineError("batched submit needs at least one request")
+        seen = set()
+        for r in reqs:
+            if r.name in seen:
+                raise DuplicateNameError(
+                    f"a collective named '{r.name}' appears twice in one "
+                    "batched submit; names must be unique among in-flight "
+                    "tensors")
+            seen.add(r.name)
+        # Fault site engine.submit: checked ONCE per batch, before any
+        # buffer is frozen or snapshotted — same observable shape as a
+        # synchronous enqueue rejection.
+        injected = flt.engine_submit(reqs[0].name)
+        if injected is not None:
+            raise EngineError(injected)
+        entries: List[_Entry] = []
+        spans = []
+        flipped: List[np.ndarray] = []
+        for r in reqs:
+            t0 = self.timeline.now_us()
+            a = np.asarray(r.tensor)
+            if r.donate and a.flags["C_CONTIGUOUS"]:
+                if _freeze_donated(a):
+                    flipped.append(a)
+                snap, donated = a, True
+                args = {"donated": True}
+            else:
+                snap, tracked = self.pool.snapshot_bound(r.name, a)
+                donated = False
+                args = {"pooled": tracked}
+            args["batch_n"] = n
+            spans.append((t0, self.timeline.now_us(), args))
+            wire = ("none" if op != "allreduce"
+                    else (resolve_wire_policy(r.compression)
+                          if r.compression is not None
+                          else self.wire_default))
+            entries.append(_Entry(
+                -1, r.name, op, snap, average=r.average,
+                root_rank=r.root_rank, prescale=r.prescale,
+                compression=wire, donated=donated,
+                deadline=self._abs_deadline(r.deadline_ms), batch_n=n))
+        dup_failed = []
+        handles: List[int] = []
+        with self._lock:
+            if self._shutdown.is_set() or self._quiesced is not None:
+                # Whole-batch rejection: the engine never took
+                # ownership, so every buffer frozen above flips back.
+                for a in flipped:
+                    a.flags.writeable = True
+                if self._shutdown.is_set():
+                    raise ShutdownError("engine is shut down")
+                raise EngineError(
+                    f"engine is draining ({self._quiesced}): submissions "
+                    "are closed — the engine is completing in-flight "
+                    "work before shutdown (quiesce)")
+            for e in entries:
+                h = _Handle(e.name)
+                e.handle = self._next_handle
+                self._next_handle += 1
+                self._handles[e.handle] = h
+                handles.append(e.handle)
+                if e.name in self._pending_names:
+                    # Deferred duplicate: registered but never queued —
+                    # completed inline below, after the lock.
+                    dup_failed.append((e, h))
+                    continue
+                self._pending_names[e.name] = e
+                if e.deadline is not None:
+                    self._deadline_count += 1
+                    self._stall_kick.set()
+            depth = len(self._pending_names)
+        # All N requests count as submitted — the native engine cannot
+        # know at submit which will dup-fail at its async fold, so the
+        # python twin counts identically to keep the counters parable.
+        record_submit_batch(op, [e.tensor.nbytes for e in entries], depth)
+        for e, (t0, t1, args) in zip(entries, spans):
+            self.timeline.start(e.name, tl.QUEUE, ts_us=t0)
+            self.timeline.start(e.name, tl.MEMCPY, ts_us=t0)
+            self.timeline.end(e.name, tl.MEMCPY, args, ts_us=t1)
+        dup_names = {e.name for e, _ in dup_failed}
+        queued = [e for e in entries if e.name not in dup_names]
+        numx.engine_note_submit_batch([e.name for e in queued],
+                                      [e.tensor for e in queued])
+        for e in queued:
+            self._queue.put(e)
+        for e, h in dup_failed:
+            self.timeline.end(e.name, tl.QUEUE,
+                              {"batch_n": e.batch_n} if e.batch_n > 1
+                              else None)
+            tele.REGISTRY.counter("engine.errors").inc()
+            e.tensor = _RETIRED
+            h.error = DuplicateNameError(
+                f"a collective named '{e.name}' is already pending; "
+                "names must be unique among in-flight tensors")
+            h.event.set()
+        self._wake.set()
+        return handles
 
     # -- deadline / cancel / drain plane --------------------------------------
 
@@ -1287,7 +1469,9 @@ class Engine:
             result, err = None, CancelledError(
                 f"collective '{e.name}' was cancelled (cooperative "
                 "cancel; result discarded)")
-        self.timeline.end(e.name, tl.QUEUE)
+        self.timeline.end(
+            e.name, tl.QUEUE,
+            {"batch_n": e.batch_n} if e.batch_n > 1 else None)
         with self._lock:
             self._pending_names.pop(e.name, None)
             if e.deadline is not None and self._deadline_count > 0:

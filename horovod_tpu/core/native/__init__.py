@@ -156,6 +156,11 @@ class HvdRequest(ctypes.Structure):
         ("count", ctypes.c_longlong),
         ("ndim", ctypes.c_int),
         ("shape", ctypes.c_longlong * 8),
+        # Batched-submit plane (hvd_engine_enqueue_n): per-request
+        # ownership-handoff flag, honored element-by-element like the
+        # single-enqueue `donate` argument. Engine->executor requests
+        # always carry 0 here.
+        ("donate", ctypes.c_int),
     ]
 
 
@@ -203,6 +208,11 @@ class HvdStats(ctypes.Structure):
         # engine.cancelled counter parity with the python engine).
         ("deadline_exceeded", ctypes.c_longlong),
         ("cancelled", ctypes.c_longlong),
+        # Batched-submit plane: submit-ring pressure and name-bound pool
+        # reuse (engine.ring.{full,spins} / engine.pool.bound_hits).
+        ("ring_full", ctypes.c_longlong),
+        ("ring_spins", ctypes.c_longlong),
+        ("pool_bound_hits", ctypes.c_longlong),
     ]
 
 
@@ -247,6 +257,10 @@ def load_library():
         ctypes.c_int, ctypes.c_void_p, ctypes.POINTER(ctypes.c_longlong),
         ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_double,
         ctypes.c_int, ctypes.c_int, ctypes.c_double, ctypes.c_char_p]
+    lib.hvd_engine_enqueue_n.restype = ctypes.c_int
+    lib.hvd_engine_enqueue_n.argtypes = [
+        ctypes.c_void_p, ctypes.POINTER(HvdRequest), ctypes.c_int,
+        ctypes.POINTER(ctypes.c_longlong), ctypes.c_char_p]
     lib.hvd_engine_poll.restype = ctypes.c_int
     lib.hvd_engine_poll.argtypes = [ctypes.c_void_p, ctypes.c_longlong]
     lib.hvd_engine_cancel.restype = ctypes.c_int

@@ -124,6 +124,12 @@ struct hvd_request {
   // For non-fusable ops the original shape rides along:
   int ndim;
   long long shape[8];
+  // Batched-submit plane (hvd_engine_enqueue_n): per-request ownership
+  // handoff flag, honored element-by-element inside one batched call
+  // exactly like the single-enqueue `donate` argument. Engine->executor
+  // requests always carry 0 here (donated inputs reach the data plane
+  // through the data/out split instead).
+  int donate;
 };
 
 struct hvd_result {
@@ -201,6 +207,14 @@ struct hvd_engine_stats {
   // telemetry parity with the python twin's counters).
   long long deadline_exceeded;
   long long cancelled;
+  // Batched-submit plane: lock-free submit-ring pressure (full -> locked
+  // fallback taken; spins -> CAS retries under producer contention) and
+  // name-bound pool slabs reused without a bucket scan. Fed into
+  // engine.ring.{full,spins} / engine.pool.bound_hits by the Python
+  // stats sync (_STAT_COUNTERS).
+  long long ring_full;
+  long long ring_spins;
+  long long pool_bound_hits;
 };
 
 void* hvd_alloc(long long nbytes) { return malloc((size_t)nbytes); }
@@ -481,6 +495,8 @@ class BufferPool {
   BufferPool() {
     const char* v = getenv("HVD_POOL_MAX_BYTES");
     max_bytes_ = v ? atoll(v) : (1LL << 30);
+    const char* b = getenv("HVD_POOL_BIND_MAX");
+    bind_max_ = b ? atoll(b) : 1024;
   }
 
   // Power-of-two size class, floored at 4 KiB (matches the python pool:
@@ -539,6 +555,74 @@ class BufferPool {
     return cls;
   }
 
+  // Name-bound checkout (the batched-submit fast path): a steady-state
+  // per-step gradient resubmits under a stable name, so its snapshot
+  // slab is parked under that name at completion (PutBound) and handed
+  // straight back on the next submit — no bucket scan, no resize churn,
+  // and the bound reuse is visible as pool_bound_hits (a hit that
+  // skipped even the checkout scan). Falls through to the regular Get
+  // path on first sight of a name, a size-class change, or past the
+  // binding cap (HVD_POOL_BIND_MAX names).
+  std::vector<char> GetBound(const std::string& name, long long nbytes,
+                             bool* tracked) {
+    if (max_bytes_ > 0) {
+      size_t cls = ClassOf(nbytes);
+      std::unique_lock<std::mutex> lk(mu_);
+      auto it = bound_.find(name);
+      if (it != bound_.end() && FloorClass(it->second.capacity()) == cls) {
+        std::vector<char> v = std::move(it->second);
+        bound_.erase(it);
+        checkouts_++;
+        hits_++;
+        bound_hits_++;
+        v.resize((size_t)nbytes);
+        if (tracked) *tracked = true;
+        return v;
+      }
+      if (it != bound_.end()) {
+        // Size class changed: retire the stale binding into the general
+        // buckets (same capacity-floored bucket Put() would choose).
+        std::vector<char> stale = std::move(it->second);
+        bound_.erase(it);
+        if (resident_ > max_bytes_) {
+          resident_ -= (long long)FloorClass(stale.capacity());
+          if (resident_ < 0) resident_ = 0;
+        } else {
+          free_[FloorClass(stale.capacity())].push_back(std::move(stale));
+        }
+      }
+    }
+    return Get(nbytes, tracked);
+  }
+
+  // Completion-side twin of GetBound: park the slab under its tensor
+  // name instead of the shared buckets. Resident accounting is
+  // unchanged (the slab was counted at its original miss; binding only
+  // moves where it waits).
+  void PutBound(const std::string& name, std::vector<char>&& v) {
+    if (v.capacity() < 4096) return;
+    std::lock_guard<std::mutex> g(mu_);
+    if (max_bytes_ <= 0) return;
+    if (resident_ > max_bytes_) {
+      resident_ -= (long long)FloorClass(v.capacity());
+      if (resident_ < 0) resident_ = 0;
+      return;
+    }
+    auto it = bound_.find(name);
+    if (it != bound_.end()) {
+      // A same-name binding is already parked (e.g. a rejected duplicate
+      // retired its slab first): shunt the incumbent into the shared
+      // buckets so its resident accounting survives the re-bind.
+      free_[FloorClass(it->second.capacity())].push_back(
+          std::move(it->second));
+      bound_.erase(it);
+    } else if ((long long)bound_.size() >= bind_max_) {
+      free_[FloorClass(v.capacity())].push_back(std::move(v));
+      return;
+    }
+    bound_[name] = std::move(v);
+  }
+
   void Put(std::vector<char>&& v) {
     if (v.capacity() < 4096) return;  // sub-class slab: not pool-tracked
     // Bucket by the largest class the capacity COVERS (reserve may
@@ -567,20 +651,23 @@ class BufferPool {
   }
 
   void Stats(long long* hits, long long* misses, long long* checkouts,
-             long long* resident) {
+             long long* resident, long long* bound_hits = nullptr) {
     std::lock_guard<std::mutex> g(mu_);
     *hits = hits_;
     *misses = misses_;
     *checkouts = checkouts_;
     *resident = resident_ > 0 ? resident_ : 0;
+    if (bound_hits) *bound_hits = bound_hits_;
   }
 
  private:
   std::mutex mu_;
   std::map<size_t, std::vector<std::vector<char>>> free_;
+  std::unordered_map<std::string, std::vector<char>> bound_;
   long long max_bytes_ = 0;
+  long long bind_max_ = 0;
   long long resident_ = 0;  // bytes in pool-tracked slabs (free + lent)
-  long long hits_ = 0, misses_ = 0, checkouts_ = 0;
+  long long hits_ = 0, misses_ = 0, checkouts_ = 0, bound_hits_ = 0;
 };
 
 // ---------------------------------------------------------------------------
@@ -611,6 +698,13 @@ struct Entry {
   // Entry copy computes hvd_request.deadline_s at execution.
   Clock::time_point deadline;
   bool has_deadline = false;
+  // Batched-submit members: how many requests rode the same
+  // hvd_engine_enqueue_n call (stamped on the QUEUE/MEMCPY span args so
+  // the trace tools attribute the batch's share per member, not N x),
+  // and whether the snapshot slab is name-bound (returned via PutBound
+  // instead of the shared buckets at completion).
+  int batch_n = 1;
+  bool bound = false;
 
   const char* bytes() const { return ext ? ext : data.data(); }
 };
@@ -628,6 +722,12 @@ struct Pending {
   const char* phase = "QUEUE";  // -> NEGOTIATE -> ALLREDUCE/...
 };
 
+// One hvd_engine_enqueue_n call's worth of fully-built entries, published
+// into the submit ring as a single pointer (one CAS per batch). The
+// handles are pre-allocated so the caller already holds them; the loop
+// thread folds them into the engine tables at the next drain.
+struct SubmitBatch;
+
 struct HandleState {
   bool done = false;
   std::string error;
@@ -641,6 +741,85 @@ struct HandleState {
   ~HandleState() {
     if (pool) pool->Put(std::move(result));
   }
+};
+
+struct SubmitBatch {
+  std::vector<Entry> entries;
+  std::vector<std::shared_ptr<HandleState>> handles;
+};
+
+// Lock-free bounded MPSC submit ring (Vyukov bounded-queue shape with a
+// single consumer): producers CAS-claim a slot and publish a SubmitBatch
+// pointer via the slot's sequence number; the consumer side is "whoever
+// holds the engine mutex" (the loop each cycle, or any reader API that
+// folds before looking at engine state), which serializes Pop without a
+// second lock. The submit fast path therefore never takes mu_ — on a
+// full ring the caller falls back to the locked path.
+class SubmitRing {
+ public:
+  SubmitRing() {
+    const char* v = getenv("HVD_SUBMIT_RING_SIZE");
+    long long want = v ? atoll(v) : 256;
+    size_ = 2;
+    while (size_ < want && size_ < (1 << 16)) size_ <<= 1;
+    slots_.reset(new Slot[size_]);
+    for (long long i = 0; i < size_; ++i)
+      slots_[i].seq.store((uint64_t)i, std::memory_order_relaxed);
+  }
+
+  // Multi-producer publish; false when the ring is full (the caller
+  // takes the locked fallback). `spins` counts CAS retries lost to
+  // producer contention (engine.ring.spins).
+  bool Push(SubmitBatch* b, long long* spins) {
+    uint64_t pos = head_.load(std::memory_order_relaxed);
+    for (;;) {
+      Slot& s = slots_[pos & (uint64_t)(size_ - 1)];
+      uint64_t seq = s.seq.load(std::memory_order_acquire);
+      long long dif = (long long)seq - (long long)pos;
+      if (dif == 0) {
+        if (head_.compare_exchange_weak(pos, pos + 1,
+                                        std::memory_order_relaxed)) {
+          s.batch = b;
+          s.seq.store(pos + 1, std::memory_order_release);
+          count_.fetch_add(1, std::memory_order_release);
+          return true;
+        }
+        (*spins)++;  // CAS lost to another producer; pos was reloaded
+      } else if (dif < 0) {
+        return false;  // full: a lap behind the consumer
+      } else {
+        pos = head_.load(std::memory_order_relaxed);
+      }
+    }
+  }
+
+  // Single-consumer pop — caller MUST hold the engine mutex. Returns
+  // nullptr when empty (or when the next slot is claimed but not yet
+  // published; the count stays armed and the caller retries next wake).
+  SubmitBatch* Pop() {
+    Slot& s = slots_[tail_ & (uint64_t)(size_ - 1)];
+    uint64_t seq = s.seq.load(std::memory_order_acquire);
+    if ((long long)seq - (long long)(tail_ + 1) < 0) return nullptr;
+    SubmitBatch* b = s.batch;
+    s.seq.store(tail_ + (uint64_t)size_, std::memory_order_release);
+    tail_++;
+    count_.fetch_sub(1, std::memory_order_release);
+    return b;
+  }
+
+  // Cheap wait-predicate probe: batches published (or mid-publish).
+  bool Armed() const { return count_.load(std::memory_order_acquire) > 0; }
+
+ private:
+  struct Slot {
+    std::atomic<uint64_t> seq{0};
+    SubmitBatch* batch = nullptr;
+  };
+  std::unique_ptr<Slot[]> slots_;
+  long long size_ = 0;
+  std::atomic<uint64_t> head_{0};
+  std::atomic<long long> count_{0};
+  uint64_t tail_ = 0;  // consumer cursor, guarded by the engine mutex
 };
 
 class Engine {
@@ -713,6 +892,7 @@ class Engine {
                     int average, int root_rank, double prescale, int wire,
                     int donate, double deadline_s, char* err) {
     std::unique_lock<std::mutex> lk(mu_);
+    FoldRingLocked();  // duplicate check must see ring-published names
     if (shutdown_) {
       snprintf(err, 256, "Horovod engine has been shut down");
       return -1;
@@ -792,12 +972,133 @@ class Engine {
     return h;
   }
 
+  // Batched submit (hvd_engine_enqueue_n): one call, one snapshot pass,
+  // one ring publish, one wakeup for N requests. The fast path takes NO
+  // engine lock — handles come off the atomic counter, snapshots go
+  // through the pool's own (uncontended) lock, and the fully-built
+  // batch is CAS-published into the submit ring for the loop (or the
+  // next locked reader) to fold. Whole-batch rejections (mixed ops,
+  // intra-batch duplicate names) happen synchronously; a duplicate
+  // against an already-IN-FLIGHT name is only decidable at fold time
+  // and fails that request's handle instead — the waiter sees the same
+  // duplicate-name error at synchronize, which both engines document
+  // as the batched-submit contract.
+  int EnqueueN(hvd_request* reqs, int n, long long* handles_out, char* err) {
+    if (n <= 0) {
+      snprintf(err, 256, "batched submit needs at least one request");
+      return -1;
+    }
+    if (shutdown_flag_.load(std::memory_order_seq_cst)) {
+      snprintf(err, 256, "Horovod engine has been shut down");
+      return -1;
+    }
+    for (int i = 0; i < n; ++i) {
+      if (reqs[i].op < 0 || reqs[i].op > 2) {
+        snprintf(err, 256, "batched submit: unsupported op code %d",
+                 reqs[i].op);
+        return -1;
+      }
+      if (reqs[i].op != reqs[0].op) {
+        snprintf(err, 256,
+                 "a batched submit must be a single collective op; this "
+                 "batch mixes op %d with op %d", reqs[0].op, reqs[i].op);
+        return -1;
+      }
+    }
+    {
+      std::unordered_set<std::string> seen;
+      for (int i = 0; i < n; ++i) {
+        if (!seen.insert(reqs[i].names).second) {
+          snprintf(err, 256,
+                   "a collective named '%s' appears twice in one batched "
+                   "submit; names must be unique among in-flight tensors",
+                   reqs[i].names);
+          return -1;
+        }
+      }
+    }
+    auto* b = new SubmitBatch;
+    b->entries.reserve(n);
+    b->handles.reserve(n);
+    long long base = next_handle_.fetch_add(n);
+    long long t0 = timeline_.NowUs();
+    for (int i = 0; i < n; ++i) {
+      hvd_request& r = reqs[i];
+      Entry e;
+      e.handle = base + i;
+      e.name = r.names;  // single name per batched request, not ';'-joined
+      e.op = r.op;
+      e.dtype_num = r.dtype_num;
+      e.itemsize = r.itemsize;
+      e.average = r.average;
+      e.root_rank = r.root_rank;
+      e.wire = r.wire;
+      e.prescale = r.prescale;
+      long long count = 1;
+      for (int d = 0; d < r.ndim; ++d) count *= r.shape[d];
+      e.nbytes = count * r.itemsize;
+      e.batch_n = n;
+      std::string mem_args;
+      if (r.donate) {
+        e.ext = (const char*)r.data;
+        mem_args = "\"donated\": true";
+      } else {
+        bool tracked = false;
+        e.data = pool_->GetBound(e.name, e.nbytes, &tracked);
+        memcpy(e.data.data(), r.data, (size_t)e.nbytes);
+        e.bound = true;
+        mem_args = BufferPool::PooledArgs(tracked);
+      }
+      mem_args += ", \"batch_n\": ";
+      mem_args += std::to_string(n);
+      e.shape.assign(r.shape, r.shape + r.ndim);
+      e.enqueued = Clock::now();
+      if (r.deadline_s > 0) {
+        e.has_deadline = true;
+        e.deadline =
+            e.enqueued + std::chrono::duration_cast<Clock::duration>(
+                             std::chrono::duration<double>(r.deadline_s));
+      }
+      auto hs = std::make_shared<HandleState>();
+      hs->pool = pool_;
+      timeline_.BeginAt(e.name, "QUEUE", t0);
+      timeline_.BeginAt(e.name, "MEMCPY", t0);
+      timeline_.EndAt(e.name, "MEMCPY", timeline_.NowUs(), mem_args);
+      handles_out[i] = e.handle;
+      b->handles.push_back(std::move(hs));
+      b->entries.push_back(std::move(e));
+    }
+    long long spins = 0;
+    if (!ring_.Push(b, &spins)) {
+      // Ring full: locked fallback. Fold FIRST so this batch cannot
+      // overtake batches already published in the ring.
+      ring_full_.fetch_add(1, std::memory_order_relaxed);
+      std::lock_guard<std::mutex> g(mu_);
+      FoldRingLocked();
+      for (size_t i = 0; i < b->entries.size(); ++i)
+        AdmitEntryLocked(b->entries[i], b->handles[i]);
+      delete b;
+    }
+    if (spins) ring_spins_.fetch_add(spins, std::memory_order_relaxed);
+    cv_.notify_all();
+    if (shutdown_flag_.load(std::memory_order_seq_cst)) {
+      // Shutdown raced the publish: the loop's final drain may already
+      // be done — rescue the batch ourselves (admitting under shutdown_
+      // fails every waiter with the shutdown error). seq_cst ordering
+      // guarantees this recheck or Join's post-join fold sees the batch.
+      std::lock_guard<std::mutex> g(mu_);
+      FoldRingLocked();
+    }
+    return 0;
+  }
+
   // -1 unknown, 0 pending, 1 done ok, 2 done with an error. The ok/err
   // split lets the binding release donated-buffer pins only on clean
   // completions (an errored one may be a deadline expiry whose entry —
   // and in-place buffer reference — is still in flight).
   int Poll(long long handle) {
     std::lock_guard<std::mutex> g(mu_);
+    FoldRingLocked();  // a ring-published handle registers at fold time
     auto it = handles_.find(handle);
     if (it == handles_.end()) return -1;
     if (!it->second->done) return 0;
@@ -810,6 +1111,7 @@ class Engine {
   int Cancel(long long handle) {
     {
       std::lock_guard<std::mutex> g(mu_);
+      FoldRingLocked();
       auto it = handles_.find(handle);
       if (it == handles_.end() || it->second->done) return -1;
       bool in_flight = false;
@@ -828,6 +1130,7 @@ class Engine {
     std::shared_ptr<HandleState> hs;
     {
       std::unique_lock<std::mutex> lk(mu_);
+      FoldRingLocked();  // also rescues a ring batch after a bare Shutdown
       auto it = handles_.find(handle);
       if (it == handles_.end()) return -1;
       hs = it->second;
@@ -849,6 +1152,7 @@ class Engine {
     std::shared_ptr<HandleState> hs;
     {
       std::lock_guard<std::mutex> g(mu_);
+      FoldRingLocked();
       auto it = handles_.find(handle);
       if (it == handles_.end()) return -1;
       hs = it->second;
@@ -862,11 +1166,13 @@ class Engine {
   // Retires an errored/unneeded handle.
   void Drop(long long handle) {
     std::lock_guard<std::mutex> g(mu_);
+    FoldRingLocked();  // an unfolded handle would re-register after erase
     handles_.erase(handle);
   }
 
   long long PendingCount() {
     std::lock_guard<std::mutex> g(mu_);
+    FoldRingLocked();
     return (long long)pending_names_.size();
   }
 
@@ -877,6 +1183,7 @@ class Engine {
   // fit is dropped whole).
   long long PendingNames(char* out, long long cap) {
     std::lock_guard<std::mutex> g(mu_);
+    FoldRingLocked();
     long long used = 0;
     if (cap > 0) out[0] = '\0';
     for (auto& kv : pending_names_) {
@@ -893,11 +1200,14 @@ class Engine {
   void GetStats(hvd_engine_stats* out) {
     {
       std::lock_guard<std::mutex> g(mu_);
+      FoldRingLocked();
       *out = stats_;
       out->queue_depth = (long long)pending_names_.size();
     }
+    out->ring_full = ring_full_.load(std::memory_order_relaxed);
+    out->ring_spins = ring_spins_.load(std::memory_order_relaxed);
     pool_->Stats(&out->pool_hits, &out->pool_misses, &out->pool_checkouts,
-                 &out->pool_bytes_resident);
+                 &out->pool_bytes_resident, &out->pool_bound_hits);
   }
 
   void Shutdown() {
@@ -906,6 +1216,11 @@ class Engine {
       if (shutdown_) return;
       shutdown_ = true;
     }
+    // seq_cst pairs with EnqueueN's post-publish recheck: a producer
+    // that misses this store has already published, and either its own
+    // recheck or the next locked fold (loop drain, Join, any reader)
+    // fails the batch with the shutdown error.
+    shutdown_flag_.store(true, std::memory_order_seq_cst);
     cv_.notify_all();
   }
 
@@ -918,6 +1233,13 @@ class Engine {
     Shutdown();
     if (loop_.joinable()) loop_.join();
     if (watchdog_.joinable()) watchdog_.join();
+    {
+      // A producer that published before seeing shutdown_flag_ may have
+      // left a batch in the ring after the loop's final drain; fail its
+      // waiters now (admitting under shutdown_ completes them inline).
+      std::lock_guard<std::mutex> g(mu_);
+      FoldRingLocked();
+    }
     timeline_.Close();  // workers joined: no further Emit is possible
   }
 
@@ -948,6 +1270,69 @@ class Engine {
   }
 
  private:
+  // Fold one fast-path entry into the engine tables — caller holds mu_.
+  // Duplicate-vs-in-flight and shutdown are only decidable here; both
+  // complete the handle inline (Stage()/Complete() re-acquire mu_ and
+  // must not be called with it held).
+  void AdmitEntryLocked(Entry& e, const std::shared_ptr<HandleState>& hs) {
+    handles_[e.handle] = hs;
+    char msg[512];
+    const char* fail = nullptr;
+    if (shutdown_) {
+      fail = "Horovod engine has been shut down";
+    } else if (pending_names_.count(e.name)) {
+      snprintf(msg, sizeof(msg),
+               "a collective named '%s' is already pending; names must be "
+               "unique among in-flight tensors", e.name.c_str());
+      fail = msg;
+    }
+    if (fail) {
+      stats_.errors++;
+      hs->error = fail;
+      hs->done = true;
+      std::string qargs;
+      if (e.batch_n > 1)
+        qargs = "\"batch_n\": " + std::to_string(e.batch_n);
+      timeline_.End(e.name, "QUEUE", qargs);
+      if (!e.ext && e.data.capacity()) {
+        if (e.bound)
+          pool_->PutBound(e.name, std::move(e.data));
+        else
+          pool_->Put(std::move(e.data));
+      }
+      cv_done_.notify_all();
+      return;
+    }
+    Pending p;
+    p.enqueued = e.enqueued;
+    p.handle = e.handle;
+    if (e.has_deadline) {
+      p.has_deadline = true;
+      p.deadline = e.deadline;
+      deadline_count_++;
+      deadline_kick_ = true;
+      // The publish-side notify predates the fold, so the watchdog may
+      // already be back in a coarse sleep; kick it again now that the
+      // deadline is visible.
+      cv_.notify_all();
+    }
+    pending_names_[e.name] = p;
+    if (e.op >= 0 && e.op < 3) stats_.submitted[e.op]++;
+    stats_.submitted_bytes += e.nbytes;
+    queue_.push_back(std::move(e));
+  }
+
+  // Drain the submit ring into the engine tables — caller holds mu_.
+  // Every mu_-taking entry point folds first, so fast-path submits are
+  // visible to any reader or cycle that observes engine state.
+  void FoldRingLocked() {
+    while (SubmitBatch* b = ring_.Pop()) {
+      for (size_t i = 0; i < b->entries.size(); ++i)
+        AdmitEntryLocked(b->entries[i], b->handles[i]);
+      delete b;
+    }
+  }
+
   void Loop() {
     while (true) {
       std::deque<Entry> batch;
@@ -961,13 +1346,14 @@ class Engine {
         // message (reference: every rank gathers a possibly-empty request
         // list each tick, operations.cc:2117) — and its idle pacing comes
         // from the control plane's 'w' backoff folded into `cycle` above,
-        // not from a different wait here. A fresh enqueue or shutdown
-        // cuts either mode's sleep short.
+        // not from a different wait here. A fresh enqueue, a ring
+        // publish, or shutdown cuts either mode's sleep short.
         WaitFor(cv_, lk, cycle,
-                [&] { return shutdown_ || !queue_.empty(); });
+                [&] { return shutdown_ || !queue_.empty() || ring_.Armed(); });
         // On shutdown, leave queued entries for the failure drain below:
         // executing them could call into Python during teardown.
         if (shutdown_) break;
+        FoldRingLocked();
         batch.swap(queue_);
         negotiate = neg_active_ && neg_fn_ != nullptr;
       }
@@ -986,6 +1372,7 @@ class Engine {
     std::deque<Entry> rest;
     {
       std::lock_guard<std::mutex> g(mu_);
+      FoldRingLocked();  // under shutdown_ this fails ring batches inline
       rest.swap(queue_);
     }
     for (auto& e : rest)
@@ -1470,11 +1857,16 @@ class Engine {
                    "discarded)";
       error = cancel_msg.c_str();
     }
+    // Batched members stamp batch_n on the QUEUE end so trace tools can
+    // attribute the batch's queue share per member instead of N x.
+    std::string qargs;
+    if (e.batch_n > 1)
+      qargs = "\"batch_n\": " + std::to_string(e.batch_n);
     if (hs != nullptr && already_done) {
       // The sweep already failed this waiter with its attributed
       // CollectiveTimeout — a late completion must neither clobber the
       // error nor re-notify (the sweep's write was the final one).
-      timeline_.End(e.name, "QUEUE");
+      timeline_.End(e.name, "QUEUE", qargs);
       hs = nullptr;
     } else if (hs != nullptr) {
       if (error) {
@@ -1492,11 +1884,17 @@ class Engine {
           timeline_.End(e.name, copy_phase,
                         BufferPool::PooledArgs(tracked));
       }
-      timeline_.End(e.name, "QUEUE");
+      timeline_.End(e.name, "QUEUE", qargs);
     }
     // Retire the entry's snapshot slab (donated buffers are caller-owned
-    // and stay untouched).
-    if (!e.ext && e.data.capacity()) pool_->Put(std::move(e.data));
+    // and stay untouched). Batched snapshots park under their tensor
+    // name so the next steady-state submit skips even the bucket scan.
+    if (!e.ext && e.data.capacity()) {
+      if (e.bound)
+        pool_->PutBound(e.name, std::move(e.data));
+      else
+        pool_->Put(std::move(e.data));
+    }
     return hs;
   }
 
@@ -1557,6 +1955,9 @@ class Engine {
     std::vector<Fired> fired;
     {
       std::lock_guard<std::mutex> g(mu_);
+      // The watchdog may sweep while the loop thread is wedged inside an
+      // executor call: ring batches carrying deadlines must be visible.
+      FoldRingLocked();
       if (deadline_count_ <= 0) return;
       Clock::time_point now = Clock::now();
       for (auto& kv : pending_names_) {
@@ -1686,8 +2087,15 @@ class Engine {
   long long deadline_count_ = 0;
   bool deadline_kick_ = false;  // enqueue -> watchdog wake (under mu_)
   std::unordered_set<long long> cancelled_;
-  long long next_handle_ = 0;
+  // Atomic: the batched fast path reserves handles without mu_.
+  std::atomic<long long> next_handle_{0};
   bool shutdown_ = false;
+  // Lock-free mirror of shutdown_ for the submit fast path; the
+  // post-publish recheck in EnqueueN plus Join's post-join fold close
+  // the publish-vs-shutdown race (both sides are seq_cst).
+  std::atomic<bool> shutdown_flag_{false};
+  SubmitRing ring_;
+  std::atomic<long long> ring_full_{0}, ring_spins_{0};
   bool sort_by_name_ = false;
   hvd_exec_fn exec_fn_ = nullptr;
   void* exec_ctx_ = nullptr;
@@ -1749,6 +2157,11 @@ long long hvd_engine_enqueue(void* e, int op, const char* name, int dtype_num,
                                           shape, ndim, average, root_rank,
                                           prescale, wire, donate, deadline_s,
                                           err);
+}
+
+int hvd_engine_enqueue_n(void* e, hvd_request* reqs, int n,
+                         long long* handles_out, char* err) {
+  return static_cast<Engine*>(e)->EnqueueN(reqs, n, handles_out, err);
 }
 
 int hvd_engine_poll(void* e, long long handle) {

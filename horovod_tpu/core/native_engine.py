@@ -33,6 +33,7 @@ from horovod_tpu.core.engine import (
     EngineError,
     JaxExecutor,
     ShutdownError,
+    SubmitRequest,
     _freeze_donated,
     _multi_controller,
     _negotiated,
@@ -42,6 +43,7 @@ from horovod_tpu.core.engine import (
     quiesce_drain,
     record_cache_config,
     record_submit,
+    record_submit_batch,
     resolve_wire_policy,
     wire_policy_from_env,
 )
@@ -382,6 +384,13 @@ class NativeEngine:
         # same names are fed in its sweep/_complete paths.
         ("engine.deadline_exceeded", "deadline_exceeded"),
         ("engine.cancelled", "cancelled"),
+        # Batched-submit plane: submit-ring pressure and name-bound pool
+        # reuse. The python twin's names are pinned into existence by
+        # record_submit_batch / BufferPool.snapshot_bound (it has no
+        # ring, so the ring pair stays 0 there).
+        ("engine.ring.full", "ring_full"),
+        ("engine.ring.spins", "ring_spins"),
+        ("engine.pool.bound_hits", "pool_bound_hits"),
     )
 
     def _collect_stats(self):
@@ -610,6 +619,122 @@ class NativeEngine:
         return self._enqueue("broadcast", name, tensor, root_rank=root_rank,
                              donate=donate, deadline_ms=deadline_ms)
 
+    def submit_n(self, op: str, requests) -> List[int]:
+        """Batched submit through ONE ``hvd_engine_enqueue_n`` call: one
+        GIL crossing, one snapshot pass, one ring publish/wakeup for N
+        :class:`SubmitRequest` of a single collective op. Returns N
+        handles in request order; per-request ``deadline_ms`` /
+        ``compression`` / ``donate`` preserved. Duplicate-vs-in-flight
+        is DEFERRED to the loop's ring fold: that handle alone fails and
+        its ``synchronize`` raises :class:`DuplicateNameError` — same
+        contract as the python twin's ``Engine.submit_n``."""
+        if op not in _OPS:
+            raise EngineError(f"batched submit: unsupported op {op!r}")
+        reqs = list(requests)
+        n = len(reqs)
+        if n == 0:
+            raise EngineError("batched submit needs at least one request")
+        seen = set()
+        for r in reqs:
+            if r.name in seen:
+                raise DuplicateNameError(
+                    f"a collective named '{r.name}' appears twice in one "
+                    "batched submit; names must be unique among in-flight "
+                    "tensors")
+            seen.add(r.name)
+        # Fault site engine.submit — once per batch, before any freeze.
+        injected = flt.engine_submit(reqs[0].name)
+        if injected is not None:
+            raise EngineError(injected)
+        if self._ptr is None:
+            raise ShutdownError("engine is shut down")
+        if self._quiesced is not None:
+            raise EngineError(
+                f"engine is draining ({self._quiesced}): submissions "
+                "are closed — the engine is completing in-flight work "
+                "before shutdown (quiesce)")
+        carr = (native.HvdRequest * n)()
+        keep: List[np.ndarray] = []  # tensor keep-alives through the call
+        flipped: List[np.ndarray] = []
+        donated: dict = {}
+        op_code = _OPS[op]
+        try:
+            for i, r in enumerate(reqs):
+                tensor = np.asarray(r.tensor)
+                do = bool(r.donate) and tensor.flags["C_CONTIGUOUS"]
+                if not do:
+                    tensor = np.ascontiguousarray(tensor)
+                if tensor.dtype not in _DTYPE_CODE:
+                    raise EngineError(f"unsupported dtype {tensor.dtype}")
+                if tensor.ndim > 8:
+                    raise EngineError(
+                        "tensors with >8 dims are not supported")
+                if op != "allreduce":
+                    wire = "none"
+                else:
+                    wire = (resolve_wire_policy(r.compression)
+                            if r.compression is not None
+                            else self.wire_default)
+                if do and _freeze_donated(tensor):
+                    flipped.append(tensor)
+                if r.deadline_ms is not None:
+                    deadline_s = (r.deadline_ms / 1000.0
+                                  if r.deadline_ms > 0 else 0.0)
+                else:
+                    deadline_s = self.default_deadline_s or 0.0
+                keep.append(tensor)
+                q = carr[i]
+                q.op = op_code
+                q.dtype_num = _DTYPE_CODE[tensor.dtype]
+                q.itemsize = tensor.dtype.itemsize
+                q.average = int(r.average)
+                q.root_rank = int(r.root_rank)
+                q.wire = int(WIRE_CODES[wire])
+                q.prescale = float(r.prescale)
+                q.deadline_s = float(deadline_s)
+                q.names = r.name.encode()
+                q.data = tensor.ctypes.data
+                q.out = tensor.ctypes.data
+                q.count = tensor.size
+                q.ndim = tensor.ndim
+                for d, s in enumerate(tensor.shape):
+                    q.shape[d] = s
+                q.donate = int(do)
+                if do:
+                    donated[i] = tensor
+        except Exception:
+            # Rejected mid-build: nothing was handed to C — every buffer
+            # frozen above flips back.
+            for a in flipped:
+                a.flags.writeable = True
+            raise
+        handles_out = (ctypes.c_longlong * n)()
+        err = ctypes.create_string_buffer(256)
+        rc = self._lib.hvd_engine_enqueue_n(
+            self._ptr, carr, n, handles_out, err)
+        if rc != 0:
+            for a in flipped:
+                a.flags.writeable = True
+            msg = err.value.decode()
+            if "names must be unique" in msg:
+                raise DuplicateNameError(msg)
+            if "shut down" in msg:
+                raise ShutdownError(msg)
+            raise EngineError(msg)
+        handles = [int(handles_out[i]) for i in range(n)]
+        for i, h in enumerate(handles):
+            if i in donated:
+                self._donated[h] = donated[i]
+            self._meta[h] = (keep[i].dtype, reqs[i].name)
+        # All N count as submitted — a dup-vs-in-flight verdict only
+        # exists at the loop's fold, so the submit-side tally cannot
+        # exclude it (the python twin counts identically on purpose).
+        # queue_depth=None: reading the pending count would take mu_
+        # (and fold the ring) — the stats sync owns the gauge here.
+        record_submit_batch(op, [t.nbytes for t in keep], None)
+        numx.engine_note_submit_batch([r.name for r in reqs], keep)
+        return handles
+
     def cancel(self, handle: int) -> bool:
         """Cooperative cancel — same contract as the python twin's:
         pre-announce entries retire locally, announced/executing ones
@@ -702,6 +827,11 @@ class NativeEngine:
             self._donated.pop(handle, None)
             if "was cancelled" in msg:
                 raise CancelledError(msg)
+            if "names must be unique" in msg:
+                # Deferred duplicate: a batched submit's request whose
+                # name was already in flight when the loop folded the
+                # ring — that handle alone failed (submit_n docstring).
+                raise DuplicateNameError(msg)
             if "shut down" in msg:
                 raise ShutdownError(msg)
             raise EngineError(msg)

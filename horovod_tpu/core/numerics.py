@@ -397,6 +397,8 @@ def check_consistency(tree, tag: str = "params",
 def np_nonfinite(tensor) -> int:
     try:
         t = np.asarray(tensor)
+        if t.dtype.kind == "f":  # the common case, sans issubdtype cost
+            return int((~np.isfinite(t)).sum())
         if not np.issubdtype(t.dtype, np.floating):
             try:  # ml_dtypes (bfloat16) are floating but not np.floating
                 t = t.astype(np.float32)
@@ -420,6 +422,27 @@ def engine_note_submit(name: str, tensor):
         while len(_engine_submit_nf) >= _ENGINE_SUBMIT_MAX:
             _engine_submit_nf.pop(next(iter(_engine_submit_nf)))
         _engine_submit_nf[name] = nf
+
+
+def engine_note_submit_batch(names, tensors):
+    """The batched-submit twin of :func:`engine_note_submit` — identical
+    per-tensor semantics (same counter, same attribution dict), but the
+    policy/env gate, counter feed and latch lock are paid ONCE per
+    batch, not once per member: a 10k-member ``submit_n`` must not
+    spend more time in instrumentation wrappers than in the submit
+    itself (measured: the per-call form cost ~22 us/tensor, most of it
+    env reads and lock churn)."""
+    if not enabled():
+        return
+    counts = [np_nonfinite(t) for t in tensors]
+    bad = sum(1 for nf in counts if nf)
+    if bad:
+        tele.REGISTRY.counter("numerics.engine.nonfinite_submits").inc(bad)
+    with _lock:
+        for name, nf in zip(names, counts):
+            while len(_engine_submit_nf) >= _ENGINE_SUBMIT_MAX:
+                _engine_submit_nf.pop(next(iter(_engine_submit_nf)))
+            _engine_submit_nf[name] = nf
 
 
 def engine_check_result(name: str, result):

@@ -117,6 +117,30 @@ class Histogram:
             self.sum += v
             self.count += 1
 
+    def observe_many(self, vals):
+        """Fold a whole batch of observations under ONE lock acquisition
+        (the batched-submit telemetry path: per-value observe() would put
+        N lock round-trips back on the submit fast path)."""
+        if not vals:
+            return
+        n_bounds = len(self.bounds)
+        idxs = []
+        total = 0.0
+        for v in vals:
+            i = 0
+            for i, b in enumerate(self.bounds):
+                if v <= b:
+                    break
+            else:
+                i = n_bounds
+            idxs.append(i)
+            total += v
+        with self._lock:
+            for i in idxs:
+                self.counts[i] += 1
+            self.sum += total
+            self.count += len(idxs)
+
     def snapshot(self):
         with self._lock:
             buckets = {}

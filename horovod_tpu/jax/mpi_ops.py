@@ -87,6 +87,64 @@ def broadcast_async(tensor, root_rank: int, name: Optional[str] = None,
         donate=donate, deadline_ms=deadline_ms)
 
 
+def allreduce_n_async(tensors, average: bool = True, names=None,
+                      compression=None, donate: bool = False,
+                      deadline_ms: Optional[float] = None) -> list:
+    """Batched allreduce submit: the whole list rides ONE engine call
+    (``Engine.submit_n`` / ``hvd_engine_enqueue_n``) — one GIL crossing,
+    one snapshot pass over name-bound pool slabs, one engine wakeup.
+    Returns handles in input order for :func:`synchronize`. ``names``
+    aligns per-member engine names with ``tensors`` (auto-named when
+    omitted — but pass stable names for slab pre-binding to bite);
+    ``compression`` is one engine wire policy for all members or a
+    per-member list."""
+    from horovod_tpu.core.engine import SubmitRequest
+
+    ts = list(tensors)
+    if names is None:
+        names = [None] * len(ts)
+    comps = (list(compression) if isinstance(compression, (list, tuple))
+             else [compression] * len(ts))
+    reqs = [SubmitRequest(_auto_name("allreduce", nm), _np_of(t),
+                          average=average, compression=c, donate=donate,
+                          deadline_ms=deadline_ms)
+            for t, nm, c in zip(ts, names, comps)]
+    return get_engine().submit_n("allreduce", reqs)
+
+
+def broadcast_n_async(tensors, root_rank: int, names=None,
+                      donate: bool = False,
+                      deadline_ms: Optional[float] = None) -> list:
+    """Batched broadcast submit — the grouped state-sync twin of
+    :func:`allreduce_n_async` (one engine call for a whole parameter
+    list)."""
+    from horovod_tpu.core.engine import SubmitRequest
+
+    ts = list(tensors)
+    if names is None:
+        names = [None] * len(ts)
+    reqs = [SubmitRequest(_auto_name("broadcast", nm), _np_of(t),
+                          root_rank=root_rank, donate=donate,
+                          deadline_ms=deadline_ms)
+            for t, nm in zip(ts, names)]
+    return get_engine().submit_n("broadcast", reqs)
+
+
+def allreduce_n(tensors, average: bool = True, names=None,
+                compression=None, donate: bool = False) -> list:
+    """Blocking grouped allreduce: batched submit, then drain every
+    handle (results in input order)."""
+    return [synchronize(h) for h in
+            allreduce_n_async(tensors, average, names, compression,
+                              donate)]
+
+
+def broadcast_n(tensors, root_rank: int, names=None,
+                donate: bool = False) -> list:
+    return [synchronize(h) for h in
+            broadcast_n_async(tensors, root_rank, names, donate)]
+
+
 def poll(handle: int) -> bool:
     return get_engine().poll(handle)
 

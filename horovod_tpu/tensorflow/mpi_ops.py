@@ -122,7 +122,32 @@ def _bridge_group(kind: str, tensors, names, *, average=False, root=0,
         # synchronize below returned — past completion — so the engine
         # may reference it in place and skip the submit snapshot (it
         # only READS donated buffers).
-        for k, name, t, w in zip(kinds, names, ts, wires):
+        # The group partitions into consecutive same-kind runs, and each
+        # run rides ONE batched engine call (Engine.submit_n /
+        # hvd_engine_enqueue_n): one GIL crossing and one engine wakeup
+        # for a whole gradient bucket instead of per-tensor submits.
+        # Submit-all-then-wait inside this one py_function is preserved
+        # exactly (the tf-bridge-group deadlock rule).
+        members = list(zip(kinds, names, ts, wires))
+        i = 0
+        while i < len(members):
+            k = members[i][0]
+            if k not in ("allreduce", "broadcast", "allgather"):
+                raise ValueError(k)
+            j = i
+            while j < len(members) and members[j][0] == k:
+                j += 1
+            run = members[i:j]
+            i = j
+            if len(run) > 1:
+                reqs = [_eng.SubmitRequest(
+                            name, np.atleast_1d(np.asarray(t.numpy())),
+                            average=average, root_rank=root,
+                            compression=w, donate=True)
+                        for _, name, t, w in run]
+                handles.extend(e.submit_n(k, reqs))
+                continue
+            _, name, t, w = run[0]
             a = np.atleast_1d(np.asarray(t.numpy()))
             if k == "allreduce":
                 handles.append(e.allreduce_async(name, a, average,
@@ -131,10 +156,8 @@ def _bridge_group(kind: str, tensors, names, *, average=False, root=0,
             elif k == "broadcast":
                 handles.append(e.broadcast_async(name, a, root,
                                                  donate=True))
-            elif k == "allgather":
-                handles.append(e.allgather_async(name, a, donate=True))
             else:
-                raise ValueError(k)
+                handles.append(e.allgather_async(name, a, donate=True))
         # Drain EVERY handle even when one errors (then re-raise the
         # first failure): an abandoned handle would orphan its donated
         # buffer's pin on the native engine, and the group's remaining

@@ -33,12 +33,14 @@ from horovod_tpu.torch.mpi_ops import (  # noqa: F401
     allreduce_,
     allreduce_async,
     allreduce_async_,
+    allreduce_batch_async_,
     allgather,
     allgather_async,
     broadcast,
     broadcast_,
     broadcast_async,
     broadcast_async_,
+    broadcast_batch_async_,
     poll,
     synchronize,
 )
@@ -124,13 +126,33 @@ class _DistributedOptimizer(torch.optim.Optimizer):
     def synchronize(self):
         """Drain outstanding gradient reductions (reference:
         torch/__init__.py:117-136)."""
-        for group in self.param_groups:
-            for p in group["params"]:
-                if p.requires_grad and id(p) not in self._handles \
-                        and p.grad is not None:
-                    # Parameter whose hook did not fire this step (e.g. after
-                    # manual backward wiring): reduce it now.
-                    self._handles[id(p)] = (p, self._allreduce_grad_async(p))
+        missed = [p for group in self.param_groups
+                  for p in group["params"]
+                  if p.requires_grad and id(p) not in self._handles
+                  and p.grad is not None]
+        if len(missed) == 1:
+            # Parameter whose hook did not fire this step (e.g. after
+            # manual backward wiring): reduce it now.
+            p = missed[0]
+            self._handles[id(p)] = (p, self._allreduce_grad_async(p))
+        elif missed:
+            # Hooks fired for none of these (manual backward wiring
+            # reduces the whole bucket here): compress each, then hand
+            # the bucket to the engine as ONE batched submit.
+            from horovod_tpu.jax.compression import for_tensor as _for_tensor
+
+            metas, named, wires = [], [], []
+            for p in missed:
+                name = self._parameter_names[id(p)]
+                comp = _for_tensor(self._compression, name)
+                compressed, cctx = comp.compress(p.grad)
+                named.append((name, compressed))
+                wires.append(getattr(comp, "engine_wire", None))
+                metas.append((p, comp, compressed, cctx))
+            handles = allreduce_batch_async_(named, average=True,
+                                             compressions=wires)
+            for h, (p, comp, compressed, cctx) in zip(handles, metas):
+                self._handles[id(p)] = (p, (h, comp, compressed, cctx))
         for pid, (p, (handle, comp, compressed, ctx)) in list(
                 self._handles.items()):
             out = synchronize(handle)
@@ -176,17 +198,20 @@ def broadcast_parameters(params, root_rank: int = 0):
                     "(name, tensor) pairs (e.g. model.named_parameters()); "
                     f"got item of type {type(it).__name__}"
                 )
-    handles = []
+    batch = []
     for name, p in items:
         if p is None:
             continue
         if torch.is_tensor(p):
-            handles.append(broadcast_async_(p, root_rank, name=name))
+            batch.append((name, p))
         else:
             raise ValueError(
                 f"cannot broadcast non-tensor value for '{name}' "
                 f"(type {type(p).__name__})"
             )
+    # The whole state_dict rides ONE batched engine call — the state
+    # sync costs one GIL crossing and one wakeup, not one per tensor.
+    handles = broadcast_batch_async_(batch, root_rank) if batch else []
     for h in handles:
         synchronize(h)
 
@@ -202,11 +227,11 @@ def broadcast_optimizer_state(optimizer: torch.optim.Optimizer,
     state_dict = optimizer.state_dict()
 
     callbacks = []
-    handles = []
+    batch = []
 
     def _broadcast_value(container, key, value, name):
         if torch.is_tensor(value):
-            handles.append(broadcast_async_(value, root_rank, name=name))
+            batch.append((name, value))
             return
         if isinstance(value, bool):
             t = torch.tensor(int(value), dtype=torch.int64)
@@ -219,8 +244,7 @@ def broadcast_optimizer_state(optimizer: torch.optim.Optimizer,
             restore = lambda x: float(x.item())  # noqa: E731
         else:
             return  # non-numeric options (None, str) assumed identical
-        h = broadcast_async_(t, root_rank, name=name)
-        handles.append(h)
+        batch.append((name, t))
         callbacks.append(lambda c=container, k=key, x=t, r=restore: c.__setitem__(k, r(x)))
 
     for index, group in enumerate(state_dict["param_groups"]):
@@ -234,6 +258,8 @@ def broadcast_optimizer_state(optimizer: torch.optim.Optimizer,
             _broadcast_value(param_state, name, value,
                              f"optimizer.state.{pid}.{name}")
 
+    # One batched engine call for the whole optimizer state.
+    handles = broadcast_batch_async_(batch, root_rank) if batch else []
     for h in handles:
         synchronize(h)
     for cb in callbacks:

@@ -155,6 +155,47 @@ def allreduce_async_(tensor: torch.Tensor, average: bool = True,
     return h
 
 
+def allreduce_batch_async_(named_tensors, average: bool = True,
+                           compressions=None) -> list:
+    """Batched in-place allreduce: ONE engine call (``submit_n`` /
+    ``hvd_engine_enqueue_n``) for a whole bucket of gradients — one GIL
+    crossing, one snapshot pass over name-bound pool slabs, one engine
+    wakeup, instead of per-tensor submit costs. ``named_tensors`` is an
+    iterable of ``(name, tensor)``; ``compressions`` optionally aligns
+    per-member engine wire names with it. Results are copied back into
+    each tensor at its ``synchronize`` (same in-place contract as
+    :func:`allreduce_async_`)."""
+    from horovod_tpu.core.engine import SubmitRequest
+
+    items = list(named_tensors)
+    comps = (list(compressions) if compressions is not None
+             else [None] * len(items))
+    reqs = [SubmitRequest(_auto_name("allreduce", name), _np_of(t),
+                          average=average, compression=c)
+            for (name, t), c in zip(items, comps)]
+    handles = get_engine().submit_n("allreduce", reqs)
+    for h, (_, t) in zip(handles, items):
+        _register(h, t, t)
+    return handles
+
+
+def broadcast_batch_async_(named_tensors, root_rank: int) -> list:
+    """Batched in-place broadcast — the state-sync twin of
+    :func:`allreduce_batch_async_` (``broadcast_parameters`` /
+    ``broadcast_optimizer_state`` hand their whole (name, tensor) list
+    over in one engine call)."""
+    from horovod_tpu.core.engine import SubmitRequest
+
+    items = list(named_tensors)
+    reqs = [SubmitRequest(_auto_name("broadcast", name), _np_of(t),
+                          root_rank=root_rank)
+            for name, t in items]
+    handles = get_engine().submit_n("broadcast", reqs)
+    for h, (_, t) in zip(handles, items):
+        _register(h, t, t)
+    return handles
+
+
 class HorovodAllreduce(torch.autograd.Function):
     @staticmethod
     def forward(ctx, tensor, average, name, wire=None):

@@ -516,10 +516,20 @@ def critical_path_data(target: str) -> dict:
         for (tensor, act), sp in spans.items():
             if act != "QUEUE":
                 continue
-            for b, e, _ in sp:
+            for b, e, qargs in sp:
+                # A batched submit stamps batch_n on its QUEUE spans:
+                # all N members share ONE wall-clock wait, so the
+                # aggregate attributes each member's time at 1/N — a
+                # 10k-member batch must not report 10k x the queue
+                # interval as critical-path time. Per-instance numbers
+                # stay unscaled (the slowest-instances view is about
+                # that tensor's own experience).
+                bn = max(1, int(qargs.get("batch_n", 1) or 1))
                 inst = {"rank": t.rank, "tensor": tensor,
                         "total_us": e - b,
                         "phases": {p: 0 for p in _PHASE_ORDER}}
+                if bn > 1:
+                    inst["batch_n"] = bn
                 for pb, pe, phase in nested.get(tensor, []):
                     if pb >= b and pe <= e:
                         inst["phases"][phase] += pe - pb
@@ -527,7 +537,7 @@ def critical_path_data(target: str) -> dict:
                                 if p != "OTHER")
                 inst["phases"]["OTHER"] = max(0, inst["total_us"] - accounted)
                 for p in _PHASE_ORDER:
-                    phase_us[p] += inst["phases"][p]
+                    phase_us[p] += inst["phases"][p] // bn
                 instances.append(inst)
     total = sum(phase_us.values())
     shares = {p: (phase_us[p] / total if total else 0.0)

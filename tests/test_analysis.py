@@ -118,10 +118,29 @@ def test_abi_catches_skewed_ctypes_field(tmp_path):
 
 def test_abi_catches_new_c_field_missing_from_mirror(tmp_path):
     root = _mini_root(tmp_path)
-    _edit(root, _CC, "long long cancelled;\n};",
-          "long long cancelled;\n  long long new_counter;\n};")
+    _edit(root, _CC, "long long pool_bound_hits;\n};",
+          "long long pool_bound_hits;\n  long long new_counter;\n};")
     findings = abi.check(root)
     assert any(f.rule == "abi-struct" and "new_counter" in f.message
+               for f in findings), findings
+
+
+def test_abi_catches_enqueue_n_argtype_skew(tmp_path):
+    """The batched-submit entry point is machine-diffed like every other
+    hvd_* symbol: narrowing the request-array pointer in the ctypes
+    mirror must be named."""
+    root = _mini_root(tmp_path)
+    _edit(root, _BINDING,
+          "lib.hvd_engine_enqueue_n.argtypes = [\n"
+          "        ctypes.c_void_p, ctypes.POINTER(HvdRequest), "
+          "ctypes.c_int,\n"
+          "        ctypes.POINTER(ctypes.c_longlong), ctypes.c_char_p]",
+          "lib.hvd_engine_enqueue_n.argtypes = [\n"
+          "        ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int,\n"
+          "        ctypes.POINTER(ctypes.c_longlong), ctypes.c_char_p]")
+    findings = abi.check(root)
+    assert any(f.rule == "abi-signature"
+               and "hvd_engine_enqueue_n" in f.message
                for f in findings), findings
 
 
@@ -157,6 +176,17 @@ def test_parity_catches_renamed_cxx_counter_field(tmp_path):
     rules = {f.rule for f in parity.check(root)}
     assert "parity-stats-fields" in rules
     # ...and the ABI checker flags the layout skew independently.
+    assert any(f.rule == "abi-struct" for f in abi.check(root))
+
+
+def test_parity_catches_renamed_ring_counter_field(tmp_path):
+    """The batched-submit stats tail (ring_full/ring_spins/
+    pool_bound_hits) is covered by the same stats-field diff as the
+    legacy counters."""
+    root = _mini_root(tmp_path)
+    _edit(root, _CC, "long long ring_full;", "long long ring_stalls;")
+    rules = {f.rule for f in parity.check(root)}
+    assert "parity-stats-fields" in rules
     assert any(f.rule == "abi-struct" for f in abi.check(root))
 
 

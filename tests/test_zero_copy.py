@@ -134,7 +134,7 @@ def test_pool_disabled_and_capped():
     del x
     y = off.checkout(64, np.float32)
     assert off.stats() == {"hits": 0, "misses": 2, "checkouts": 2,
-                           "bytes_resident": 0}
+                           "bound_hits": 0, "bytes_resident": 0}
     del y
     # Cap: one 4 KiB slab fits, the second is not retained.
     small = bpool.BufferPool(max_bytes=4096)

@@ -64,6 +64,25 @@ def submitter(engine, tid, steps, errors):
         errors.append(f"thread {tid}: {exc!r}")
 
 
+def ring_hammer(engine, tid, steps, errors):
+    """Batched-submit producer: CAS-publish into the MPSC submit ring
+    from several threads at once, against a ring sized small enough that
+    the ring-full locked fallback also gets exercised."""
+    from horovod_tpu.core import engine as eng
+
+    try:
+        for i in range(steps):
+            reqs = [eng.SubmitRequest(f"r{tid}.b{i}.{j}",
+                                      np.full(97, float(j + 1), np.float32),
+                                      average=False)
+                    for j in range(6)]
+            handles = engine.submit_n("allreduce", reqs)
+            for h in handles:
+                engine.synchronize(h)
+    except Exception as exc:  # pragma: no cover - failure path
+        errors.append(f"ring thread {tid}: {exc!r}")
+
+
 def main():
     from horovod_tpu.core.native_engine import NativeEngine
 
@@ -82,6 +101,25 @@ def main():
     for t in threads:
         t.join()
     engine.shutdown()
+
+    # Phase 2: the lock-free submit ring under multi-producer pressure.
+    # An 8-slot ring with 4 producers × 6-request batches guarantees both
+    # the CAS publish path and the ring-full locked fallback run, racing
+    # the loop thread's fold-on-mu_-entry consumer.
+    os.environ["HVD_SUBMIT_RING_SIZE"] = "8"
+    ring_engine = NativeEngine(executor=LocalExecutor(), cycle_time_s=0.002,
+                               stall_warning_s=0.0)
+    ring_threads = [threading.Thread(target=ring_hammer,
+                                     args=(ring_engine, t, 20, errors))
+                    for t in range(4)]
+    for t in ring_threads:
+        t.start()
+    for _ in range(50):
+        ring_engine._collect_stats()
+    for t in ring_threads:
+        t.join()
+    ring_engine.shutdown()
+
     if errors:
         print("\n".join(errors))
         return 1
