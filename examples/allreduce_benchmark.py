@@ -143,6 +143,40 @@ def _decompose_timeline(path, n_ops):
     }
 
 
+def _latency_fields(before, decompose=False):
+    """Per-op submit→complete latency quantiles over the run, from the
+    engine latency histograms (``engine.latency.*`` — the same
+    instruments the fleet rollup merges world-wide, so a benchmark
+    number is directly comparable to a production ``/fleet`` p99).
+    ``before`` is a ``histogram_counts()`` snapshot from the start of
+    the run; quantiles are computed on the bucket-count DELTAS so a
+    warm registry doesn't pollute the window."""
+    from horovod_tpu.core import telemetry as _tele
+
+    out = {}
+    for name, h in sorted(_tele.REGISTRY.histogram_counts().items()):
+        if not name.startswith("engine.latency."):
+            continue
+        prev = before.get(name)
+        counts = (h["counts"] if prev is None else
+                  [c - p for c, p in zip(h["counts"], prev["counts"])])
+        if not sum(counts):
+            continue
+        op = name.rsplit(".", 1)[1]
+        q = {}
+        for label, frac in (("latency_p50_us", 0.5),
+                            ("latency_p99_us", 0.99)):
+            v = _tele.quantile_from_buckets(h["bounds"], counts, frac)
+            q[label] = None if v is None else round(v * 1e6, 1)
+        out[op] = q
+    if decompose and out:
+        parts = [f"{op} p50={q['latency_p50_us']:g}us "
+                 f"p99={q['latency_p99_us']:g}us"
+                 for op, q in sorted(out.items())]
+        print("#   submit->complete latency: " + " | ".join(parts))
+    return out
+
+
 def _wire_split(compressed_bytes, policy_name):
     """Decompose the MEASURED ``engine.wire_bytes.compressed`` counter
     into (payload_bytes, scale_bytes). Exact regardless of how fusion
@@ -182,6 +216,7 @@ def run_engine(args, tl_path):
 
     e = eng.get_engine()
     kind = type(e).__name__
+    lat_before = _tele.REGISTRY.histogram_counts()
     policy = args.compression or "none"
     print(f"# engine path ({kind}), fusion_threshold="
           f"{e.fusion_threshold}, tensors/iter={args.tensors}, "
@@ -274,6 +309,8 @@ def run_engine(args, tl_path):
             "donate": args.donate,
             "pool_max_bytes": _os.environ.get("HVD_POOL_MAX_BYTES",
                                               "default"),
+            "latency": _latency_fields(lat_before,
+                                       decompose=args.decompose),
             "rows": rows}
 
 
@@ -295,8 +332,11 @@ def run_small(args, tl_path):
 
     from horovod_tpu.core import engine as eng
 
+    from horovod_tpu.core import telemetry as _tele
+
     e = eng.get_engine()
     kind = type(e).__name__
+    lat_before = _tele.REGISTRY.histogram_counts()
     n = args.tensors
     elems = max(1, args.bytes // 4)
     names = [f"bench/{i}" for i in range(n)]
@@ -347,6 +387,8 @@ def run_small(args, tl_path):
               "ms_per_iter": round(per_iter * 1e3, 3),
               "submit_tensors_per_s": round(submit_tps, 1),
               "submit_ms_per_iter": round(submit_per_iter * 1e3, 3),
+              "latency": _latency_fields(lat_before,
+                                         decompose=args.decompose),
               "digest": digest}
     if tl_path:
         # Timeline'd rerun on a fresh engine (2 iterations: one binds

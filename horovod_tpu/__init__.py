@@ -55,3 +55,6 @@ from horovod_tpu.core.numerics import (  # noqa: F401
     check_consistency,
     report as numerics_report,
 )
+from horovod_tpu.core.fleet import (  # noqa: F401
+    fleet_report,
+)

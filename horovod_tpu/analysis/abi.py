@@ -28,6 +28,7 @@ STRUCT_MIRRORS = {
     "hvd_request": "HvdRequest",
     "hvd_result": "HvdResult",
     "hvd_engine_stats": "HvdStats",
+    "hvd_engine_latency": "HvdLatency",
 }
 
 # C typedef name -> CFUNCTYPE constant name.
@@ -59,6 +60,7 @@ _ARG_MAP: Dict[str, Tuple[str, ...]] = {
     "hvd_request*": ("POINTER(HvdRequest)",),
     "hvd_result*": ("POINTER(HvdResult)",),
     "hvd_engine_stats*": ("POINTER(HvdStats)",),
+    "hvd_engine_latency*": ("POINTER(HvdLatency)",),
 }
 
 # Canonical C type -> ctypes token inside a Structure (by-value field).

@@ -300,6 +300,30 @@ def parse_string_array(src: str, marker: str) -> List[str]:
     return re.findall(r'"([^"]*)"', m.group(1))
 
 
+def parse_double_array(src: str, marker: str) -> List[float]:
+    """The numeric initializer list of the array declared nearest AFTER
+    ``marker`` (e.g. the ``kLatencyBucketsS`` bucket edges the
+    parity-latency rule diffs against telemetry.LATENCY_BUCKETS_S)."""
+    clean = strip_comments(src)
+    at = clean.find(marker)
+    if at < 0:
+        raise CParseError(f"marker {marker!r} not found")
+    m = re.search(r"\{([^{}]*)\}", clean[at:])
+    if not m:
+        raise CParseError(f"no initializer list after {marker!r}")
+    vals: List[float] = []
+    for piece in m.group(1).split(","):
+        piece = piece.strip()
+        if not piece:
+            continue
+        try:
+            vals.append(float(piece))
+        except ValueError:
+            raise CParseError(
+                f"non-numeric entry {piece!r} in array after {marker!r}")
+    return vals
+
+
 def parse_case_string_map(src: str, fn_name: str) -> Dict[int, str]:
     """``case N: return "name";`` pairs inside one function body."""
     clean = strip_comments(src)

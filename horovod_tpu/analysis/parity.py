@@ -16,6 +16,9 @@ Sources (under the given root, overridable for fixture tests):
   from ``core/engine.py``)
 - C++ literals/tables: ``core/native/hvdcore.cc``
 - span vocabulary: ``core/timeline.py`` module constants
+- latency bucket edges: ``core/telemetry.py`` (LATENCY_BUCKETS_S vs the
+  C++ ``kLatencyBucketsS`` array — world rollups merge per-rank
+  histograms exactly, so the edges must be bit-identical)
 """
 
 from __future__ import annotations
@@ -81,12 +84,13 @@ def _imported_engine_helpers(native_tree: ast.AST) -> Set[str]:
     return names
 
 
-def _stat_counters(native_tree: ast.AST) -> List[Tuple[str, str, int]]:
-    """The ``_STAT_COUNTERS`` (registry name, C stats field) table."""
-    for node in ast.walk(native_tree):
+def _pair_table(tree: ast.AST, var_name: str) -> List[Tuple[str, str, int]]:
+    """A ``VAR = ((reg_name, c_field), ...)`` mapping table read via ast
+    (``_STAT_COUNTERS`` and ``_LATENCY_HISTS`` in native_engine.py)."""
+    for node in ast.walk(tree):
         if isinstance(node, ast.Assign) and len(node.targets) == 1 and \
                 isinstance(node.targets[0], ast.Name) and \
-                node.targets[0].id == "_STAT_COUNTERS" and \
+                node.targets[0].id == var_name and \
                 isinstance(node.value, (ast.Tuple, ast.List)):
             out = []
             for elt in node.value.elts:
@@ -95,6 +99,23 @@ def _stat_counters(native_tree: ast.AST) -> List[Tuple[str, str, int]]:
                     out.append((elt.elts[0].value, elt.elts[1].value,
                                 elt.lineno))
             return out
+    return []
+
+
+def _stat_counters(native_tree: ast.AST) -> List[Tuple[str, str, int]]:
+    """The ``_STAT_COUNTERS`` (registry name, C stats field) table."""
+    return _pair_table(native_tree, "_STAT_COUNTERS")
+
+
+def _latency_buckets(telemetry_tree: ast.AST) -> List[float]:
+    """``LATENCY_BUCKETS_S`` from core/telemetry.py as floats."""
+    for node in ast.walk(telemetry_tree):
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 and \
+                isinstance(node.targets[0], ast.Name) and \
+                node.targets[0].id == "LATENCY_BUCKETS_S" and \
+                isinstance(node.value, (ast.Tuple, ast.List)):
+            return [float(e.value) for e in node.value.elts
+                    if isinstance(e, ast.Constant)]
     return []
 
 
@@ -228,13 +249,15 @@ def check(root: str,
           engine_path: Optional[str] = None,
           native_path: Optional[str] = None,
           bufferpool_path: Optional[str] = None,
-          timeline_path: Optional[str] = None) -> List[Finding]:
+          timeline_path: Optional[str] = None,
+          telemetry_path: Optional[str] = None) -> List[Finding]:
     core = os.path.join(root, "horovod_tpu", "core")
     cc_path = cc_path or os.path.join(core, "native", "hvdcore.cc")
     engine_path = engine_path or os.path.join(core, "engine.py")
     native_path = native_path or os.path.join(core, "native_engine.py")
     bufferpool_path = bufferpool_path or os.path.join(core, "bufferpool.py")
     timeline_path = timeline_path or os.path.join(core, "timeline.py")
+    telemetry_path = telemetry_path or os.path.join(core, "telemetry.py")
 
     cc_rel = os.path.relpath(cc_path, root)
     native_rel = os.path.relpath(native_path, root)
@@ -246,6 +269,8 @@ def check(root: str,
     pool_tree = ast.parse(open(bufferpool_path).read(),
                           filename=bufferpool_path)
     tl_tree = ast.parse(open(timeline_path).read(), filename=timeline_path)
+    tel_tree = ast.parse(open(telemetry_path).read(),
+                         filename=telemetry_path)
 
     findings: List[Finding] = []
 
@@ -262,9 +287,11 @@ def check(root: str,
         if fn is not None:
             shared |= _registry_names(fn)
     stat_counters = _stat_counters(native_tree)
+    latency_hists = _pair_table(native_tree, "_LATENCY_HISTS")
     native_set = (_registry_names(native_tree) | shared
                   | _registry_names(pool_tree)
-                  | {name for name, _, _ in stat_counters})
+                  | {name for name, _, _ in stat_counters}
+                  | {name for name, _, _ in latency_hists})
     for name in sorted(py_set - native_set):
         findings.append(Finding(
             "parity-counters", engine_rel, 0,
@@ -286,6 +313,36 @@ def check(root: str,
                 "parity-stats-fields", native_rel, line,
                 f"_STAT_COUNTERS maps {reg_name!r} to stats field "
                 f"{field!r}, which struct hvd_engine_stats does not "
+                "declare"))
+
+    # -- latency histograms: bucket edges + C-struct field targets ---------
+    py_buckets = _latency_buckets(tel_tree)
+    try:
+        cc_buckets: Optional[List[float]] = cparse.parse_double_array(
+            src, "kLatencyBucketsS")
+    except cparse.CParseError:
+        cc_buckets = None
+    if cc_buckets is None:
+        findings.append(Finding(
+            "parity-latency", cc_rel, 0,
+            "kLatencyBucketsS (the latency histogram bucket edges) not "
+            "found in hvdcore.cc"))
+    elif cc_buckets != py_buckets:
+        findings.append(Finding(
+            "parity-latency", cc_rel, 0,
+            f"C++ kLatencyBucketsS {cc_buckets} does not match "
+            f"telemetry.LATENCY_BUCKETS_S {py_buckets} — per-rank "
+            "histograms only merge exactly on identical edges, a skew "
+            "corrupts every fleet quantile silently"))
+    latency_fields = {f.name for f in
+                      cparse.parse_structs(src).get("hvd_engine_latency",
+                                                    [])}
+    for reg_name, field, line in latency_hists:
+        if field not in latency_fields:
+            findings.append(Finding(
+                "parity-latency", native_rel, line,
+                f"_LATENCY_HISTS maps {reg_name!r} to latency field "
+                f"{field!r}, which struct hvd_engine_latency does not "
                 "declare"))
 
     # -- timeline span vocabulary ------------------------------------------

@@ -52,6 +52,10 @@ RULE_CATALOG: Dict[str, str] = {
     "parity-wire-codes": "the C++ wire-policy code map must match "
                          "WIRE_CODES in core/engine.py",
     "parity-ops": "the C++ HvdOp enum must match the python op codes",
+    "parity-latency": "latency histogram bucket edges (kLatencyBucketsS "
+                      "vs telemetry.LATENCY_BUCKETS_S) and the native "
+                      "_LATENCY_HISTS field targets must match — world "
+                      "rollups merge per-rank histograms exactly",
     "tf-bridge-group": "no per-tensor blocking engine bridge inside a "
                        "TF py_function loop (use _bridge_group: "
                        "submit-all-then-wait)",

@@ -385,6 +385,18 @@ def init(ranks: Optional[Sequence[int]] = None, devices: Optional[Sequence] = No
 
         logging.getLogger("horovod_tpu").warning(
             "elastic world bring-up failed", exc_info=True)
+    # Fleet observability plane: per-rank snapshot publisher (+ rank-0
+    # aggregator) over the KV plane. No-op unless a fleet directory
+    # resolves (HVD_FLEET_DIR, or the elastic dir); must never break init.
+    try:
+        from horovod_tpu.core import fleet as _fleet
+
+        _fleet.maybe_start(_state.process_index, _state.num_processes)
+    except Exception:
+        import logging
+
+        logging.getLogger("horovod_tpu").warning(
+            "fleet plane bring-up failed", exc_info=True)
 
 
 def shutdown():
@@ -403,6 +415,12 @@ def shutdown():
             from horovod_tpu.ops import collectives as _coll
 
             _coll._ranked_program.cache_clear()
+        except Exception:
+            pass
+        try:
+            from horovod_tpu.core import fleet as _fleet
+
+            _fleet.stop()
         except Exception:
             pass
         try:

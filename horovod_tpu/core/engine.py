@@ -209,6 +209,10 @@ class _Entry:
     # cooperative cancel is pending.
     deadline: Optional[float] = None
     phase: str = tl.QUEUE
+    # Monotonic time of the last phase transition: the per-phase
+    # residency histograms (engine.phase.*) observe the elapsed span at
+    # every transition and once more at completion.
+    phase_since: float = field(default_factory=time.monotonic)
     fired: bool = False
     cancelled: bool = False
     # Size of the batched submit this entry rode in on (submit_n /
@@ -604,6 +608,50 @@ def record_cycle(elapsed_s: float):
     both engines apply the same rule, so the counts are comparable)."""
     tele.REGISTRY.counter("engine.cycles").inc()
     tele.REGISTRY.counter("engine.cycle_seconds_total").inc(elapsed_s)
+
+
+def _phase_class(phase: str) -> str:
+    """Collapse a deadline-attribution phase (QUEUE / NEGOTIATE_* /
+    ALLREDUCE / ALLGATHER / BROADCAST) to its residency class."""
+    if phase == tl.QUEUE:
+        return "queue"
+    if phase.startswith("NEGOTIATE"):
+        return "negotiate"
+    return "exec"
+
+
+def record_phase(cls: str, seconds: float):
+    """One phase-residency observation (queue / negotiate / memcpy /
+    exec). Instrument names and bucket boundaries are the cross-engine
+    parity contract: the C++ engine feeds the SAME histograms through
+    ``hvd_engine_latency`` (hvdcheck rule ``parity-latency``). The
+    memcpy class counts one observation per fusion-buffer copy pass
+    that performs a real copy (pack on both engines; the native staging
+    copy-out too — the python twin unpacks by view and observes no
+    copy-out)."""
+    tele.REGISTRY.histogram(
+        "engine.phase.queue" if cls == "queue" else
+        "engine.phase.negotiate" if cls == "negotiate" else
+        "engine.phase.memcpy" if cls == "memcpy" else
+        "engine.phase.exec").observe(seconds)
+
+
+def record_complete_latency(op: str, latency_s: float,
+                            margin_s: Optional[float] = None):
+    """End-to-end submit→complete latency of ONE engine collective, per
+    op class, plus — when the request carried a deadline — the margin
+    remaining at completion (clipped at 0: a deadline-fired entry that
+    completes late reports zero margin). Same parity contract as
+    :func:`record_phase`. The compiled/AOT hot path feeds nothing here
+    (hvd.jax.jit collectives stay uninstrumented — the bench headline's
+    standing rule)."""
+    tele.REGISTRY.histogram(
+        "engine.latency.allreduce" if op == "allreduce" else
+        "engine.latency.allgather" if op == "allgather" else
+        "engine.latency.broadcast").observe(latency_s)
+    if margin_s is not None:
+        tele.REGISTRY.histogram("engine.deadline.margin").observe(
+            max(float(margin_s), 0.0))
 
 
 def make_autotuner(engine):
@@ -1203,7 +1251,9 @@ class Engine:
         for e in entries:
             # Phase attribution reuses the span vocabulary (the C++
             # sweep spells the same literals — hvdcheck parity-spans).
+            record_phase("queue", t_cycle - e.phase_since)
             e.phase = f"NEGOTIATE_{e.op.upper()}"
+            e.phase_since = t_cycle
             self.timeline.start(e.name, f"NEGOTIATE_{e.op.upper()}")
         self._negotiating.extend(entries)
         c = self._coordinator
@@ -1371,6 +1421,7 @@ class Engine:
                 sum(e.tensor.nbytes for e in batch))
         try:
             if fused:
+                t_pack = time.monotonic()
                 for n in names:
                     self.timeline.start(n, tl.MEMCPY_IN_FUSION_BUFFER)
                 dtype = batch[0].tensor.dtype
@@ -1400,6 +1451,7 @@ class Engine:
                         else:
                             flat[off: off + n_] = src
                         off += n_
+                record_phase("memcpy", time.monotonic() - t_pack)
                 pool_args = {"pooled": pooled_fusion}
                 for n in names:
                     self.timeline.end(n, tl.MEMCPY_IN_FUSION_BUFFER,
@@ -1409,8 +1461,11 @@ class Engine:
                 if batch[0].prescale != 1.0:
                     flat = flat * batch[0].prescale
             t0 = self.timeline.now_us()
+            t_exec = time.monotonic()
             for e in batch:
+                record_phase(_phase_class(e.phase), t_exec - e.phase_since)
                 e.phase = tl.ALLREDUCE  # deadline attribution: executing
+                e.phase_since = t_exec
             # Wire policy rides an executor attribute, not a parameter,
             # so custom test executors with the historical two-arg
             # signature keep working (batches are policy-uniform — the
@@ -1442,7 +1497,10 @@ class Engine:
     def _exec_single(self, e: _Entry):
         try:
             t0 = self.timeline.now_us()
+            t_exec = time.monotonic()
+            record_phase(_phase_class(e.phase), t_exec - e.phase_since)
             e.phase = e.op.upper()  # deadline attribution: executing
+            e.phase_since = t_exec
             if e.op == "allgather":
                 out = self.executor.allgather(e.tensor)
                 record_wire(self.executor)
@@ -1458,6 +1516,11 @@ class Engine:
             self._complete(e, None, EngineError(str(exc)))
 
     def _complete(self, e: _Entry, result, err: Optional[Exception]):
+        now = time.monotonic()
+        record_phase(_phase_class(e.phase), now - e.phase_since)
+        record_complete_latency(
+            e.op, now - e.enqueued_at,
+            None if e.deadline is None else e.deadline - now)
         if e.cancelled and err is None:
             # Cooperative cancel: the result (if the entry executed —
             # post-agreement cancels complete cross-rank) is DISCARDED

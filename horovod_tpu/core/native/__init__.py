@@ -216,6 +216,33 @@ class HvdStats(ctypes.Structure):
     ]
 
 
+class HvdLatency(ctypes.Structure):
+    """Latency/phase-residency histogram snapshot — field layout MUST
+    stay in sync with hvd_engine_latency in hvdcore.cc. Each instrument
+    is 13 raw bucket counts over the shared LATENCY_BUCKETS_S edges
+    (last = +Inf overflow) plus an exact value sum; native_engine.py
+    folds count deltas into the registry via Histogram.add_counts."""
+
+    _fields_ = [
+        ("allreduce", ctypes.c_longlong * 13),
+        ("allgather", ctypes.c_longlong * 13),
+        ("broadcast", ctypes.c_longlong * 13),
+        ("phase_queue", ctypes.c_longlong * 13),
+        ("phase_negotiate", ctypes.c_longlong * 13),
+        ("phase_memcpy", ctypes.c_longlong * 13),
+        ("phase_exec", ctypes.c_longlong * 13),
+        ("deadline_margin", ctypes.c_longlong * 13),
+        ("allreduce_sum", ctypes.c_double),
+        ("allgather_sum", ctypes.c_double),
+        ("broadcast_sum", ctypes.c_double),
+        ("phase_queue_sum", ctypes.c_double),
+        ("phase_negotiate_sum", ctypes.c_double),
+        ("phase_memcpy_sum", ctypes.c_double),
+        ("phase_exec_sum", ctypes.c_double),
+        ("deadline_margin_sum", ctypes.c_double),
+    ]
+
+
 EXEC_FN = ctypes.CFUNCTYPE(ctypes.c_int, ctypes.c_void_p,
                            ctypes.POINTER(HvdRequest),
                            ctypes.POINTER(HvdResult))
@@ -282,6 +309,8 @@ def load_library():
         ctypes.c_void_p, ctypes.c_char_p, ctypes.c_longlong]
     lib.hvd_engine_get_stats.argtypes = [ctypes.c_void_p,
                                          ctypes.POINTER(HvdStats)]
+    lib.hvd_engine_get_latency.argtypes = [ctypes.c_void_p,
+                                           ctypes.POINTER(HvdLatency)]
     lib.hvd_engine_timeline_instant.argtypes = [
         ctypes.c_void_p, ctypes.c_char_p, ctypes.c_char_p, ctypes.c_char_p]
     lib.hvd_engine_timeline_meta.argtypes = [

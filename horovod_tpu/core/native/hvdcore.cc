@@ -217,6 +217,41 @@ struct hvd_engine_stats {
   long long pool_bound_hits;
 };
 
+// Latency histogram bucket boundaries in seconds. MUST equal
+// LATENCY_BUCKETS_S in core/telemetry.py — hvdcheck rule parity-latency
+// diffs the two arrays from source, because world-level rollups merge
+// per-rank histograms exactly (same buckets, sum counts) and a skewed
+// edge would silently corrupt every fleet quantile.
+static const double kLatencyBucketsS[12] = {
+    1e-4, 3e-4, 1e-3, 3e-3, 1e-2, 3e-2, 0.1, 0.3, 1.0, 3.0, 10.0, 30.0};
+
+// Per-collective latency / phase-residency histograms. Field layout MUST
+// stay in sync with HvdLatency in native/__init__.py (hvdcheck rule
+// abi-struct). Each instrument is 13 raw bucket counts over
+// kLatencyBucketsS (last = +Inf overflow, matching telemetry.Histogram)
+// plus an exact value sum; the Python stats sync computes deltas between
+// reads and folds them into the registry histograms via
+// Histogram.add_counts, which keeps the merged histogram exact. The
+// compiled/AOT hot path never feeds these — engine-path completions only.
+struct hvd_engine_latency {
+  long long allreduce[13];        // engine.latency.allreduce (s)
+  long long allgather[13];        // engine.latency.allgather (s)
+  long long broadcast[13];        // engine.latency.broadcast (s)
+  long long phase_queue[13];      // engine.phase.queue (s)
+  long long phase_negotiate[13];  // engine.phase.negotiate (s)
+  long long phase_memcpy[13];     // engine.phase.memcpy (s)
+  long long phase_exec[13];       // engine.phase.exec (s)
+  long long deadline_margin[13];  // engine.deadline.margin (s, clipped >= 0)
+  double allreduce_sum;
+  double allgather_sum;
+  double broadcast_sum;
+  double phase_queue_sum;
+  double phase_negotiate_sum;
+  double phase_memcpy_sum;
+  double phase_exec_sum;
+  double deadline_margin_sum;
+};
+
 void* hvd_alloc(long long nbytes) { return malloc((size_t)nbytes); }
 
 }  // extern "C"
@@ -720,6 +755,10 @@ struct Pending {
   bool fired = false;   // deadline already failed the waiter
   long long handle = -1;
   const char* phase = "QUEUE";  // -> NEGOTIATE -> ALLREDUCE/...
+  // Last phase-transition time: the per-phase residency histograms
+  // (engine.phase.*) observe the elapsed span at every transition and
+  // once more at completion, mirroring _Entry.phase_since in engine.py.
+  Clock::time_point phase_since;
 };
 
 // One hvd_engine_enqueue_n call's worth of fully-built entries, published
@@ -940,6 +979,7 @@ class Engine {
     e.enqueued = Clock::now();
     Pending p;
     p.enqueued = e.enqueued;
+    p.phase_since = e.enqueued;
     p.handle = e.handle;
     if (deadline_s > 0) {
       e.has_deadline = true;
@@ -1210,6 +1250,59 @@ class Engine {
                  &out->pool_bytes_resident, &out->pool_bound_hits);
   }
 
+  // --- latency / phase-residency histograms (latency_, guarded by mu_) ---
+
+  // Bucket index for a value: same <= rule as telemetry.Histogram.observe
+  // (first bound the value does not exceed; 12 = the +Inf overflow).
+  static int LatencyBucket(double v) {
+    for (int i = 0; i < 12; ++i)
+      if (v <= kLatencyBucketsS[i]) return i;
+    return 12;
+  }
+
+  static void ObserveInto(long long* counts, double* sum, double v) {
+    counts[LatencyBucket(v)]++;
+    *sum += v;
+  }
+
+  // Residency class of a phase-attribution string — dispatch on the first
+  // letter (QUEUE / NEGOTIATE_* / everything else = executing) rather
+  // than spelling new ALL-CAPS literals the parity-spans vocabulary diff
+  // would flag. Mirrors _phase_class in engine.py.
+  void ObservePhaseLocked(const char* phase, double v) {
+    if (phase != nullptr && phase[0] == 'Q')
+      ObserveInto(latency_.phase_queue, &latency_.phase_queue_sum, v);
+    else if (phase != nullptr && phase[0] == 'N')
+      ObserveInto(latency_.phase_negotiate, &latency_.phase_negotiate_sum, v);
+    else
+      ObserveInto(latency_.phase_exec, &latency_.phase_exec_sum, v);
+  }
+
+  // One observation per fusion-buffer copy pass that performs a real
+  // copy (pack, and the staging copy-out — the python twin unpacks by
+  // view and observes no copy-out; values may differ across engines,
+  // only names and buckets are parity-checked).
+  void ObserveMemcpy(double v) {
+    std::lock_guard<std::mutex> g(mu_);
+    ObserveInto(latency_.phase_memcpy, &latency_.phase_memcpy_sum, v);
+  }
+
+  // End-to-end submit->complete latency per op class, mirroring
+  // record_complete_latency in engine.py.
+  void ObserveCompleteLocked(int op, double latency_s) {
+    if (op == HVD_ALLGATHER)
+      ObserveInto(latency_.allgather, &latency_.allgather_sum, latency_s);
+    else if (op == HVD_BROADCAST)
+      ObserveInto(latency_.broadcast, &latency_.broadcast_sum, latency_s);
+    else
+      ObserveInto(latency_.allreduce, &latency_.allreduce_sum, latency_s);
+  }
+
+  void GetLatency(hvd_engine_latency* out) {
+    std::lock_guard<std::mutex> g(mu_);
+    *out = latency_;
+  }
+
   void Shutdown() {
     {
       std::lock_guard<std::mutex> g(mu_);
@@ -1305,6 +1398,7 @@ class Engine {
     }
     Pending p;
     p.enqueued = e.enqueued;
+    p.phase_since = e.enqueued;
     p.handle = e.handle;
     if (e.has_deadline) {
       p.has_deadline = true;
@@ -1660,6 +1754,7 @@ class Engine {
       bool tracked = false;
       fused = pool_->Get(total * itemsize, &tracked);
       long long off = 0;
+      Clock::time_point t_pack = Clock::now();
       for (auto* e : batch) {
         timeline_.Begin(e->name, "MEMCPY_IN_FUSION_BUFFER");
         memcpy(fused.data() + off, e->bytes(), (size_t)e->nbytes);
@@ -1667,6 +1762,9 @@ class Engine {
         timeline_.End(e->name, "MEMCPY_IN_FUSION_BUFFER",
                       BufferPool::PooledArgs(tracked));
       }
+      // One engine.phase.memcpy observation per pack pass (the python
+      // twin times its fusion pack the same way).
+      ObserveMemcpy(SecondsSince(t_pack));
       req.data = fused.data();
       req.out = fused.data();
     } else if (batch[0]->ext) {
@@ -1832,6 +1930,24 @@ class Engine {
         already_done = pit->second.fired;
         if (pit->second.has_deadline && deadline_count_ > 0)
           deadline_count_--;
+        // Completion instruments (twin of _complete in engine.py): final
+        // phase residency, end-to-end submit->complete latency per op
+        // class, and the remaining deadline margin (clipped >= 0 — a
+        // late completion past its deadline reports zero margin).
+        Clock::time_point now = Clock::now();
+        ObservePhaseLocked(
+            pit->second.phase,
+            std::chrono::duration<double>(now - pit->second.phase_since)
+                .count());
+        ObserveCompleteLocked(
+            e.op, std::chrono::duration<double>(now - e.enqueued).count());
+        if (pit->second.has_deadline) {
+          double margin =
+              std::chrono::duration<double>(pit->second.deadline - now)
+                  .count();
+          ObserveInto(latency_.deadline_margin, &latency_.deadline_margin_sum,
+                      margin > 0.0 ? margin : 0.0);
+        }
         pending_names_.erase(pit);
       }
       // Cooperative cancel: an organic error outranks it (the waiter
@@ -1873,6 +1989,7 @@ class Engine {
         hs->error = error;
       } else {
         bool trace_copy = copy_phase != nullptr;
+        Clock::time_point t_copy = Clock::now();
         if (trace_copy) timeline_.Begin(e.name, copy_phase);
         // Result buffer from the pool (returned by ~HandleState once the
         // handle retires and the last waiter leaves).
@@ -1880,9 +1997,13 @@ class Engine {
         hs->result = pool_->Get(nbytes, &tracked);
         memcpy(hs->result.data(), data, (size_t)nbytes);
         if (shape) hs->shape = *shape;
-        if (trace_copy)
+        if (trace_copy) {
           timeline_.End(e.name, copy_phase,
                         BufferPool::PooledArgs(tracked));
+          // Fused copy-out pass: native-only engine.phase.memcpy feed
+          // (the python twin unpacks by view — no copy to time).
+          ObserveMemcpy(SecondsSince(t_copy));
+        }
       }
       timeline_.End(e.name, "QUEUE", qargs);
     }
@@ -1937,7 +2058,13 @@ class Engine {
   void SetPhase(const std::string& name, const char* phase) {
     std::lock_guard<std::mutex> g(mu_);
     auto it = pending_names_.find(name);
-    if (it != pending_names_.end()) it->second.phase = phase;
+    if (it == pending_names_.end()) return;
+    Clock::time_point now = Clock::now();
+    ObservePhaseLocked(
+        it->second.phase,
+        std::chrono::duration<double>(now - it->second.phase_since).count());
+    it->second.phase = phase;
+    it->second.phase_since = now;
   }
 
   // Fail the waiter of every overdue entry with an attributed
@@ -2078,6 +2205,7 @@ class Engine {
   std::mutex mu_;
   std::condition_variable cv_, cv_done_;
   hvd_engine_stats stats_{};  // guarded by mu_
+  hvd_engine_latency latency_{};  // guarded by mu_ (see GetLatency)
   std::deque<Entry> queue_;
   std::unordered_map<std::string, Pending> pending_names_;
   std::unordered_map<long long, std::shared_ptr<HandleState>> handles_;
@@ -2196,6 +2324,10 @@ long long hvd_engine_pending_names(void* e, char* out, long long cap) {
 
 void hvd_engine_get_stats(void* e, hvd_engine_stats* out) {
   static_cast<Engine*>(e)->GetStats(out);
+}
+
+void hvd_engine_get_latency(void* e, hvd_engine_latency* out) {
+  static_cast<Engine*>(e)->GetLatency(out);
 }
 
 void hvd_engine_timeline_instant(void* e, const char* name,

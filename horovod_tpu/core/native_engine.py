@@ -350,6 +350,7 @@ class NativeEngine:
         # both engines surface the SAME counter names (submit-side
         # counters are recorded in _enqueue below, which is Python).
         self._last_stats: dict = {}
+        self._last_latency: dict = {}
         self._stats_lock = threading.Lock()
         tele.REGISTRY.register_sync(self._collect_stats)
 
@@ -393,6 +394,23 @@ class NativeEngine:
         ("engine.pool.bound_hits", "pool_bound_hits"),
     )
 
+    # Registry histogram name <- hvd_engine_latency field (the parity
+    # contract with record_phase / record_complete_latency in
+    # core/engine.py; bucket edges are parity-checked from source by
+    # hvdcheck rule parity-latency). The C++ loop observed into its own
+    # bucket arrays; _collect_stats folds count DELTAS into the registry
+    # histograms, so merged values stay exact (same buckets, sum counts).
+    _LATENCY_HISTS = (
+        ("engine.latency.allreduce", "allreduce"),
+        ("engine.latency.allgather", "allgather"),
+        ("engine.latency.broadcast", "broadcast"),
+        ("engine.phase.queue", "phase_queue"),
+        ("engine.phase.negotiate", "phase_negotiate"),
+        ("engine.phase.memcpy", "phase_memcpy"),
+        ("engine.phase.exec", "phase_exec"),
+        ("engine.deadline.margin", "deadline_margin"),
+    )
+
     def _collect_stats(self):
         """Fold the C++ loop's counters into the process-wide registry
         (delta since the previous collect — counters stay monotonic
@@ -415,6 +433,20 @@ class NativeEngine:
             # pool together (one data plane, one occupancy number).
             tele.REGISTRY.gauge("engine.pool.bytes_resident").set(
                 int(st.pool_bytes_resident) + self._pool.bytes_resident)
+            lat = native.HvdLatency()
+            self._lib.hvd_engine_get_latency(self._ptr, ctypes.byref(lat))
+            for hist_name, field in self._LATENCY_HISTS:
+                counts = list(getattr(lat, field))
+                prev = self._last_latency.get(field)
+                deltas = (counts if prev is None else
+                          [c - p for c, p in zip(counts, prev)])
+                if any(deltas):
+                    sum_now = float(getattr(lat, field + "_sum"))
+                    tele.REGISTRY.histogram(hist_name).add_counts(
+                        deltas,
+                        sum_now - self._last_latency.get(field + "_sum", 0.0))
+                    self._last_latency[field] = counts
+                    self._last_latency[field + "_sum"] = sum_now
 
     def _emit_clock_meta(self, offset_us: Optional[int],
                          rtt_us: Optional[int]):

@@ -40,12 +40,13 @@ all feed the same registry).
 from __future__ import annotations
 
 import atexit
+import math
 import os
 import re
 import threading
 import time
 from collections import deque
-from typing import Callable, Dict, List, Optional, Tuple
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 # Default bucket boundaries. Latencies span 100 µs (an engine cycle slice)
 # to 30 s (a stalled negotiation); bytes span 256 B (a scalar metric) to
@@ -53,6 +54,40 @@ from typing import Callable, Dict, List, Optional, Tuple
 LATENCY_BUCKETS_S = (1e-4, 3e-4, 1e-3, 3e-3, 1e-2, 3e-2, 0.1, 0.3,
                      1.0, 3.0, 10.0, 30.0)
 BYTES_BUCKETS = tuple(256 * 4 ** i for i in range(12))  # 256 B .. 1 GiB
+
+
+def quantile_from_buckets(bounds: Sequence[float], counts: Sequence[int],
+                          q: float) -> Optional[float]:
+    """Quantile estimate from raw histogram bucket counts
+    (``len(counts) == len(bounds) + 1``, overflow last). Log-interpolates
+    inside the winning bucket — the latency buckets are log-spaced, so
+    linear interpolation would bias every estimate toward the upper
+    edge. The overflow bucket reports the last bound (a lower bound on
+    the true value). None when the histogram is empty."""
+    if len(counts) != len(bounds) + 1:
+        raise ValueError(
+            f"{len(counts)} counts for {len(bounds)} bounds")
+    total = sum(counts)
+    if total <= 0:
+        return None
+    target = q * total
+    cum = 0
+    for i, c in enumerate(counts):
+        cum += c
+        if c and cum >= target:
+            if i >= len(bounds):
+                return float(bounds[-1])
+            hi = float(bounds[i])
+            if i > 0:
+                lo = float(bounds[i - 1])
+            elif len(bounds) > 1:
+                lo = hi * float(bounds[0]) / float(bounds[1])
+            else:
+                lo = hi / 2.0
+            frac = (target - (cum - c)) / c
+            return float(math.exp(
+                math.log(lo) + frac * (math.log(hi) - math.log(lo))))
+    return float(bounds[-1])  # pragma: no cover - cum >= target above
 
 
 class Counter:
@@ -141,6 +176,25 @@ class Histogram:
             self.sum += total
             self.count += len(idxs)
 
+    def add_counts(self, deltas: Sequence[int], sum_delta: float = 0.0):
+        """Fold per-bucket count deltas (``len(self.counts)`` entries,
+        overflow last) plus the matching value-sum delta — the native
+        engine's latency sync path: the C++ side observed into its own
+        bucket array and hands over deltas, exactly like the stats
+        counters, so the merged histogram stays exact (same buckets,
+        summed counts)."""
+        if len(deltas) != len(self.counts):
+            raise ValueError(
+                f"bucket-count mismatch: {len(deltas)} deltas for "
+                f"{len(self.counts)} buckets")
+        with self._lock:
+            n = 0
+            for i, d in enumerate(deltas):
+                self.counts[i] += d
+                n += d
+            self.sum += sum_delta
+            self.count += n
+
     def snapshot(self):
         with self._lock:
             buckets = {}
@@ -183,6 +237,12 @@ class Ring:
             self._buf.append(v)
             self.count += 1
             self.total += v
+
+    def values(self) -> List[float]:
+        """The current window, oldest first (the fleet snapshot ships
+        this for the console's step-time sparkline)."""
+        with self._lock:
+            return list(self._buf)
 
     def snapshot(self):
         with self._lock:
@@ -371,6 +431,40 @@ class Registry:
             items = [(n, m) for n, m in self._metrics.items()
                      if isinstance(m, Counter)]
         return {name: m.snapshot() for name, m in items}
+
+    def flat_gauges(self) -> Dict[str, object]:
+        """Gauges only (post-sync) — the spread-comparable subset the
+        fleet rollup reports min/max over (queue depth, pool bytes)."""
+        self._run_syncs()
+        with self._lock:
+            items = [(n, m) for n, m in self._metrics.items()
+                     if isinstance(m, Gauge)]
+        return {name: m.snapshot() for name, m in items}
+
+    def histogram_counts(self) -> Dict[str, dict]:
+        """{name: {bounds, counts (raw, overflow last), sum, count}} for
+        every histogram (post-sync) — the mergeable form the fleet
+        snapshot publishes: same buckets on every rank, so the world
+        rollup sums counts exactly."""
+        self._run_syncs()
+        with self._lock:
+            items = [(n, m) for n, m in self._metrics.items()
+                     if isinstance(m, Histogram)]
+        out: Dict[str, dict] = {}
+        for name, m in items:
+            with m._lock:
+                out[name] = {"bounds": list(m.bounds),
+                             "counts": list(m.counts),
+                             "sum": m.sum, "count": m.count}
+        return out
+
+    def ring_values(self) -> Dict[str, List[float]]:
+        """{name: recent window} for every ring — the fleet snapshot's
+        sparkline feed (step times, dispatch latencies)."""
+        with self._lock:
+            items = [(n, m) for n, m in self._metrics.items()
+                     if isinstance(m, Ring)]
+        return {name: m.values() for name, m in items}
 
     def snapshot(self) -> dict:
         """Nested dict of every metric (dots become nesting levels)."""

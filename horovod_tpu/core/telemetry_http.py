@@ -11,7 +11,12 @@ must not open the host to the network):
 - ``GET /healthz`` — the sentinel's health JSON (watchdog verdicts +
   last-step age, ``core/sentinel.py``), HTTP 200 when ``ok``/``init``,
   503 when ``warn`` (load balancers and ``curl -f`` get the right
-  signal for free).
+  signal for free);
+- ``GET /fleet`` — the merged world rollup (``core/fleet.py``): per-op
+  latency quantiles, per-rank heatmap with STALE/DEAD marking, world
+  gauges. Degrades to a one-rank rollup off rank 0 / with the plane
+  down. Rank 0's ``/metrics`` also carries the per-rank-labeled
+  ``hvd_fleet_*`` series when the plane is up.
 
 Activation mirrors the file exporter: lazy, on the first telemetry
 touch, only when ``HVD_TELEMETRY_PORT`` is set and nonzero. The
@@ -54,7 +59,18 @@ class _Handler(BaseHTTPRequestHandler):
             if path == "/metrics":
                 from horovod_tpu.core import telemetry
 
-                self._send(200, telemetry.prometheus().encode(),
+                body = telemetry.prometheus()
+                try:
+                    # Per-rank-labeled world series (rank 0 with the
+                    # fleet plane up; empty string elsewhere). A broken
+                    # rollup must not take /metrics down with it.
+                    from horovod_tpu.core import fleet
+
+                    body += fleet.prometheus_extra()
+                except Exception:
+                    LOG.debug("fleet prometheus append failed",
+                              exc_info=True)
+                self._send(200, body.encode(),
                            "text/plain; version=0.0.4")
             elif path == "/healthz":
                 from horovod_tpu.core import sentinel
@@ -63,8 +79,16 @@ class _Handler(BaseHTTPRequestHandler):
                 self._send(200 if h["status"] in ("ok", "init") else 503,
                            (json.dumps(h) + "\n").encode(),
                            "application/json")
+            elif path == "/fleet":
+                from horovod_tpu.core import fleet
+
+                self._send(200,
+                           (json.dumps(fleet.fleet_report()) + "\n")
+                           .encode(),
+                           "application/json")
             else:
-                self._send(404, b"not found: try /metrics or /healthz\n",
+                self._send(404, b"not found: try /metrics, /healthz "
+                                b"or /fleet\n",
                            "text/plain")
         except Exception as exc:  # serving must never kill the thread
             try:
@@ -93,7 +117,7 @@ def maybe_start(port: int) -> Optional[int]:
                                    name="hvd-telemetry-http", daemon=True)
         _thread.start()
         LOG.info("telemetry endpoint on http://127.0.0.1:%d "
-                 "(/metrics, /healthz)", srv.server_address[1])
+                 "(/metrics, /healthz, /fleet)", srv.server_address[1])
         return srv.server_address[1]
 
 

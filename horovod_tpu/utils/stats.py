@@ -22,7 +22,16 @@
 "target", "samples": [{"name", "labels", "value"}, ...]}`` — so a
 dashboard script written against a file keeps working pointed at a live
 ``http://`` rank or a capture dir (xplane figures flatten into
-``xplane_*`` samples with the op class as a label)."""
+``xplane_*`` samples with the op class as a label).
+
+``--fleet <target>`` switches to the live world console: the merged
+cross-rank rollup (:mod:`horovod_tpu.core.fleet`) rendered as a
+step-time sparkline, per-op latency quantiles (p50/p99/p999 merged
+exactly across ranks), deadline/cancel/ring-full counts, and a
+per-rank heatmap with last-beat ages and STALE/DEAD marking. The
+target is the rank-0 HTTP endpoint (``/fleet`` picked automatically),
+a fleet KV directory (``HVD_FLEET_DIR`` — readable with no live
+process), or a saved report JSON; ``--watch N`` redraws."""
 
 from __future__ import annotations
 
@@ -94,6 +103,107 @@ def render(samples: List[Tuple[str, Dict[str, str], float]]) -> str:
     width = max(len(r[0]) for r in rows)
     return "\n".join(f"{label:{width}s} {value:>18s}"
                      for label, value in sorted(rows))
+
+
+_SPARK = "▁▂▃▄▅▆▇█"
+
+
+def sparkline(values: List[float], width: int = 40) -> str:
+    """Unicode block sparkline of the last ``width`` values (the
+    step-time strip at the top of the fleet console)."""
+    vals = [v for v in values[-width:] if isinstance(v, (int, float))]
+    if not vals:
+        return ""
+    lo, hi = min(vals), max(vals)
+    span = hi - lo
+    if span <= 0:
+        return _SPARK[0] * len(vals)
+    return "".join(
+        _SPARK[min(len(_SPARK) - 1,
+                   int((v - lo) / span * len(_SPARK)))] for v in vals)
+
+
+def render_fleet(report: dict) -> str:
+    """Human console of a fleet rollup (``hvd.fleet_report()`` /
+    ``GET /fleet`` / ``core.fleet.report_from_dir``): world line,
+    step-time sparkline, per-op latency quantiles, deadline/cancel
+    counts, and the per-rank heatmap with last-beat ages and
+    STALE/DEAD marking."""
+    lines: List[str] = []
+    marks = []
+    if report.get("stale"):
+        marks.append(f"STALE={report['stale']}")
+    if report.get("dead"):
+        marks.append(f"DEAD={report['dead']}")
+    lines.append(
+        f"world: size={report.get('size', 0)} "
+        f"epoch={report.get('epoch', 0)} "
+        f"generation={report.get('generation', 0)}"
+        + (" " + " ".join(marks) if marks else ""))
+    step = report.get("step") or {}
+    strip = sparkline(step.get("sparkline") or [])
+    if strip:
+        last = (step.get("sparkline") or [None])[-1]
+        lines.append(f"step_s: {strip}  last={last:.4g}"
+                     if isinstance(last, (int, float))
+                     else f"step_s: {strip}")
+    ops = report.get("ops") or {}
+    if ops:
+        lines.append("op          count     p50_us      p99_us     p999_us")
+        for op, q in sorted(ops.items()):
+            lines.append(
+                f"{op:<10s} {q.get('count', 0):>6} "
+                f"{_fmt_us(q.get('p50_us')):>10s} "
+                f"{_fmt_us(q.get('p99_us')):>11s} "
+                f"{_fmt_us(q.get('p999_us')):>11s}")
+    phases = report.get("phases") or {}
+    if phases:
+        lines.append("phase: " + "  ".join(
+            f"{name} p50={_fmt_us(q.get('p50_us'))}us"
+            for name, q in sorted(phases.items())))
+    dl = report.get("deadline") or {}
+    lines.append(
+        f"deadline: exceeded={dl.get('exceeded', 0):g} "
+        f"cancelled={dl.get('cancelled', 0):g} "
+        f"ring_full={dl.get('ring_full', 0):g}")
+    ranks = report.get("ranks") or {}
+    if ranks:
+        lines.append(
+            "rank  state  beat_age   queue     step_s  health  numerics")
+        for r, info in sorted(ranks.items(), key=lambda kv: int(kv[0])):
+            verdicts = info.get("numerics")
+            lines.append(
+                f"{r:>4s}  {info.get('state', '?'):<5s} "
+                f"{info.get('age_s', 0):>7.1f}s "
+                f"{_fmt_us(info.get('queue_depth')):>7s} "
+                f"{_fmt_us(info.get('step_s')):>10s}  "
+                f"{str(info.get('health')):<6s}  "
+                f"{','.join(verdicts) if verdicts else '-'}")
+    return "\n".join(lines)
+
+
+def _fmt_us(v) -> str:
+    return "-" if v is None else f"{v:g}"
+
+
+def _fleet_report_for(target: str) -> dict:
+    """Resolve a ``--fleet`` target into a rollup dict: an ``http://``
+    rank-0 endpoint (``/fleet`` is targeted automatically), a fleet KV
+    directory (cold-scanned, no process needed), or a JSON report file
+    (e.g. a saved ``curl .../fleet`` body)."""
+    from urllib.parse import urlparse
+
+    if _is_http(target):
+        url = target
+        if urlparse(target).path in ("", "/"):
+            url = target.rstrip("/") + "/fleet"
+        return json.loads(fetch_http(url))
+    if os.path.isdir(target):
+        from horovod_tpu.core import fleet
+
+        return fleet.report_from_dir(target)
+    with open(target) as fh:
+        return json.loads(fh.read())
 
 
 def _is_xplane_dir(target: str) -> bool:
@@ -184,6 +294,12 @@ def main(argv=None):
     ap.add_argument("--json", action="store_true",
                     help="machine-readable output (one envelope shape "
                          "for every source)")
+    ap.add_argument("--fleet", action="store_true",
+                    help="render the merged world rollup instead of "
+                         "one rank's registry: target is the rank-0 "
+                         "http endpoint (/fleet), a fleet KV directory "
+                         "(HVD_FLEET_DIR — works with no live "
+                         "process), or a saved report JSON file")
     ap.add_argument("--watch", type=float, metavar="SECONDS", default=None,
                     help="redraw the report every N seconds (exposition "
                          "file, http target or 'live'); Ctrl-C exits "
@@ -194,6 +310,15 @@ def main(argv=None):
     args = ap.parse_args(argv)
 
     def render_once() -> int:
+        if args.fleet:
+            try:
+                report = _fleet_report_for(args.target)
+            except Exception as exc:
+                print(f"cannot build fleet view from {args.target}: {exc}")
+                return 1
+            print(json.dumps(report) if args.json
+                  else render_fleet(report))
+            return 0
         if args.target == "live":
             from horovod_tpu.core import telemetry
 

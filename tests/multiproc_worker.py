@@ -978,6 +978,91 @@ def main():
         assert any("nonfinite" in r for r in reasons), reasons
         assert any("diverged" in r for r in reasons), reasons
         print(f"proc {pid}: FLIGHT dumps {len(dumps)}", flush=True)
+    elif scenario == "fleet":
+        # Fleet observability plane end-to-end: both ranks publish
+        # latency snapshots over the KV plane (HVD_FLEET_DIR set by the
+        # test, interval set huge so only explicit beats land — the
+        # SIGKILL race below must be deterministic); rank 0 merges. The
+        # contract under test: identical instrument vocabularies across
+        # ranks, a world p99 that reflects rank 1's injected skew, and a
+        # SIGKILLed rank going STALE without wedging rank 0's rollup.
+        import signal
+        import time
+        import json as _json
+
+        from horovod_tpu.core import engine as eng, fleet
+
+        e = eng.get_engine()
+        assert fleet._publisher is not None, "fleet publisher not started"
+
+        def _ar(name, **kw):
+            h = e.allreduce_async(name, np.ones((4,), np.float32), False,
+                                  **kw)
+            e.synchronize(h)
+
+        for i in range(8):
+            _ar(f"fast{i}")
+        for i in range(4):
+            if pid == nproc - 1:
+                time.sleep(0.12)  # the skew: peers wait on this rank
+            _ar(f"slow{i}")
+        _ar("deadlined", deadline_ms=30000.0)
+        h = e.allgather_async("gather", np.ones((3,), np.float32))
+        e.synchronize(h)
+        h = e.broadcast_async("bcast", np.ones((2,), np.float32), 0)
+        e.synchronize(h)
+
+        fleet._publisher.publish_once()
+        _ar("sync")  # barrier: every rank has published its final beat
+        if pid == nproc - 1:
+            os.kill(os.getpid(), signal.SIGKILL)
+
+        time.sleep(0.5)  # let the victim die
+        g, ep = fleet._world_coords()
+        mine = fleet.local_snapshot(rank=pid)
+        peer = _json.loads(fleet._aggregator._kv.try_get(
+            fleet.snapshot_key(g, ep, nproc - 1)))
+        # Identical instrument vocabularies (names AND bucket layout are
+        # already pinned engine-to-engine by hvdcheck; this pins them
+        # rank-to-rank through the actual publish path).
+        assert sorted(mine["hists"]) == sorted(peer["hists"]), (
+            sorted(mine["hists"]), sorted(peer["hists"]))
+        for want in ("engine.latency.allreduce", "engine.latency.allgather",
+                     "engine.latency.broadcast", "engine.phase.queue",
+                     "engine.deadline.margin"):
+            assert want in mine["hists"], sorted(mine["hists"])
+
+        rep = hvd.fleet_report()
+        assert rep["size"] == nproc, rep["size"]
+        ar = rep["ops"]["allreduce"]
+        # Merged exactly across ranks: rank 0's live registry has all 14
+        # allreduces; every peer's KV snapshot was published before the
+        # "sync" barrier op, so it carries 13.
+        assert ar["count"] == 14 + 13 * (nproc - 1), ar
+        # The skewed ops put >= 4 observations per survivor rank above
+        # 0.12 s: the world p99 must live in the slow tail while the
+        # p50 stays fast.
+        assert ar["p99_us"] > 50_000, ar
+        assert ar["p50_us"] < ar["p99_us"], ar
+        print(f"proc {pid}: world p99 {ar['p99_us']}us over "
+              f"{ar['count']} ops", flush=True)
+
+        time.sleep(1.5)  # > HVD_FLEET_LEASE_S: the dead rank's seq froze
+        t0 = time.monotonic()
+        rep = hvd.fleet_report()
+        took = time.monotonic() - t0
+        assert took < 5.0, f"rollup wedged for {took:.1f}s"
+        victim = str(nproc - 1)
+        assert rep["ranks"][victim]["state"] == "STALE", rep["ranks"]
+        assert int(victim) in rep["stale"], rep["stale"]
+        assert rep["ranks"]["0"]["state"] == "OK", rep["ranks"]
+        print(f"proc {pid}: rank {victim} STALE after lease, rollup "
+              f"in {took * 1e3:.0f}ms", flush=True)
+        # Same exit discipline as engine_peer_sigkill: the JAX
+        # coordination shutdown barrier can never pass with a SIGKILLed
+        # member — skip atexit entirely.
+        print(f"proc {pid}: SCENARIO {scenario} PASSED", flush=True)
+        os._exit(0)
     elif scenario == "mismatch":
         os.environ["HVD_CONSISTENCY_CHECKS"] = "1"
         from horovod_tpu.common.topology import HorovodInternalError

@@ -59,7 +59,7 @@ def test_every_rule_is_cataloged_and_documented():
 # ---------------------------------------------------------------------------
 
 _CORE_FILES = ("engine.py", "native_engine.py", "bufferpool.py",
-               "timeline.py")
+               "timeline.py", "telemetry.py")
 
 
 def _mini_root(tmp_path):
@@ -229,6 +229,40 @@ def test_parity_catches_wire_code_skew(tmp_path):
     _edit(root, _CC, 'case 2: return "fp8";', 'case 3: return "fp8";')
     findings = parity.check(root)
     assert any(f.rule == "parity-wire-codes" for f in findings), findings
+
+
+def test_parity_catches_skewed_latency_bucket_edge(tmp_path):
+    """The issue's canonical seed: one C++ bucket edge nudged — merged
+    world histograms would silently corrupt every fleet quantile."""
+    root = _mini_root(tmp_path)
+    _edit(root, _CC, "1e-4, 3e-4, 1e-3", "2e-4, 3e-4, 1e-3")
+    findings = parity.check(root)
+    assert any(f.rule == "parity-latency" and "kLatencyBucketsS"
+               in f.message for f in findings), findings
+
+
+def test_parity_catches_renamed_latency_struct_field(tmp_path):
+    """A renamed hvd_engine_latency field skews both the _LATENCY_HISTS
+    fold target (parity) and the ctypes mirror layout (abi)."""
+    root = _mini_root(tmp_path)
+    _edit(root, _CC, "long long phase_exec[13];",
+          "long long phase_execute[13];")
+    findings = parity.check(root)
+    assert any(f.rule == "parity-latency" and "phase_exec" in f.message
+               for f in findings), findings
+    assert any(f.rule == "abi-struct" for f in abi.check(root))
+
+
+def test_parity_catches_renamed_latency_instrument(tmp_path):
+    """A latency instrument renamed on the native fold side only — the
+    vocabularies the two engines feed must stay identical."""
+    root = _mini_root(tmp_path)
+    _edit(root, _NATIVE_PY, '("engine.latency.allreduce", "allreduce"),',
+          '("engine.latency.allreduce_s", "allreduce"),')
+    findings = parity.check(root)
+    assert any(f.rule == "parity-counters"
+               and "engine.latency.allreduce" in f.message
+               for f in findings), findings
 
 
 # ---------------------------------------------------------------------------

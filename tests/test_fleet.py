@@ -1,0 +1,281 @@
+"""Fleet observability plane unit tier (core/fleet.py) — merge
+arithmetic, quantile-from-buckets, the stale-rank lease, and epoch
+scoping, all on in-memory/tmpdir KV backends. The cross-process
+behavior (identical instrument vocabularies on both engines, SIGKILL →
+STALE without wedging rank 0) lives in tests/test_multiprocess.py."""
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from horovod_tpu.core import fleet  # noqa: E402
+from horovod_tpu.core import telemetry as tele  # noqa: E402
+from horovod_tpu.core.coordinator import LocalKV  # noqa: E402
+
+N_BUCKETS = len(tele.LATENCY_BUCKETS_S) + 1
+
+
+def _hist(**bucket_counts):
+    """A snapshot-shaped histogram with counts at named bucket indices
+    (``b2=5`` puts 5 observations in bucket index 2)."""
+    counts = [0] * N_BUCKETS
+    total = 0
+    for key, n in bucket_counts.items():
+        counts[int(key[1:])] = n
+        total += n
+    return {"counts": counts, "sum": 0.0, "count": total}
+
+
+def _snap(rank, seq=1, wall=None, generation=0, epoch=0,
+          counters=None, gauges=None, hists=None, rings=None):
+    import time
+
+    return {
+        "v": 1, "rank": rank, "seq": seq,
+        "wall": time.time() if wall is None else wall,
+        "generation": generation, "epoch": epoch,
+        "counters": counters or {}, "gauges": gauges or {},
+        "hists": hists or {}, "rings": rings or {},
+        "health": "ok", "numerics": None,
+    }
+
+
+# ---------------------------------------------------------------------------
+# Merge arithmetic
+# ---------------------------------------------------------------------------
+
+def test_merge_sums_histograms_exactly():
+    # Rank 0: all fast (bucket 1); rank 1: a slow tail (bucket 8).
+    a = _snap(0, hists={"engine.latency.allreduce": _hist(b1=90)})
+    b = _snap(1, hists={"engine.latency.allreduce": _hist(b1=8, b8=2)})
+    rep = fleet.merge_snapshots([a, b])
+    ar = rep["ops"]["allreduce"]
+    assert ar["count"] == 100
+    # 98 of 100 observations are <= bucket edge 1 (3e-4 s): the world
+    # p50 sits in the fast bucket, the p99 in the tail bucket — exactly
+    # what summing the count arrays must produce.
+    bounds = list(tele.LATENCY_BUCKETS_S)
+    assert ar["p50_us"] <= bounds[1] * 1e6
+    assert ar["p99_us"] > bounds[7] * 1e6
+    assert ar["p50_us"] <= ar["p99_us"] <= ar["p999_us"]
+
+
+def test_merge_quantiles_match_quantile_from_buckets():
+    a = _snap(0, hists={"engine.latency.broadcast": _hist(b0=3, b5=7)})
+    b = _snap(1, hists={"engine.latency.broadcast": _hist(b5=10)})
+    rep = fleet.merge_snapshots([a, b])
+    bounds = list(tele.LATENCY_BUCKETS_S)
+    summed = [x + y for x, y in zip(_hist(b0=3, b5=7)["counts"],
+                                    _hist(b5=10)["counts"])]
+    want = tele.quantile_from_buckets(bounds, summed, 0.99)
+    assert rep["ops"]["broadcast"]["p99_us"] == round(want * 1e6, 1)
+
+
+def test_merge_skips_foreign_bucket_layouts():
+    # A snapshot from a build with different bucket edges must be
+    # dropped from the merge, never summed index-by-index.
+    a = _snap(0, hists={"engine.latency.allreduce": _hist(b1=10)})
+    b = _snap(1, hists={"engine.latency.allreduce": {
+        "counts": [5, 5], "sum": 0.0, "count": 10}})
+    rep = fleet.merge_snapshots([a, b])
+    assert rep["ops"]["allreduce"]["count"] == 10
+
+
+def test_merge_counter_totals_and_gauge_spreads():
+    a = _snap(0, counters={"engine.completed": 10},
+              gauges={"engine.queue_depth": 2.0})
+    b = _snap(1, counters={"engine.completed": 30},
+              gauges={"engine.queue_depth": 6.0})
+    rep = fleet.merge_snapshots([a, b])
+    assert rep["counters"]["engine.completed"] == 40
+    g = rep["gauges"]["engine.queue_depth"]
+    assert (g["min"], g["max"], g["mean"]) == (2.0, 6.0, 4.0)
+    assert g["per_rank"] == {"0": 2.0, "1": 6.0}
+    assert rep["size"] == 2
+
+
+def test_merge_step_ring_feeds_sparkline_and_heatmap():
+    a = _snap(0, rings={"trainer.step_s": [0.01, 0.02, 0.03]})
+    b = _snap(1, rings={"trainer.step_s": [0.05]})
+    rep = fleet.merge_snapshots([a, b])
+    assert rep["step"]["sparkline"] == [0.01, 0.02, 0.03]
+    assert rep["step"]["per_rank_last"] == {"0": 0.03, "1": 0.05}
+    assert rep["ranks"]["1"]["step_s"] == 0.05
+
+
+# ---------------------------------------------------------------------------
+# Aggregator: lease, liveness, epoch scoping
+# ---------------------------------------------------------------------------
+
+def test_aggregator_stale_lease_on_frozen_seq():
+    kv = LocalKV({})
+    kv.set(fleet.snapshot_key(0, 0, 0), json.dumps(_snap(0, seq=1)))
+    kv.set(fleet.snapshot_key(0, 0, 1), json.dumps(_snap(1, seq=1)))
+    agg = fleet.FleetAggregator(kv, nproc=2, lease=1.0)
+    t = 100.0
+    rep = agg.collect(generation=0, epoch=0, now=t)
+    assert rep["stale"] == [] and rep["ranks"]["1"]["state"] == "OK"
+    # Rank 1's seq freezes; rank 0 keeps beating.
+    kv.set(fleet.snapshot_key(0, 0, 0), json.dumps(_snap(0, seq=2)))
+    rep = agg.collect(generation=0, epoch=0, now=t + 1.5)
+    assert rep["ranks"]["0"]["state"] == "OK"
+    assert rep["ranks"]["1"]["state"] == "STALE"
+    assert rep["stale"] == [1]
+    # The rank comes back: seq advances, marking clears immediately.
+    kv.set(fleet.snapshot_key(0, 0, 1), json.dumps(_snap(1, seq=2)))
+    rep = agg.collect(generation=0, epoch=0, now=t + 2.0)
+    assert rep["stale"] == []
+
+
+def test_aggregator_within_lease_is_ok():
+    kv = LocalKV({})
+    kv.set(fleet.snapshot_key(0, 0, 0), json.dumps(_snap(0, seq=1)))
+    agg = fleet.FleetAggregator(kv, nproc=1, lease=1.0)
+    t = 50.0
+    agg.collect(generation=0, epoch=0, now=t)
+    rep = agg.collect(generation=0, epoch=0, now=t + 0.5)
+    assert rep["ranks"]["0"]["state"] == "OK"
+
+
+def test_aggregator_never_blocks_on_missing_ranks():
+    kv = LocalKV({})
+    kv.set(fleet.snapshot_key(0, 0, 2), json.dumps(_snap(2)))
+    agg = fleet.FleetAggregator(kv, nproc=8, lease=1.0)
+    rep = agg.collect(generation=0, epoch=0, now=0.0)
+    assert rep["size"] == 1 and list(rep["ranks"]) == ["2"]
+
+
+def test_aggregator_epoch_scoping():
+    kv = LocalKV({})
+    kv.set(fleet.snapshot_key(0, 0, 0), json.dumps(
+        _snap(0, epoch=0, counters={"engine.completed": 99})))
+    kv.set(fleet.snapshot_key(0, 1, 0), json.dumps(
+        _snap(0, epoch=1, counters={"engine.completed": 7})))
+    agg = fleet.FleetAggregator(kv, nproc=1, lease=1.0)
+    # The new epoch's rollup must not merge against stale-epoch keys.
+    rep = agg.collect(generation=0, epoch=1, now=0.0)
+    assert rep["counters"]["engine.completed"] == 7
+    assert rep["epoch"] == 1
+
+
+def test_aggregator_extra_snapshot_takes_precedence():
+    # Rank 0 hands its registry in directly: the KV copy (older seq)
+    # must not shadow it.
+    kv = LocalKV({})
+    kv.set(fleet.snapshot_key(0, 0, 0), json.dumps(
+        _snap(0, seq=1, counters={"engine.completed": 1})))
+    agg = fleet.FleetAggregator(kv, nproc=1, lease=1.0)
+    local = _snap(0, seq=2, counters={"engine.completed": 5})
+    rep = agg.collect(generation=0, epoch=0, now=0.0, extra=[local])
+    assert rep["counters"]["engine.completed"] == 5
+
+
+def test_aggregator_survives_torn_values():
+    kv = LocalKV({})
+    kv.set(fleet.snapshot_key(0, 0, 0), "{not json")
+    kv.set(fleet.snapshot_key(0, 0, 1), json.dumps(_snap(1)))
+    agg = fleet.FleetAggregator(kv, nproc=2, lease=1.0)
+    rep = agg.collect(generation=0, epoch=0, now=0.0)
+    assert rep["size"] == 1
+
+
+# ---------------------------------------------------------------------------
+# Publisher
+# ---------------------------------------------------------------------------
+
+def test_publisher_works_without_durable_kwarg():
+    # LocalKV.set has no durability knob — the publisher must fall back
+    # to the two-argument form rather than require FileKV.
+    kv = LocalKV({})
+    pub = fleet.FleetPublisher(kv, rank=3, interval=60)
+    pub.publish_once()
+    raw = kv.try_get(fleet.snapshot_key(*fleet._world_coords(), 3))
+    snap = json.loads(raw)
+    assert snap["rank"] == 3 and snap["seq"] == 1
+
+
+def test_publisher_retires_previous_epoch_key(monkeypatch):
+    kv = LocalKV({})
+    pub = fleet.FleetPublisher(kv, rank=0, interval=60)
+    monkeypatch.setattr(fleet, "_world_coords", lambda: (0, 0))
+    pub.publish_once()
+    assert kv.try_get(fleet.snapshot_key(0, 0, 0)) is not None
+    # Elastic shrink: the epoch advances; the dead-epoch key must go.
+    monkeypatch.setattr(fleet, "_world_coords", lambda: (0, 1))
+    pub.publish_once()
+    assert kv.try_get(fleet.snapshot_key(0, 0, 0)) is None
+    snap = json.loads(kv.try_get(fleet.snapshot_key(0, 1, 0)))
+    assert snap["epoch"] == 1 and snap["seq"] == 2
+
+
+# ---------------------------------------------------------------------------
+# Snapshot vocabulary + cold directory scan (the console path)
+# ---------------------------------------------------------------------------
+
+def test_local_snapshot_filters_to_latency_vocabulary():
+    tele.REGISTRY.histogram("engine.latency.allreduce").observe(1e-3)
+    tele.REGISTRY.histogram("negotiation.fusion_width").observe(4)
+    snap = fleet.local_snapshot(rank=0, seq=1, generation=0, epoch=0)
+    assert "engine.latency.allreduce" in snap["hists"]
+    assert "negotiation.fusion_width" not in snap["hists"]
+    counts = snap["hists"]["engine.latency.allreduce"]["counts"]
+    assert len(counts) == N_BUCKETS
+
+
+def test_report_from_dir_picks_newest_epoch_and_marks_stale(tmp_path):
+    from horovod_tpu.core.elastic import FileKV
+
+    kv = FileKV(str(tmp_path))
+    kv.set(fleet.snapshot_key(0, 0, 0), json.dumps(
+        _snap(0, epoch=0, counters={"engine.completed": 99})))
+    kv.set(fleet.snapshot_key(0, 1, 0), json.dumps(
+        _snap(0, epoch=1, counters={"engine.completed": 3})))
+    old = _snap(1, epoch=1)
+    old["wall"] -= 3600.0
+    kv.set(fleet.snapshot_key(0, 1, 1), json.dumps(old))
+    (tmp_path / "not-a-snapshot.txt").write_text("ignore me")
+    rep = fleet.report_from_dir(str(tmp_path))
+    assert rep["epoch"] == 1
+    assert rep["counters"]["engine.completed"] == 3
+    assert rep["stale"] == [1]
+    assert rep["ranks"]["0"]["state"] == "OK"
+
+
+def test_report_from_dir_empty_or_missing(tmp_path):
+    rep = fleet.report_from_dir(str(tmp_path / "nope"))
+    assert rep["size"] == 0
+    rep = fleet.report_from_dir(str(tmp_path))
+    assert rep["size"] == 0
+
+
+# ---------------------------------------------------------------------------
+# Console rendering
+# ---------------------------------------------------------------------------
+
+def test_render_fleet_console():
+    from horovod_tpu.utils import stats
+
+    a = _snap(0, hists={"engine.latency.allreduce": _hist(b1=10)},
+              rings={"trainer.step_s": [0.01, 0.04, 0.02]},
+              counters={"engine.deadline_exceeded": 2})
+    b = _snap(1)
+    b["wall"] -= 3600.0
+    rep = fleet.merge_snapshots([a, b], states={0: "OK", 1: "STALE"})
+    out = stats.render_fleet(rep)
+    assert "size=2" in out
+    assert "STALE" in out
+    assert "allreduce" in out
+    assert "exceeded=2" in out
+    assert "step_s:" in out and "▁" in out  # sparkline rendered
+
+
+def test_sparkline_shapes():
+    from horovod_tpu.utils import stats
+
+    assert stats.sparkline([]) == ""
+    assert stats.sparkline([1.0, 1.0]) == "▁▁"
+    line = stats.sparkline([0.0, 0.5, 1.0])
+    assert line[0] == "▁" and line[-1] == "█"

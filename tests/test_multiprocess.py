@@ -305,6 +305,26 @@ def test_two_process_peer_shutdown_propagates(engine):
     assert any("peer shutdown surfaced" in out for out in outs)
 
 
+@pytest.mark.parametrize("engine", ENGINES)
+def test_two_process_fleet_rollup_and_sigkill_stale(engine, tmp_path):
+    """The fleet observability plane end-to-end on both engines: every
+    rank publishes latency snapshots to the KV plane, rank 0 merges them
+    into world rollups (identical instrument vocabularies across ranks,
+    world p99 reflecting rank 1's injected skew), and a SIGKILLed rank
+    goes STALE after the lease without wedging rank 0's rollup."""
+    outs = _run_world(
+        "fleet",
+        extra_env={"HVD_ENGINE": engine,
+                   "HVD_FLEET_DIR": str(tmp_path),
+                   # Only explicit beats: the STALE verdict must not race
+                   # a background publish between barrier and SIGKILL.
+                   "HVD_FLEET_INTERVAL_S": "60",
+                   "HVD_FLEET_LEASE_S": "1.0"},
+        expect_dead=(1,))
+    assert any("world p99" in out for out in outs)
+    assert any("STALE after lease" in out for out in outs)
+
+
 # ---------------------------------------------------------------------------
 # np=4 tier (VERDICT r2 item 5): negotiation with 3+ peers, failure
 # injection, parameter propagation, and a >2-process two-tier mesh.

@@ -498,8 +498,29 @@ def test_chaos_sigkill_shrink_and_rejoin(engine, tmp_path):
     resume from the newest checkpoint and keep a continuous loss curve
     (no NaN, no restart-from-scratch); the flight dump attributes the
     death; the restarted rank rejoins after the blacklist and
-    ``hvd.check_consistency`` passes on the regrown world."""
-    edir = str(tmp_path / f"elastic_{engine}")
+    ``hvd.check_consistency`` passes on the regrown world.
+
+    De-flake policy: same class as the frozen-heartbeat scenarios
+    (documented in CLAUDE.md) — two ranks pace 5 s leases plus a full
+    blacklist->rejoin->regrow ladder on this one-core host, and a noisy
+    neighbor (most often another chaos world's leftovers in the same
+    pytest session) can starve the ladder past the subprocess timeout
+    or race the regrown world's final eager consistency gather against
+    a peer's exit. ONE automatic same-process retry with a loud note;
+    a double failure is a real regression."""
+    try:
+        _sigkill_shrink_scenario(engine, str(tmp_path / "try1"))
+    except (AssertionError, subprocess.TimeoutExpired) as exc:
+        print(f"\n[RETRY] chaos sigkill-shrink-rejoin ({engine}) failed "
+              f"its first attempt — retrying once in-process; a second "
+              f"failure is a real regression. First failure: "
+              f"{str(exc)[:500]}", file=sys.stderr, flush=True)
+        _reap_stray_world_children()
+        _sigkill_shrink_scenario(engine, str(tmp_path / "try2"))
+
+
+def _sigkill_shrink_scenario(engine, base_dir):
+    edir = os.path.join(base_dir, f"elastic_{engine}")
     os.makedirs(edir)
     env = _clean_env({
         "HVD_ENGINE": engine,
@@ -604,8 +625,25 @@ def test_chaos_rank0_sigkill_kv_failover(engine, tmp_path):
     resume at a bumped world epoch: either IN PLACE over the two
     survivors (multi-survivor shrink — root election + backend rebuild,
     no supervisor relaunch) or via one coordinated exit-77 restart —
-    with a continuous loss curve either way."""
-    edir = str(tmp_path / f"elastic0_{engine}")
+    with a continuous loss curve either way.
+
+    De-flake policy: same load-sensitive chaos class as the other
+    scenarios in this tier (documented in CLAUDE.md): ONE automatic
+    same-process retry with a loud note; a double failure is a real
+    regression."""
+    try:
+        _rank0_failover_scenario(engine, str(tmp_path / "try1"))
+    except (AssertionError, subprocess.TimeoutExpired) as exc:
+        print(f"\n[RETRY] chaos rank0-kv-failover ({engine}) failed its "
+              f"first attempt — retrying once in-process; a second "
+              f"failure is a real regression. First failure: "
+              f"{str(exc)[:500]}", file=sys.stderr, flush=True)
+        _reap_stray_world_children()
+        _rank0_failover_scenario(engine, str(tmp_path / "try2"))
+
+
+def _rank0_failover_scenario(engine, base_dir):
+    edir = os.path.join(base_dir, f"elastic0_{engine}")
     os.makedirs(edir)
     env = _clean_env({
         "HVD_ENGINE": engine,
