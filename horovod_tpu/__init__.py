@@ -58,3 +58,6 @@ from horovod_tpu.core.numerics import (  # noqa: F401
 from horovod_tpu.core.fleet import (  # noqa: F401
     fleet_report,
 )
+from horovod_tpu.core.doctor import (  # noqa: F401
+    diagnose,
+)

@@ -346,6 +346,30 @@ def parse_case_string_map(src: str, fn_name: str) -> Dict[int, str]:
             re.findall(r'case\s+(\d+)\s*:\s*return\s+"([^"]*)"', body)}
 
 
+def function_body(src: str, marker: str) -> str:
+    """The brace-matched body of the function declared nearest AFTER
+    ``marker`` (comments stripped) — e.g. ``"long long Inspect"`` for
+    the inspect-record writer the parity-doctor rule reads."""
+    clean = strip_comments(src)
+    at = clean.find(marker)
+    if at < 0:
+        raise CParseError(f"marker {marker!r} not found")
+    brace = clean.find("{", at)
+    if brace < 0:
+        raise CParseError(f"no function body after {marker!r}")
+    depth = 0
+    i = brace
+    while i < len(clean):
+        if clean[i] == "{":
+            depth += 1
+        elif clean[i] == "}":
+            depth -= 1
+            if depth == 0:
+                break
+        i += 1
+    return clean[brace:i]
+
+
 def string_literals(src: str) -> List[Tuple[str, int]]:
     """Every double-quoted string literal (decoded for the escapes the
     engine actually uses) with its line number. Comments excluded, and

@@ -231,6 +231,53 @@ def _wire_policies(engine_tree: ast.AST) -> List[str]:
     return []
 
 
+def _str_tuple(tree: ast.AST, var_name: str) -> List[str]:
+    """A module-level ``VAR = ("a", "b", ...)`` tuple of strings, in
+    code order (ENGINE_INSPECT_KEYS, VERDICT_KINDS, _DOCTOR_KINDS)."""
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 and \
+                isinstance(node.targets[0], ast.Name) and \
+                node.targets[0].id == var_name and \
+                isinstance(node.value, (ast.Tuple, ast.List)):
+            return [e.value for e in node.value.elts
+                    if isinstance(e, ast.Constant)
+                    and isinstance(e.value, str)]
+    return []
+
+
+def _py_inspect_record_keys(engine_tree: ast.AST) -> List[str]:
+    """Keyword names, in order, of the ``dict(...)`` record builder
+    inside ``Engine.inspect`` — the record shape the python engine
+    actually writes (the keyword-call form is deliberate: dict literals
+    in engine.py belong to the span-args sweep)."""
+    fns = _function_defs(engine_tree)
+    fn = fns.get("inspect")
+    if fn is None:
+        return []
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Call) and \
+                isinstance(node.func, ast.Name) and \
+                node.func.id == "dict" and node.keywords:
+            return [kw.arg for kw in node.keywords if kw.arg]
+    return []
+
+
+def _cc_inspect_record_keys(src: str) -> List[str]:
+    """Record keys, in order, of the C++ ``Engine::Inspect`` writer —
+    the escaped ``\\"key\\":`` (no space: wire-protocol JSON, not
+    span-args) spellings in its body, deduplicated in first-seen order
+    (``deadline_remaining_us`` is written in two branches)."""
+    try:
+        body = cparse.function_body(src, "long long Inspect")
+    except cparse.CParseError:
+        return []
+    keys: List[str] = []
+    for key in re.findall(r'\\"([a-z_]+)\\":', body):
+        if key not in keys:
+            keys.append(key)
+    return keys
+
+
 def _ops_table(native_tree: ast.AST) -> Dict[str, int]:
     for node in ast.walk(native_tree):
         if isinstance(node, ast.Assign) and len(node.targets) == 1 and \
@@ -250,7 +297,9 @@ def check(root: str,
           native_path: Optional[str] = None,
           bufferpool_path: Optional[str] = None,
           timeline_path: Optional[str] = None,
-          telemetry_path: Optional[str] = None) -> List[Finding]:
+          telemetry_path: Optional[str] = None,
+          doctor_path: Optional[str] = None,
+          stats_path: Optional[str] = None) -> List[Finding]:
     core = os.path.join(root, "horovod_tpu", "core")
     cc_path = cc_path or os.path.join(core, "native", "hvdcore.cc")
     engine_path = engine_path or os.path.join(core, "engine.py")
@@ -258,6 +307,9 @@ def check(root: str,
     bufferpool_path = bufferpool_path or os.path.join(core, "bufferpool.py")
     timeline_path = timeline_path or os.path.join(core, "timeline.py")
     telemetry_path = telemetry_path or os.path.join(core, "telemetry.py")
+    doctor_path = doctor_path or os.path.join(core, "doctor.py")
+    stats_path = stats_path or os.path.join(
+        root, "horovod_tpu", "utils", "stats.py")
 
     cc_rel = os.path.relpath(cc_path, root)
     native_rel = os.path.relpath(native_path, root)
@@ -415,6 +467,64 @@ def check(root: str,
             f"C++ WireName map {cc_wire} does not match "
             f"ENGINE_WIRE_POLICIES {py_wire} (expected {expect_wire}; "
             "code 0 = full width, no arg)"))
+
+    # -- hang-doctor contracts ---------------------------------------------
+    # (1) Inspect record shape: ENGINE_INSPECT_KEYS (the published
+    # contract), the dict(...) record Engine.inspect actually builds,
+    # and the C++ Inspect writer's JSON keys must agree, names AND
+    # order — the doctor's cross-rank diff compares these records
+    # across engines, so a skewed field silently breaks attribution.
+    declared_keys = _str_tuple(engine_tree, "ENGINE_INSPECT_KEYS")
+    py_rec_keys = _py_inspect_record_keys(engine_tree)
+    cc_rec_keys = _cc_inspect_record_keys(src)
+    if not declared_keys:
+        findings.append(Finding(
+            "parity-doctor", engine_rel, 0,
+            "ENGINE_INSPECT_KEYS (the inspect-record shape contract) "
+            "not found in core/engine.py"))
+    else:
+        if py_rec_keys != declared_keys:
+            findings.append(Finding(
+                "parity-doctor", engine_rel, 0,
+                f"Engine.inspect builds record keys {py_rec_keys} but "
+                f"ENGINE_INSPECT_KEYS declares {declared_keys} (names "
+                "and order must match)"))
+        if cc_rec_keys != declared_keys:
+            findings.append(Finding(
+                "parity-doctor", cc_rel, 0,
+                f"C++ Inspect writes record keys {cc_rec_keys} but "
+                f"ENGINE_INSPECT_KEYS declares {declared_keys} — the "
+                "doctor diffs these records across engines, a skewed "
+                "field breaks attribution silently"))
+    # (2) Verdict vocabulary: the classifier's VERDICT_KINDS and the
+    # stats CLI's _DOCTOR_KINDS consumer table (rendering priority)
+    # must agree, names and order.
+    if os.path.exists(doctor_path) or os.path.exists(stats_path):
+        doctor_rel = os.path.relpath(doctor_path, root)
+        try:
+            doctor_tree = ast.parse(open(doctor_path).read(),
+                                    filename=doctor_path)
+            stats_tree = ast.parse(open(stats_path).read(),
+                                   filename=stats_path)
+        except OSError as exc:
+            findings.append(Finding(
+                "parity-doctor", doctor_rel, 0,
+                f"cannot read the doctor vocabulary pair: {exc}"))
+        else:
+            kinds = _str_tuple(doctor_tree, "VERDICT_KINDS")
+            consumed = _str_tuple(stats_tree, "_DOCTOR_KINDS")
+            if not kinds:
+                findings.append(Finding(
+                    "parity-doctor", doctor_rel, 0,
+                    "VERDICT_KINDS (the classification vocabulary) not "
+                    "found in core/doctor.py"))
+            elif kinds != consumed:
+                findings.append(Finding(
+                    "parity-doctor", doctor_rel, 0,
+                    f"doctor.VERDICT_KINDS {kinds} does not match "
+                    f"stats._DOCTOR_KINDS {consumed} — a renamed or "
+                    "reordered verdict kind renders as unknown on every "
+                    "console"))
 
     # -- op codes ----------------------------------------------------------
     cc_ops = cparse.parse_enum(src, "HvdOp")

@@ -56,6 +56,12 @@ RULE_CATALOG: Dict[str, str] = {
                       "vs telemetry.LATENCY_BUCKETS_S) and the native "
                       "_LATENCY_HISTS field targets must match — world "
                       "rollups merge per-rank histograms exactly",
+    "parity-doctor": "the inspect record shape (ENGINE_INSPECT_KEYS vs "
+                     "Engine.inspect vs the C++ Inspect writer) and the "
+                     "hang-doctor verdict vocabulary (doctor."
+                     "VERDICT_KINDS vs stats._DOCTOR_KINDS) must match, "
+                     "names and order — the doctor diffs records across "
+                     "engines and ranks",
     "tf-bridge-group": "no per-tensor blocking engine bridge inside a "
                        "TF py_function loop (use _bridge_group: "
                        "submit-all-then-wait)",

@@ -1,0 +1,467 @@
+"""The hang doctor: cross-rank stall diagnosis with attributed verdicts.
+
+The engines' watchdogs say *that* a rank is stuck (stall warnings,
+``CollectiveTimeout``/``NegotiationTimeout``, the straggler report says
+who was *historically* slow) — this module names *which tensor* is
+wedging the world, *which ranks never announced it*, and *why*, the seat
+the reference fills with ``CheckForStalledTensors`` (SURVEY C6), made
+automatic instead of a human diffing eight flight dumps.
+
+Flow
+----
+1. On a hang-class flight dump (stall / deadline / negotiation /
+   SIGUSR1) or on-demand ``hvd.diagnose()``, each rank snapshots its
+   engine's full per-entry inspect table (``Engine.inspect`` /
+   ``hvd_engine_inspect`` — identical record shape, hvdcheck rule
+   ``parity-doctor``) and publishes it under an epoch-scoped key on the
+   existing fleet/KV plane (``hvd/doctor/g{g}/e{e}/p{rank}``).
+2. The diagnoser — every stalled rank live, or offline over flight
+   dumps (``stats --doctor``) — merges whatever snapshots are visible
+   and computes the cross-rank submission diff.
+3. The verdict is attributed with a FIXED classification vocabulary
+   (``VERDICT_KINDS`` — the cross-surface parity contract with
+   ``utils/stats``): it rides the triggering flight dump, feeds the
+   sentinel as verdict kind ``hang`` (``/healthz`` degrades), serves on
+   the telemetry endpoint's ``/doctor`` arm, and blames a tensor on the
+   fleet ``--watch`` console.
+
+Everything here is post-mortem tooling: no function on the engine path
+may raise out of this module.
+"""
+
+import json
+import os
+import time
+from typing import Dict, List, Optional, Tuple
+
+from horovod_tpu.core import telemetry as tele
+
+# The fixed classification vocabulary, in attribution-priority order
+# (the first kind found becomes the verdict's primary ``kind``). This
+# tuple is machine-diffed against the ``_DOCTOR_KINDS`` consumer table
+# in utils/stats.py by hvdcheck rule ``parity-doctor`` — rename a kind
+# on either side and the analysis names the skew.
+VERDICT_KINDS = (
+    "dead_peer",           # a missing rank has an elastic death note
+    "draining",            # a missing/quiesced rank is deliberately draining
+    "missing_submitter",   # tensor + the exact ranks that never announced it
+    "metadata_mismatch",   # per-rank shape/dtype/wire skew on one name
+    "slow_executor",       # phase age far beyond the phase-latency median
+    "kv_degraded",         # the coordination KV store failed over
+)
+
+# Dump kinds that engage the doctor (the engines tag their hang-class
+# flight dumps with these; anything else dumps without a diagnosis).
+HANG_KINDS = ("stall", "deadline", "negotiation", "sigusr1", "diagnose")
+
+# An exec-phase entry is "slow" past max(this multiple of the local
+# engine.phase.exec median, the absolute floor) — generous on purpose:
+# the doctor must not cry slow_executor over ordinary jitter.
+SLOW_MULTIPLE = 10.0
+SLOW_FLOOR_US = 1_000_000.0
+
+
+def doctor_key(generation: int, epoch: int, rank: int) -> str:
+    return f"hvd/doctor/g{generation}/e{epoch}/p{rank}"
+
+
+def _world_coords() -> Tuple[int, int]:
+    from horovod_tpu.core import fleet
+
+    return fleet._world_coords()
+
+
+def _rank_nproc(rank: Optional[int]) -> Tuple[int, int]:
+    try:
+        from horovod_tpu.common import topology as topo
+
+        if topo.is_initialized():
+            return (topo.process_index() if rank is None else int(rank),
+                    topo.num_processes())
+    except Exception:  # pragma: no cover - defensive
+        pass
+    return (0 if rank is None else int(rank)), 1
+
+
+def _dead_ranks() -> Dict[int, str]:
+    """Elastic death notes (rank -> reason) — a missing submitter that
+    is KNOWN dead earns ``dead_peer``, not ``missing_submitter``."""
+    try:
+        from horovod_tpu.core import elastic
+
+        summary = elastic.world_summary()
+        if summary:
+            return {int(r): str(why)
+                    for r, why in summary.get("dead", {}).items()}
+    except Exception:  # pragma: no cover - defensive
+        pass
+    return {}
+
+
+def _draining_reason() -> Optional[str]:
+    try:
+        from horovod_tpu.core import sentinel
+
+        return sentinel._draining_reason()
+    except Exception:  # pragma: no cover - defensive
+        return None
+
+
+def _kv_failovers() -> int:
+    try:
+        return int(tele.REGISTRY.counter("world.kv_failovers").snapshot())
+    except Exception:  # pragma: no cover - defensive
+        return 0
+
+
+def _exec_median_us() -> Optional[float]:
+    """Local ``engine.phase.exec`` median (the PR 17 phase-latency
+    instrument, fed by BOTH engines) — the slow_executor yardstick."""
+    try:
+        h = tele.REGISTRY.histogram_counts().get("engine.phase.exec")
+        if not h or not h.get("count"):
+            return None
+        v = tele.quantile_from_buckets(
+            list(tele.LATENCY_BUCKETS_S), h["counts"], 0.5)
+        return None if v is None else v * 1e6
+    except Exception:  # pragma: no cover - defensive
+        return None
+
+
+def local_snapshot(table: List[dict], rank: Optional[int] = None,
+                   kind: Optional[str] = None,
+                   reason: Optional[str] = None) -> dict:
+    """This rank's published view: the inspect table plus the local
+    context the classifier attributes with (drain marker, KV failover
+    count, the phase-latency median)."""
+    rank, nproc = _rank_nproc(rank)
+    g, e = _world_coords()
+    return {
+        "v": 1,
+        "rank": int(rank),
+        "nproc": int(nproc),
+        "wall": time.time(),
+        "generation": int(g),
+        "epoch": int(e),
+        "kind": kind,
+        "reason": (str(reason).splitlines()[0][:300]
+                   if reason is not None else None),
+        "entries": list(table or []),
+        "draining": _draining_reason(),
+        "kv_failovers": _kv_failovers(),
+        "exec_median_us": _exec_median_us(),
+    }
+
+
+def _kv():
+    """The fleet plane's KV handle (FileKV over the shared fleet
+    directory), or None when the plane is off — the doctor then degrades
+    to a one-rank diagnosis."""
+    try:
+        from horovod_tpu.core import fleet
+
+        d = fleet.fleet_dir()
+        if not d or not fleet.enabled():
+            return None
+        from horovod_tpu.core.elastic import FileKV
+
+        return FileKV(d)
+    except Exception:  # pragma: no cover - defensive
+        return None
+
+
+def publish(kv, snap: dict):
+    """One snapshot to the epoch-scoped doctor key. Same durability
+    policy as the fleet publisher: rename-only (durable=False) — a
+    snapshot lost to power failure is just a missing peer view."""
+    key = doctor_key(snap["generation"], snap["epoch"], snap["rank"])
+    try:
+        kv.set(key, json.dumps(snap), durable=False)
+    except TypeError:
+        # KV backends without the durability knob (LocalKV in tests).
+        kv.set(key, json.dumps(snap))
+
+
+def collect(kv, generation: int, epoch: int, nproc: int,
+            exclude: Optional[int] = None) -> List[dict]:
+    """Peer snapshots for the current (generation, epoch) — non-blocking
+    reads; a rank that never published (wedged before its dump, dead,
+    or simply not stalled) is just absent and becomes part of the
+    diagnosis."""
+    snaps: List[dict] = []
+    for rank in range(int(nproc)):
+        if rank == exclude:
+            continue
+        raw = None
+        try:
+            raw = kv.try_get(doctor_key(generation, epoch, rank))
+        except Exception:  # a failing KV must not wedge the diagnosis
+            continue
+        if raw is None:
+            continue
+        try:
+            snaps.append(json.loads(raw))
+        except ValueError:
+            continue  # torn/foreign value: skip, never raise
+    return snaps
+
+
+def _is_exec_phase(phase: str) -> bool:
+    return bool(phase) and phase != "QUEUE" \
+        and not str(phase).startswith("NEGOTIATE")
+
+
+def classify(snaps: List[dict], nproc: Optional[int] = None,
+             dead: Optional[Dict[int, str]] = None) -> dict:
+    """The cross-rank submission diff → an attributed verdict.
+
+    ``snaps`` is whatever per-rank snapshots are visible (live KV reads
+    or offline flight dumps); ``nproc`` the world size the diff runs
+    against (defaults to the largest size any snapshot reports);
+    ``dead`` the elastic death notes. Returns a verdict dict whose
+    ``kind`` is the highest-priority finding's (``VERDICT_KINDS``
+    order), or None-kinded when nothing is attributable — classification
+    itself never raises on malformed snapshots, it skips them."""
+    dead = dict(dead or {})
+    clean: List[dict] = []
+    for s in snaps:
+        try:
+            int(s["rank"])
+            clean.append(s)
+        except Exception:
+            continue
+    # Newest snapshot per rank wins (offline dirs hold history).
+    by_rank: Dict[int, dict] = {}
+    for s in clean:
+        r = int(s["rank"])
+        prev = by_rank.get(r)
+        if prev is None or s.get("wall", 0) >= prev.get("wall", 0):
+            by_rank[r] = s
+    if nproc is None:
+        sizes = [int(s.get("nproc", 0)) for s in by_rank.values()]
+        nproc = max(sizes + [len(by_rank)]) if by_rank else 0
+    all_ranks = set(range(int(nproc))) | set(by_rank)
+    draining_ranks = {r: s.get("draining") for r, s in by_rank.items()
+                      if s.get("draining")}
+
+    # name -> {rank: inspect record}
+    tensors: Dict[str, Dict[int, dict]] = {}
+    for r, s in by_rank.items():
+        for rec in s.get("entries") or []:
+            try:
+                tensors.setdefault(str(rec["name"]), {})[r] = rec
+            except Exception:
+                continue
+
+    findings: List[dict] = []
+    blamed_dead: Dict[int, List[str]] = {}
+    for name in sorted(tensors):
+        submitters = set(tensors[name])
+        missing = sorted(all_ranks - submitters)
+        dead_missing = [r for r in missing if r in dead]
+        drain_missing = [r for r in missing if r in draining_ranks]
+        other = [r for r in missing
+                 if r not in dead and r not in draining_ranks]
+        for r in dead_missing:
+            blamed_dead.setdefault(r, []).append(name)
+        for r in drain_missing:
+            findings.append({
+                "kind": "draining", "tensor": name, "ranks": [r],
+                "detail": f"rank {r} is draining "
+                          f"({draining_ranks[r]}) and will not submit "
+                          f"'{name}'"})
+        if other:
+            findings.append({
+                "kind": "missing_submitter", "tensor": name,
+                "ranks": other,
+                "detail": f"rank(s) {other} never announced '{name}' "
+                          f"(submitted by rank(s) "
+                          f"{sorted(submitters)})"})
+        if len(submitters) >= 2:
+            meta = {r: (tensors[name][r].get("op"),
+                        tensors[name][r].get("bytes"),
+                        tensors[name][r].get("dtype"),
+                        tensors[name][r].get("wire"))
+                    for r in sorted(submitters)}
+            if len(set(meta.values())) > 1:
+                findings.append({
+                    "kind": "metadata_mismatch", "tensor": name,
+                    "ranks": sorted(submitters),
+                    "detail": "per-rank (op, bytes, dtype, wire) skew: "
+                              + "; ".join(
+                                  f"rank {r}={list(v)}"
+                                  for r, v in meta.items())})
+    for r, names in sorted(blamed_dead.items()):
+        findings.append({
+            "kind": "dead_peer", "tensor": names[0], "ranks": [r],
+            "detail": f"rank {r} is dead ({dead[r]}); it never "
+                      f"announced {names}"})
+    # A draining rank explains a stall even when no per-tensor diff
+    # pinned it (its peers may not have published).
+    for r, why in sorted(draining_ranks.items()):
+        if not any(f["kind"] == "draining" and f["ranks"] == [r]
+                   for f in findings):
+            findings.append({
+                "kind": "draining", "tensor": None, "ranks": [r],
+                "detail": f"rank {r} is draining: {why}"})
+    # slow_executor: an exec-phase entry far beyond the local median.
+    for r, s in sorted(by_rank.items()):
+        median = s.get("exec_median_us")
+        if not median:
+            continue
+        threshold = max(SLOW_MULTIPLE * float(median), SLOW_FLOOR_US)
+        for rec in s.get("entries") or []:
+            try:
+                if _is_exec_phase(rec.get("phase")) \
+                        and float(rec.get("phase_age_us", 0)) > threshold:
+                    findings.append({
+                        "kind": "slow_executor",
+                        "tensor": str(rec["name"]), "ranks": [r],
+                        "detail": f"rank {r} has '{rec['name']}' in "
+                                  f"phase {rec.get('phase')} for "
+                                  f"{float(rec['phase_age_us']) / 1e6:.1f}s"
+                                  f" (median "
+                                  f"{float(median) / 1e6:.3f}s)"})
+            except Exception:
+                continue
+    kv_ranks = {r: int(s.get("kv_failovers") or 0)
+                for r, s in by_rank.items()
+                if int(s.get("kv_failovers") or 0) > 0}
+    if kv_ranks:
+        findings.append({
+            "kind": "kv_degraded", "tensor": None,
+            "ranks": sorted(kv_ranks),
+            "detail": "coordination KV store failed over on rank(s) "
+                      + ", ".join(f"{r} (x{n})"
+                                  for r, n in sorted(kv_ranks.items()))})
+
+    primary = None
+    for kind in VERDICT_KINDS:
+        for f in findings:
+            if f["kind"] == kind:
+                primary = f
+                break
+        if primary is not None:
+            break
+    return {
+        "v": 1,
+        "kind": primary["kind"] if primary else None,
+        "tensor": primary.get("tensor") if primary else None,
+        "ranks": primary.get("ranks") if primary else None,
+        "detail": primary.get("detail") if primary else None,
+        "findings": findings,
+        "ranks_reporting": sorted(by_rank),
+        "nproc": int(nproc),
+        "wall_us": int(time.time() * 1e6),
+    }
+
+
+_last_verdict: Optional[dict] = None
+
+
+def last_verdict() -> Optional[dict]:
+    """The most recent diagnosis this process produced (the ``/doctor``
+    endpoint serves it between hangs), or None."""
+    return _last_verdict
+
+
+def on_hang(reason: Optional[str], kind: Optional[str],
+            table: Optional[List[dict]],
+            rank: Optional[int] = None) -> Optional[dict]:
+    """The engines' hook on a hang-class flight dump: publish this
+    rank's inspect snapshot, diagnose over whatever peer snapshots are
+    visible, feed the sentinel. Returns the verdict (embedded in the
+    triggering dump) or None when the dump kind does not engage the
+    doctor. Raising is the caller's problem to swallow
+    (``engine.doctor_on_hang``) — but nothing here blocks."""
+    global _last_verdict
+    if kind not in HANG_KINDS:
+        return None
+    snap = local_snapshot(table or [], rank=rank, kind=kind,
+                          reason=reason)
+    kv = _kv()
+    snaps = [snap]
+    if kv is not None:
+        publish(kv, snap)
+        snaps += collect(kv, snap["generation"], snap["epoch"],
+                         snap["nproc"], exclude=snap["rank"])
+    verdict = classify(snaps, nproc=snap["nproc"], dead=_dead_ranks())
+    verdict["trigger"] = kind
+    if (verdict.get("kind") is None and kind != "diagnose"
+            and _last_verdict is not None
+            and _last_verdict.get("kind") is not None):
+        # An automatic hang signal that could not attribute anything
+        # (a poisoned engine keeps re-dumping empty rounds after the
+        # victims were culled) must not amnesia the standing diagnosis:
+        # ``last_verdict``/``/doctor`` keep the attributed one. Only an
+        # explicit ``hvd.diagnose()`` all-clear replaces it.
+        return verdict
+    _last_verdict = verdict
+    if verdict.get("kind") is not None:
+        try:
+            from horovod_tpu.core import sentinel
+
+            sentinel.note_hang(verdict, snap["rank"])
+        except Exception:  # pragma: no cover - defensive
+            pass
+    return verdict
+
+
+def diagnose() -> dict:
+    """On-demand diagnosis (``hvd.diagnose()``): snapshot the live
+    engine's inspect table, publish it, and diff against every visible
+    peer snapshot — the FIRST rung of the hung-collective recovery
+    ladder (docs/troubleshooting.md). Safe on a healthy world: an empty
+    table simply announces "this rank is waiting on nothing"."""
+    table: List[dict] = []
+    try:
+        from horovod_tpu.core import engine as _eng
+
+        e = _eng._engine
+        if e is not None:
+            table = e.inspect()
+    except Exception:
+        table = []
+    verdict = on_hang("on-demand hvd.diagnose()", "diagnose", table)
+    return verdict if verdict is not None else classify([])
+
+
+def diagnose_dumps(paths: List[str]) -> dict:
+    """Offline diagnosis over hang-triggered flight-dump files (each
+    embeds the rank's inspect table): the ``stats --doctor <dir>``
+    backend. Dumps without an inspect table (non-hang kinds, pre-doctor
+    versions) are skipped; the newest snapshot per rank wins."""
+    snaps: List[dict] = []
+    for path in paths:
+        try:
+            with open(path) as fh:
+                payload = json.load(fh)
+        except (OSError, ValueError):
+            continue
+        if "inspect" not in payload:
+            continue
+        telem = payload.get("telemetry") or {}
+        snaps.append({
+            "v": 1,
+            "rank": int(payload.get("rank") or 0),
+            "nproc": 0,
+            "wall": float(payload.get("wall_us", 0)) / 1e6,
+            "kind": payload.get("kind"),
+            "reason": payload.get("reason"),
+            "entries": payload.get("inspect") or [],
+            "draining": None,
+            "kv_failovers": int(telem.get("world.kv_failovers", 0)),
+            "exec_median_us": None,
+        })
+    return classify(snaps)
+
+
+def flight_dump_paths(directory: str) -> List[str]:
+    """Every flight-dump file under ``directory`` (the
+    ``hvd_flight.rank{N}.*`` spelling both dump writers use)."""
+    try:
+        names = sorted(os.listdir(directory))
+    except OSError:
+        return []
+    return [os.path.join(directory, n) for n in names
+            if n.startswith("hvd_flight.") and n.endswith(".json")]

@@ -55,6 +55,19 @@ ENGINE_WIRE_POLICIES = ("none", "int8", "fp8")
 WIRE_CODES = {name: i for i, name in enumerate(ENGINE_WIRE_POLICIES)}
 WIRE_NAMES = {i: name for name, i in WIRE_CODES.items()}
 
+# Per-entry introspection record shape (``Engine.inspect`` /
+# ``NativeEngine.inspect`` / the ``hvd_engine_inspect`` C ABI): key names
+# AND their order are machine-diffed against the C++ Inspect writer by
+# hvdcheck rule ``parity-doctor`` — the hang doctor (core/doctor.py)
+# correlates these records across ranks, so the two engines must export
+# the identical shape. Records are built with ``dict(keyword=...)`` on
+# purpose: dict literals in this module are swept by the span-args
+# vocabulary lint (hvdcheck parity-span-args).
+ENGINE_INSPECT_KEYS = (
+    "name", "op", "phase", "phase_age_us", "bytes", "dtype", "wire",
+    "batch_n", "deadline_remaining_us", "round",
+)
+
 
 def _process_str() -> str:
     try:
@@ -654,6 +667,21 @@ def record_complete_latency(op: str, latency_s: float,
             max(float(margin_s), 0.0))
 
 
+def doctor_on_hang(reason, kind, table, rank):
+    """Engage the cross-rank hang doctor (core/doctor.py) on a
+    hang-class flight dump: publish this rank's inspect table on the
+    fleet/KV plane and attempt an attributed verdict. Shared by both
+    engine implementations; never raises — post-mortem reporting must
+    not take the engine down. Returns the verdict dict or None."""
+    try:
+        from horovod_tpu.core import doctor as _doctor
+
+        return _doctor.on_hang(reason, kind, table, rank)
+    except Exception:
+        LOG.debug("hang doctor failed", exc_info=True)
+        return None
+
+
 def make_autotuner(engine):
     """Shared autotuner construction (reference: HOROVOD_AUTOTUNE,
     operations.cc:1797-1804). Returns a ParameterManager or None. In
@@ -740,7 +768,7 @@ class Engine:
         self._clock_synced = False
         # Post-mortem hook: SIGUSR1 dumps the flight recorder of a live
         # (possibly hung) run — no env var needed.
-        tl.install_sigusr1(self._dump_flight)
+        tl.install_sigusr1(self._dump_sigusr1)
         self._thread = threading.Thread(
             target=self._loop, name="hvd-background", daemon=True
         )
@@ -1067,7 +1095,7 @@ class Engine:
                 h.event.set()
             lines.append(f"{e.name} (phase {e.phase}, {age:.2f}s)")
         self._dump_flight("collective deadline exceeded: "
-                          + ", ".join(lines))
+                          + ", ".join(lines), kind="deadline")
 
     def _cull(self, entries):
         """Retire cancelled / deadline-fired entries that have NOT been
@@ -1176,13 +1204,62 @@ class Engine:
         for e in entries:
             self._complete(e, None, err)
 
-    def _dump_flight(self, reason: str):
+    def _dump_flight(self, reason: str, kind: Optional[str] = None):
         """Dump the flight recorder (+ telemetry snapshot) — called on
-        stalls, failed negotiations, shutdown-drained work and SIGUSR1.
-        Never raises: post-mortem reporting must not take the engine
-        down."""
+        stalls, failed negotiations, deadline expiries, shutdown-drained
+        work and SIGUSR1. ``kind`` tags hang-class dumps ("stall",
+        "deadline", "negotiation", "sigusr1"): those embed the per-entry
+        inspect table, engage the cross-rank hang doctor
+        (core/doctor.py) for an attributed verdict, and key the dump
+        rate limit separately so a prior unrelated dump cannot suppress
+        a hang post-mortem. Never raises: post-mortem reporting must not
+        take the engine down."""
+        table = None
+        verdict = None
+        if kind is not None:
+            try:
+                table = self.inspect()
+            except Exception:
+                table = None
+            verdict = doctor_on_hang(reason, kind, table,
+                                     self.timeline.rank)
         tl.dump_and_warn(self.timeline.recent(), reason,
-                         self.timeline.rank, LOG)
+                         self.timeline.rank, LOG, kind=kind,
+                         inspect=table, verdict=verdict)
+
+    def _dump_sigusr1(self, reason: str):
+        """SIGUSR1 entry point: an on-demand live-hang post-mortem —
+        the dump embeds the inspect table and engages the doctor."""
+        self._dump_flight(reason, kind="sigusr1")
+
+    # -- introspection (the hang doctor's raw table) --------------------------
+
+    def inspect(self) -> List[dict]:
+        """Full per-entry state of every in-flight tensor — the hang
+        doctor's raw table, superseding the bare pending-name list.
+        Record shape (``ENGINE_INSPECT_KEYS``) is the cross-engine
+        parity contract with ``hvd_engine_inspect``; hvdcheck rule
+        ``parity-doctor`` machine-diffs the two writers."""
+        c = self._coordinator
+        rnd = int(getattr(c, "round", 0)) if c is not None else 0
+        now = time.monotonic()
+        out = []
+        with self._lock:
+            for e in self._pending_names.values():
+                out.append(dict(
+                    name=e.name,
+                    op=e.op,
+                    phase=e.phase,
+                    phase_age_us=int((now - e.phase_since) * 1e6),
+                    bytes=int(e.tensor.nbytes),
+                    dtype=str(e.tensor.dtype),
+                    wire=e.compression,
+                    batch_n=int(e.batch_n),
+                    deadline_remaining_us=(
+                        None if e.deadline is None
+                        else int((e.deadline - now) * 1e6)),
+                    round=rnd))
+        return out
 
     def set_params(self, cycle_time_s: Optional[float] = None,
                    fusion_threshold: Optional[int] = None):
@@ -1280,15 +1357,20 @@ class Engine:
             msg = str(exc)
             shutdownish = coord.is_shutdownish(exc)
             err = ShutdownError(msg) if shutdownish else EngineError(msg)
+            if not shutdownish:
+                # A hung negotiation (timeout, KV failure) is exactly the
+                # post-mortem the flight recorder exists for; a clean
+                # peer/local shutdown is not. Dump BEFORE failing the
+                # round's entries: the doctor diagnoses off the inspect
+                # table, so the victims must still be in it (the native
+                # twin dumps from the negotiator trampoline before the
+                # C++ loop culls — same order).
+                self._dump_flight(f"negotiation failed: {msg}",
+                                  kind="negotiation")
             for e in self._negotiating:
                 self.timeline.end(e.name, f"NEGOTIATE_{e.op.upper()}")
                 self._complete(e, None, err)
             self._negotiating.clear()
-            if not shutdownish:
-                # A hung negotiation (timeout, KV failure) is exactly the
-                # post-mortem the flight recorder exists for; a clean
-                # peer/local shutdown is not.
-                self._dump_flight(f"negotiation failed: {msg}")
             return
         if c.clock_ready and not self._clock_synced:
             # The anchor exchange completed: embed rank 0's clock bridge
@@ -1625,7 +1707,7 @@ class Engine:
             )
             # Post-mortem: the stalled world's last N events + telemetry,
             # dumped while the dispatch thread may itself be hung.
-            self._dump_flight(f"stalled tensors: {names}")
+            self._dump_flight(f"stalled tensors: {names}", kind="stall")
             # The performance sentinel folds the stall into /healthz and
             # into the next watchdog verdict's attribution.
             try:
@@ -1666,7 +1748,7 @@ class Engine:
                     "engine abandoned: coordination KV plane lost")
                 h.event.set()
         self.timeline.close()
-        tl.uninstall_sigusr1(self._dump_flight)
+        tl.uninstall_sigusr1(self._dump_sigusr1)
 
     def shutdown(self):
         # Publish the shutdown tombstone first: peers blocked mid-round on
@@ -1696,7 +1778,7 @@ class Engine:
         self.timeline.close()
         # A later SIGUSR1 must dump a LIVE engine's ring, not this dead
         # one's — and the module-global handler state must not pin us.
-        tl.uninstall_sigusr1(self._dump_flight)
+        tl.uninstall_sigusr1(self._dump_sigusr1)
 
 
 _engine: Optional[Engine] = None

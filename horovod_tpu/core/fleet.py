@@ -157,6 +157,20 @@ def local_snapshot(rank: Optional[int] = None, seq: int = 0,
         numerics = (h.get("numerics") or {}).get("verdicts")
     except Exception:  # pragma: no cover - defensive
         pass
+    # The hang doctor's latest attributed blame (core/doctor.py), in
+    # compact form: the fleet console's blamed-tensor line rides the
+    # ordinary snapshot plane — no extra keys, no extra reads.
+    doctor = None
+    try:
+        from horovod_tpu.core import doctor as _doc
+
+        v = _doc.last_verdict()
+        if v and v.get("kind"):
+            doctor = {"kind": v["kind"], "tensor": v.get("tensor"),
+                      "ranks": v.get("ranks"),
+                      "wall_us": v.get("wall_us")}
+    except Exception:  # pragma: no cover - defensive
+        pass
     return {
         "v": 1,
         "rank": int(rank),
@@ -170,6 +184,7 @@ def local_snapshot(rank: Optional[int] = None, seq: int = 0,
         "rings": rings,
         "health": health,
         "numerics": numerics,
+        "doctor": doctor,
     }
 
 
@@ -270,6 +285,7 @@ def merge_snapshots(snaps: List[dict],
     hists: Dict[str, dict] = {}
     step_last: Dict[int, Optional[float]] = {}
     sparkline: List[float] = []
+    doctor: Optional[dict] = None
     generation = epoch = 0
     for snap in snaps:
         rank = int(snap["rank"])
@@ -291,6 +307,12 @@ def merge_snapshots(snaps: List[dict],
                 "engine.pool.bytes_resident"),
             "step_s": step_last[rank],
         }
+        blame = snap.get("doctor")
+        if blame and blame.get("kind") and (
+                doctor is None
+                or (blame.get("wall_us") or 0)
+                > (doctor.get("wall_us") or 0)):
+            doctor = blame  # newest attributed hang blame wins
         for name, v in (snap.get("counters") or {}).items():
             counters[name] = counters.get(name, 0) + v
         for name, v in (snap.get("gauges") or {}).items():
@@ -353,6 +375,7 @@ def merge_snapshots(snaps: List[dict],
         "step": {"sparkline": sparkline,
                  "per_rank_last": {str(r): v for r, v
                                    in sorted(step_last.items())}},
+        "doctor": doctor,
     }
 
 

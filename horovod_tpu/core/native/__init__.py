@@ -307,6 +307,9 @@ def load_library():
     lib.hvd_engine_pending_names.restype = ctypes.c_longlong
     lib.hvd_engine_pending_names.argtypes = [
         ctypes.c_void_p, ctypes.c_char_p, ctypes.c_longlong]
+    lib.hvd_engine_inspect.restype = ctypes.c_longlong
+    lib.hvd_engine_inspect.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.c_longlong]
     lib.hvd_engine_get_stats.argtypes = [ctypes.c_void_p,
                                          ctypes.POINTER(HvdStats)]
     lib.hvd_engine_get_latency.argtypes = [ctypes.c_void_p,

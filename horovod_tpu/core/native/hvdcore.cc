@@ -492,6 +492,17 @@ const char* WireName(int wire) {
   }
 }
 
+// Collective op names by code (hvd_request.op) — the inspect records'
+// `op` field; mirrors _OPS in core/native_engine.py.
+const char* OpName(int op) {
+  switch (op) {
+    case 0: return "allreduce";
+    case 1: return "allgather";
+    case 2: return "broadcast";
+    default: return "unknown";
+  }
+}
+
 // Pre-rendered args body for timeline events — dtype + shape (+ the wire
 // policy when one applies), the detail the reference writer records
 // (timeline.cc:98-188).
@@ -759,6 +770,15 @@ struct Pending {
   // (engine.phase.*) observe the elapsed span at every transition and
   // once more at completion, mirroring _Entry.phase_since in engine.py.
   Clock::time_point phase_since;
+  // Introspection metadata (Engine::Inspect — the hang doctor's
+  // per-entry table): stamped from the Entry at both admission sites so
+  // the watchdog can export full entry state while the loop thread may
+  // be wedged inside an executor call holding the Entry itself.
+  int op = 0;
+  long long nbytes = 0;
+  int dtype_num = 0;
+  int wire = 0;
+  int batch_n = 1;
 };
 
 // One hvd_engine_enqueue_n call's worth of fully-built entries, published
@@ -981,6 +1001,11 @@ class Engine {
     p.enqueued = e.enqueued;
     p.phase_since = e.enqueued;
     p.handle = e.handle;
+    p.op = e.op;
+    p.nbytes = e.nbytes;
+    p.dtype_num = e.dtype_num;
+    p.wire = e.wire;
+    p.batch_n = e.batch_n;
     if (deadline_s > 0) {
       e.has_deadline = true;
       e.deadline = e.enqueued + std::chrono::duration_cast<Clock::duration>(
@@ -1237,6 +1262,57 @@ class Engine {
     return (long long)pending_names_.size();
   }
 
+  // Per-entry introspection (hvd_engine_inspect — the hang doctor's raw
+  // table): one JSON object per newline-separated line for every
+  // in-flight tensor, full state rather than PendingNames' bare name
+  // list. Record keys and their order MUST mirror ENGINE_INSPECT_KEYS in
+  // core/engine.py — hvdcheck rule parity-doctor machine-diffs the two.
+  // Same truncation protocol as PendingNames, at record granularity: a
+  // record that does not fit is dropped whole and the TRUE count is
+  // returned, so callers grow the buffer until the parsed line count
+  // matches. (Wire-protocol JSON: no space after the colon — see the
+  // TensorArgs formatting contract above.)
+  long long Inspect(char* out, long long cap) {
+    std::lock_guard<std::mutex> g(mu_);
+    FoldRingLocked();
+    long long used = 0;
+    if (cap > 0) out[0] = '\0';
+    Clock::time_point now = Clock::now();
+    for (auto& kv : pending_names_) {
+      const Pending& p = kv.second;
+      long long phase_age_us = (long long)(
+          std::chrono::duration<double>(now - p.phase_since).count() * 1e6);
+      std::string rec = "{\"name\":\"" + JsonEscape(kv.first) + "\"";
+      rec += ",\"op\":\"";
+      rec += OpName(p.op);
+      rec += "\",\"phase\":\"";
+      rec += p.phase;
+      rec += "\",\"phase_age_us\":" + std::to_string(phase_age_us);
+      rec += ",\"bytes\":" + std::to_string(p.nbytes);
+      rec += ",\"dtype\":\"";
+      rec += DtypeName(p.dtype_num);
+      rec += "\",\"wire\":\"";
+      const char* w = WireName(p.wire);
+      rec += w ? w : "none";
+      rec += "\",\"batch_n\":" + std::to_string(p.batch_n);
+      if (p.has_deadline) {
+        long long rem_us = (long long)(
+            std::chrono::duration<double>(p.deadline - now).count() * 1e6);
+        rec += ",\"deadline_remaining_us\":" + std::to_string(rem_us);
+      } else {
+        rec += ",\"deadline_remaining_us\":null";
+      }
+      rec += ",\"round\":" + std::to_string(neg_round_) + "}";
+      long long need = (long long)rec.size() + (used > 0 ? 1 : 0);
+      if (used + need + 1 > cap) break;
+      if (used > 0) out[used++] = '\n';
+      memcpy(out + used, rec.c_str(), rec.size());
+      used += (long long)rec.size();
+      out[used] = '\0';
+    }
+    return (long long)pending_names_.size();
+  }
+
   void GetStats(hvd_engine_stats* out) {
     {
       std::lock_guard<std::mutex> g(mu_);
@@ -1400,6 +1476,11 @@ class Engine {
     p.enqueued = e.enqueued;
     p.phase_since = e.enqueued;
     p.handle = e.handle;
+    p.op = e.op;
+    p.nbytes = e.nbytes;
+    p.dtype_num = e.dtype_num;
+    p.wire = e.wire;
+    p.batch_n = e.batch_n;
     if (e.has_deadline) {
       p.has_deadline = true;
       p.deadline = e.deadline;
@@ -1545,6 +1626,9 @@ class Engine {
       std::lock_guard<std::mutex> g(mu_);
       fn = neg_fn_;
       ctx = neg_ctx_;
+      // Round counter for the inspect records: peers whose tables
+      // disagree show diverging rounds in the doctor's cross-rank diff.
+      neg_round_++;
     }
     char* decision = nullptr;
     int rc = fn(ctx, table.c_str(), &decision);
@@ -2097,6 +2181,13 @@ class Engine {
       }
     }
     for (auto& f : fired) {
+      // Instant BEFORE releasing the waiter (the python twin's order):
+      // a woken synchronize may read the event ring immediately, and
+      // the DEADLINE_EXCEEDED instant must already be in it.
+      char args[96];
+      snprintf(args, sizeof(args), "\"phase\": \"%s\", \"age_s\": %.3f",
+               f.phase, f.age);
+      timeline_.Instant(f.name, "DEADLINE_EXCEEDED", args);
       std::shared_ptr<HandleState> hs;
       {
         std::lock_guard<std::mutex> g(mu_);
@@ -2115,10 +2206,6 @@ class Engine {
         }
       }
       if (hs != nullptr) cv_done_.notify_all();
-      char args[96];
-      snprintf(args, sizeof(args), "\"phase\": \"%s\", \"age_s\": %.3f",
-               f.phase, f.age);
-      timeline_.Instant(f.name, "DEADLINE_EXCEEDED", args);
     }
   }
 
@@ -2230,6 +2317,9 @@ class Engine {
   hvd_negotiate_fn neg_fn_ = nullptr;
   void* neg_ctx_ = nullptr;
   bool neg_active_ = false;
+  // Negotiation rounds started (guarded by mu_) — the inspect records'
+  // `round` field; the python twin reads Coordinator.round.
+  long long neg_round_ = 0;
   double extra_wait_ = 0.0;  // one-shot idle-round backoff
   // Loop-thread-only state (no lock needed):
   std::vector<Entry> negotiating_;
@@ -2320,6 +2410,10 @@ long long hvd_engine_pending(void* e) {
 
 long long hvd_engine_pending_names(void* e, char* out, long long cap) {
   return static_cast<Engine*>(e)->PendingNames(out, cap);
+}
+
+long long hvd_engine_inspect(void* e, char* out, long long cap) {
+  return static_cast<Engine*>(e)->Inspect(out, cap);
 }
 
 void hvd_engine_get_stats(void* e, hvd_engine_stats* out) {

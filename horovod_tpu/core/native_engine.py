@@ -39,6 +39,7 @@ from horovod_tpu.core.engine import (
     _negotiated,
     collective_deadline_from_env,
     config_from_env,
+    doctor_on_hang,
     make_autotuner,
     quiesce_drain,
     record_cache_config,
@@ -170,7 +171,8 @@ def _make_negotiator(engine):
                 # A hung negotiation (timeout, KV failure) gets the
                 # post-mortem flight-recorder dump; a clean peer/local
                 # shutdown does not — same rule as the python twin.
-                engine._dump_flight(f"negotiation failed: {msg}")
+                engine._dump_flight(f"negotiation failed: {msg}",
+                                    kind="negotiation")
             _write_cstring(lib, out_pp, msg.encode()[:4000])
             return 1
 
@@ -323,7 +325,7 @@ class NativeEngine:
         self._clock_synced = False
         self._emit_clock_meta(None, None)
         # Post-mortem hook: SIGUSR1 dumps the C++ flight-recorder ring.
-        tl.install_sigusr1(self._dump_flight)
+        tl.install_sigusr1(self._dump_sigusr1)
         # Negotiated multi-controller path: register the control-plane
         # trampoline; it is activated lazily once topology knows several
         # processes exist (set_params is re-applied at hvd.init()).
@@ -486,14 +488,33 @@ class NativeEngine:
                 return json.loads(buf.value.decode() or "[]")
             cap = int(n) + 1  # ring grew past the buffer — retry sized
 
-    def _dump_flight(self, reason: str):
-        """Dump the C++ ring (+ telemetry snapshot) — stalls,
-        negotiation failures and SIGUSR1 route here. Never raises."""
+    def _dump_flight(self, reason: str, kind: Optional[str] = None):
+        """Dump the C++ ring (+ telemetry snapshot) — stalls, deadline
+        expiries, negotiation failures and SIGUSR1 route here. ``kind``
+        tags hang-class dumps exactly like the python twin's: those
+        embed the per-entry inspect table (``hvd_engine_inspect``),
+        engage the cross-rank hang doctor (core/doctor.py), and key the
+        dump rate limit separately so a prior unrelated dump cannot
+        suppress a hang post-mortem. Never raises."""
         try:
             events = self.recent_events()
         except Exception:
             events = []
-        tl.dump_and_warn(events, reason, self._rank, LOG)
+        table = None
+        verdict = None
+        if kind is not None:
+            try:
+                table = self.inspect()
+            except Exception:
+                table = None
+            verdict = doctor_on_hang(reason, kind, table, self._rank)
+        tl.dump_and_warn(events, reason, self._rank, LOG, kind=kind,
+                         inspect=table, verdict=verdict)
+
+    def _dump_sigusr1(self, reason: str):
+        """SIGUSR1 entry point: an on-demand live-hang post-mortem —
+        the dump embeds the inspect table and engages the doctor."""
+        self._dump_flight(reason, kind="sigusr1")
 
     def _stall_dump_loop(self):
         """Dump the flight recorder when tensors sit in flight with no
@@ -526,7 +547,7 @@ class NativeEngine:
                     reason = (f"stalled: {int(st.queue_depth)} tensor(s) "
                               f"in flight with no completions for "
                               f"{int(now - stuck_since)}s")
-                    self._dump_flight(reason)
+                    self._dump_flight(reason, kind="stall")
                     # Sentinel parity with the python twin: the stall
                     # becomes /healthz state + verdict attribution.
                     try:
@@ -794,26 +815,37 @@ class NativeEngine:
                              self._pending_names, lambda: None,
                              min(self.cycle_time_s, 0.01))
 
-    def _pending_names(self):
-        """Names of the in-flight tensors, straight from the C++ table
-        (the quiesce report must NAME work like the python twin, not
-        count it). The C side truncates whole names at the buffer cap
-        and returns the TRUE count — grow until every name fits, or a
-        still-wedged tensor beyond the cutoff would be misreported as
-        drained (each call reads names+count under one lock, so the
-        per-call comparison is consistent)."""
+    def inspect(self) -> List[dict]:
+        """Full per-entry state of every in-flight tensor, straight from
+        the C++ table (``hvd_engine_inspect``) — the hang doctor's raw
+        table, record shape identical to ``Engine.inspect()``
+        (``ENGINE_INSPECT_KEYS``; hvdcheck rule ``parity-doctor``
+        machine-diffs the two writers). The C side truncates WHOLE
+        newline-separated JSON records at the buffer cap and returns the
+        TRUE count — grow until every record fits, or a still-wedged
+        tensor beyond the cutoff would vanish from the doctor's
+        cross-rank diff (each call reads records+count under one lock,
+        so the per-call comparison is consistent)."""
         if self._ptr is None:
             return []
         cap = 1 << 16
         while True:
             buf = ctypes.create_string_buffer(cap)
-            total = int(self._lib.hvd_engine_pending_names(
+            total = int(self._lib.hvd_engine_inspect(
                 self._ptr, buf, cap))
             raw = buf.value.decode()
-            names = raw.split(";") if raw else []
-            if len(names) >= total or cap >= (1 << 24):
-                return names
+            records = [json.loads(line)
+                       for line in raw.splitlines() if line]
+            if len(records) >= total or cap >= (1 << 24):
+                return records
             cap *= 2
+
+    def _pending_names(self):
+        """Names of the in-flight tensors (the quiesce report must NAME
+        work like the python twin, not count it) — a projection of the
+        inspect table, which superseded the bare
+        ``hvd_engine_pending_names`` list."""
+        return [r["name"] for r in self.inspect()]
 
     def poll(self, handle: int) -> bool:
         st = self._lib.hvd_engine_poll(self._ptr, handle)
@@ -854,7 +886,7 @@ class NativeEngine:
                 buf = self._donated.pop(handle, None)
                 if buf is not None:
                     self._parked_donations.append(buf)
-                self._dump_flight(msg)
+                self._dump_flight(msg, kind="deadline")
                 raise CollectiveTimeout(msg)
             self._donated.pop(handle, None)
             if "was cancelled" in msg:
@@ -954,7 +986,7 @@ class NativeEngine:
         if ptr is not None:
             self._lib.hvd_engine_shutdown(ptr)  # signal only — no join
         self._meta.clear()
-        tl.uninstall_sigusr1(self._dump_flight)
+        tl.uninstall_sigusr1(self._dump_sigusr1)
 
     def shutdown(self):
         if self._ptr is None:
@@ -984,4 +1016,4 @@ class NativeEngine:
         self._donated.clear()
         # A later SIGUSR1 must dump a LIVE engine's ring, not this dead
         # one's — and the module-global handler state must not pin us.
-        tl.uninstall_sigusr1(self._dump_flight)
+        tl.uninstall_sigusr1(self._dump_sigusr1)

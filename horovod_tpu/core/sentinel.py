@@ -624,6 +624,26 @@ class Sentinel:
         self.last_verdict = verdict
         return verdict
 
+    def note_hang(self, verdict: dict,
+                  rank: Optional[int] = None) -> dict:
+        """The hang doctor's attributed verdict (core/doctor.py) lands
+        here as verdict kind ``hang``: counted under the existing
+        ``sentinel.verdict.*`` vocabulary, recorded as ``last_verdict``
+        (recency degrades ``/healthz`` to warn/503 exactly like a
+        watchdog or numerics verdict). No flight dump of its own — the
+        hang-class dump that triggered the diagnosis already embeds the
+        doctor verdict, and a second dump here would only burn the rate
+        limit."""
+        v = {"origin": "doctor", "verdict": "hang",
+             "wall_us": int(time.time() * 1e6)}
+        if rank is not None:
+            v["rank"] = rank
+        v.update({k: val for k, val in verdict.items()
+                  if k not in ("origin", "verdict", "wall_us")})
+        tele.REGISTRY.counter("sentinel.verdict.hang").inc()
+        self.last_verdict = v
+        return v
+
     def set_flops_per_step(self, flops: Optional[float]):
         """Tell the sentinel the compiled step's FLOP cost so capture
         records can carry MFU (the training loop knows it from XLA cost
@@ -905,6 +925,15 @@ def note_numerics(kind: str, info: dict) -> dict:
         return get_sentinel().note_numerics(kind, info)
     except Exception:  # pragma: no cover - defensive
         return {"verdict": kind, "dump": None}
+
+
+def note_hang(verdict: dict, rank: Optional[int] = None):
+    """Module-level hook the hang doctor calls with its attributed
+    verdict. Never raises."""
+    try:
+        return get_sentinel().note_hang(verdict, rank)
+    except Exception:  # pragma: no cover - defensive
+        return None
 
 
 def health() -> dict:

@@ -16,7 +16,11 @@ must not open the host to the network):
   latency quantiles, per-rank heatmap with STALE/DEAD marking, world
   gauges. Degrades to a one-rank rollup off rank 0 / with the plane
   down. Rank 0's ``/metrics`` also carries the per-rank-labeled
-  ``hvd_fleet_*`` series when the plane is up.
+  ``hvd_fleet_*`` series when the plane is up;
+- ``GET /doctor`` — an on-demand hang diagnosis (``core/doctor.py``):
+  this rank publishes its per-entry inspect table and diffs it against
+  every visible peer snapshot, answering with the attributed verdict —
+  the remote spelling of ``hvd.diagnose()``.
 
 Activation mirrors the file exporter: lazy, on the first telemetry
 touch, only when ``HVD_TELEMETRY_PORT`` is set and nonzero. The
@@ -86,9 +90,20 @@ class _Handler(BaseHTTPRequestHandler):
                            (json.dumps(fleet.fleet_report()) + "\n")
                            .encode(),
                            "application/json")
+            elif path == "/doctor":
+                # On-demand hang diagnosis (core/doctor.py): publish
+                # this rank's inspect table and diff it against every
+                # visible peer snapshot — `curl :port/doctor` is the
+                # remote spelling of hvd.diagnose().
+                from horovod_tpu.core import doctor
+
+                self._send(200,
+                           (json.dumps(doctor.diagnose()) + "\n")
+                           .encode(),
+                           "application/json")
             else:
-                self._send(404, b"not found: try /metrics, /healthz "
-                                b"or /fleet\n",
+                self._send(404, b"not found: try /metrics, /healthz, "
+                                b"/fleet or /doctor\n",
                            "text/plain")
         except Exception as exc:  # serving must never kill the thread
             try:
@@ -117,7 +132,8 @@ def maybe_start(port: int) -> Optional[int]:
                                    name="hvd-telemetry-http", daemon=True)
         _thread.start()
         LOG.info("telemetry endpoint on http://127.0.0.1:%d "
-                 "(/metrics, /healthz, /fleet)", srv.server_address[1])
+                 "(/metrics, /healthz, /fleet, /doctor)",
+                 srv.server_address[1])
         return srv.server_address[1]
 
 

@@ -354,10 +354,18 @@ def _prune_flight_dumps(directory: str, rank: int, keep: int):
 
 def dump_flight_recorder(events: List[dict], reason: str,
                          rank: Optional[int] = None,
-                         path: Optional[str] = None) -> Optional[str]:
+                         path: Optional[str] = None,
+                         kind: Optional[str] = None,
+                         inspect: Optional[List[dict]] = None,
+                         verdict: Optional[dict] = None) -> Optional[str]:
     """Write a post-mortem dump: the flight-recorder events plus a
     telemetry snapshot (counters + the straggler report — the same data
-    ``hvd.telemetry()`` serves). Written atomically (tmp + replace) so a
+    ``hvd.telemetry()`` serves). Hang-class dumps additionally carry the
+    dump ``kind`` ("stall", "deadline", "negotiation", "sigusr1"), the
+    engine's per-entry ``inspect`` table, and — when the hang doctor
+    reached a diagnosis — its attributed ``doctor`` verdict, making each
+    dump a self-contained offline-diagnosable artifact
+    (``stats --doctor <dir>``). Written atomically (tmp + replace) so a
     concurrent reader never sees a torn file. Returns the path, or None
     when writing failed (dumping must never take the caller down)."""
     rank = _process_index() if rank is None else rank
@@ -368,6 +376,12 @@ def dump_flight_recorder(events: List[dict], reason: str,
         "wall_us": int(time.time() * 1e6),
         "events": list(events),
     }
+    if kind is not None:
+        payload["kind"] = str(kind)
+    if inspect is not None:
+        payload["inspect"] = list(inspect)
+    if verdict is not None:
+        payload["doctor"] = verdict
     try:
         from horovod_tpu.core import telemetry as tele
 
@@ -419,7 +433,7 @@ def dump_flight_recorder(events: List[dict], reason: str,
 
 
 _dump_rate_lock = threading.Lock()
-_last_dump_at: dict = {}  # (rank, reason head) -> monotonic seconds
+_last_dump_at: dict = {}  # (rank, kind, reason head) -> monotonic s
 
 
 def _dump_min_interval_s() -> float:
@@ -430,26 +444,32 @@ def _dump_min_interval_s() -> float:
 
 
 def dump_and_warn(events: List[dict], reason: str, rank: Optional[int],
-                  logger) -> Optional[str]:
+                  logger, kind: Optional[str] = None,
+                  inspect: Optional[List[dict]] = None,
+                  verdict: Optional[dict] = None) -> Optional[str]:
     """The engines' shared dump wrapper (their post-mortem semantics
     must stay twins): write the flight dump, warn with the path, never
     raise. Returns the path or None.
 
-    Rate-limited per (rank, reason): a poisoned negotiation re-raises
-    the SAME failure every ~5 ms engine cycle — dumping each one is a
-    200 Hz dump storm that churns the retention cap out from under a
-    concurrent reader. The first dump of each distinct reason always
-    lands; repeats within ``HVD_FLIGHT_MIN_INTERVAL`` seconds (default
-    1.0; 0 disables the limit) are dropped."""
+    Rate-limited per (rank, kind, reason): a poisoned negotiation
+    re-raises the SAME failure every ~5 ms engine cycle — dumping each
+    one is a 200 Hz dump storm that churns the retention cap out from
+    under a concurrent reader. The dump ``kind`` is part of the key so a
+    prior unrelated dump (say a shutdown drain whose reason head
+    collides) can never suppress a hang post-mortem. The first dump of
+    each distinct (kind, reason) always lands; repeats within
+    ``HVD_FLIGHT_MIN_INTERVAL`` seconds (default 1.0; 0 disables the
+    limit) are dropped."""
     try:
         min_s = _dump_min_interval_s()
-        key = (rank, str(reason).splitlines()[0][:80])
+        key = (rank, kind or "", str(reason).splitlines()[0][:80])
         now = time.monotonic()
         with _dump_rate_lock:
             last = _last_dump_at.get(key)
             if last is not None and min_s > 0 and now - last < min_s:
                 return None
-        path = dump_flight_recorder(events, reason, rank=rank)
+        path = dump_flight_recorder(events, reason, rank=rank, kind=kind,
+                                    inspect=inspect, verdict=verdict)
         if path:
             # Stamp only on SUCCESS: a transiently unwritable flight dir
             # must not suppress the retries — "the first dump of each

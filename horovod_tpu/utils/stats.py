@@ -31,7 +31,14 @@ exactly across ranks), deadline/cancel/ring-full counts, and a
 per-rank heatmap with last-beat ages and STALE/DEAD marking. The
 target is the rank-0 HTTP endpoint (``/fleet`` picked automatically),
 a fleet KV directory (``HVD_FLEET_DIR`` — readable with no live
-process), or a saved report JSON; ``--watch N`` redraws."""
+process), or a saved report JSON; ``--watch N`` redraws.
+
+``--doctor <target>`` renders the hang doctor's attributed verdict
+(:mod:`horovod_tpu.core.doctor`): the target is a live rank's HTTP
+endpoint (``/doctor`` picked automatically — triggers an on-demand
+diagnosis), a flight-dump directory (offline diagnosis over the
+embedded inspect tables — works on a dead world), or a saved verdict /
+single dump JSON file."""
 
 from __future__ import annotations
 
@@ -43,6 +50,20 @@ from typing import Dict, List, Tuple
 
 _SAMPLE_RE = re.compile(
     r"^([A-Za-z_:][A-Za-z0-9_:]*)(?:\{([^}]*)\})?\s+(-?[0-9.eE+\-infa]+)$")
+
+# The hang doctor's classification vocabulary as this consumer renders
+# it, in attribution-priority order. Machine-diffed against
+# ``VERDICT_KINDS`` in core/doctor.py by hvdcheck rule ``parity-doctor``
+# — a kind renamed on either side breaks the other's rendering, so the
+# analysis names the skew instead of a dashboard showing "unknown".
+_DOCTOR_KINDS = (
+    "dead_peer",
+    "draining",
+    "missing_submitter",
+    "metadata_mismatch",
+    "slow_executor",
+    "kv_degraded",
+)
 
 
 def parse_prometheus(text: str) -> List[Tuple[str, Dict[str, str], float]]:
@@ -140,6 +161,15 @@ def render_fleet(report: dict) -> str:
         f"epoch={report.get('epoch', 0)} "
         f"generation={report.get('generation', 0)}"
         + (" " + " ".join(marks) if marks else ""))
+    doc = report.get("doctor")
+    if doc and doc.get("kind"):
+        # The hang doctor's blamed-tensor line: verdict kind + the
+        # tensor/ranks it attributed (core/doctor.py, folded through
+        # the fleet snapshots).
+        lines.append(
+            f"doctor: {doc['kind']}"
+            + (f" tensor='{doc['tensor']}'" if doc.get("tensor") else "")
+            + (f" rank(s) {doc['ranks']}" if doc.get("ranks") else ""))
     step = report.get("step") or {}
     strip = sparkline(step.get("sparkline") or [])
     if strip:
@@ -204,6 +234,64 @@ def _fleet_report_for(target: str) -> dict:
         return fleet.report_from_dir(target)
     with open(target) as fh:
         return json.loads(fh.read())
+
+
+def _doctor_verdict_for(target: str) -> dict:
+    """Resolve a ``--doctor`` target into a verdict dict: an ``http://``
+    endpoint (``/doctor`` targeted automatically — triggers an on-demand
+    diagnosis on that rank), a flight-dump directory (offline diagnosis
+    over the embedded inspect tables), a saved verdict JSON, or a single
+    flight-dump file."""
+    from urllib.parse import urlparse
+
+    if _is_http(target):
+        url = target
+        if urlparse(target).path in ("", "/"):
+            url = target.rstrip("/") + "/doctor"
+        return json.loads(fetch_http(url))
+    from horovod_tpu.core import doctor
+
+    if os.path.isdir(target):
+        return doctor.diagnose_dumps(doctor.flight_dump_paths(target))
+    with open(target) as fh:
+        payload = json.loads(fh.read())
+    if "findings" in payload:
+        return payload  # a saved verdict (curl .../doctor body)
+    if isinstance(payload.get("doctor"), dict):
+        return payload["doctor"]  # a dump with an embedded verdict
+    return doctor.diagnose_dumps([target])
+
+
+def render_doctor(verdict: dict) -> str:
+    """Human rendering of a doctor verdict: the attributed headline,
+    then every finding grouped in ``_DOCTOR_KINDS`` priority order (a
+    kind outside the vocabulary renders loudly as ``unknown-kind`` —
+    the parity rule should have caught it first)."""
+    lines: List[str] = []
+    kind = verdict.get("kind")
+    if kind is None:
+        lines.append("doctor: no findings — nothing attributable "
+                     f"(rank(s) reporting: "
+                     f"{verdict.get('ranks_reporting', [])})")
+        return "\n".join(lines)
+    head = f"doctor: verdict={kind}"
+    if verdict.get("tensor"):
+        head += f" tensor='{verdict['tensor']}'"
+    if verdict.get("ranks"):
+        head += f" rank(s) {verdict['ranks']}"
+    lines.append(head)
+    lines.append(f"  reporting: rank(s) "
+                 f"{verdict.get('ranks_reporting', [])} of "
+                 f"{verdict.get('nproc', '?')}")
+    order = {k: i for i, k in enumerate(_DOCTOR_KINDS)}
+    findings = sorted(
+        verdict.get("findings") or [],
+        key=lambda f: order.get(f.get("kind"), len(order)))
+    for f in findings:
+        fk = f.get("kind")
+        label = fk if fk in order else f"unknown-kind({fk})"
+        lines.append(f"  - {label}: {f.get('detail', '')}")
+    return "\n".join(lines)
 
 
 def _is_xplane_dir(target: str) -> bool:
@@ -273,10 +361,17 @@ def xplane_samples(data: dict) -> List[Tuple[str, Dict[str, str], float]]:
 
 
 def _envelope(source: str, target: str,
-              samples: List[Tuple[str, Dict[str, str], float]]) -> dict:
-    return {"source": source, "target": target,
-            "samples": [{"name": n, "labels": l, "value": v}
-                        for n, l, v in samples]}
+              samples: List[Tuple[str, Dict[str, str], float]],
+              doctor: dict = None) -> dict:
+    env = {"source": source, "target": target,
+           "samples": [{"name": n, "labels": l, "value": v}
+                       for n, l, v in samples]}
+    if doctor is not None:
+        # The hang doctor's verdict rides INSIDE the one-envelope shape
+        # (never replaces it): dashboards keyed on {source, target,
+        # samples} keep parsing, doctor-aware ones read env["doctor"].
+        env["doctor"] = doctor
+    return env
 
 
 def main(argv=None):
@@ -300,6 +395,12 @@ def main(argv=None):
                          "http endpoint (/fleet), a fleet KV directory "
                          "(HVD_FLEET_DIR — works with no live "
                          "process), or a saved report JSON file")
+    ap.add_argument("--doctor", action="store_true",
+                    help="render the hang doctor's attributed verdict: "
+                         "target is a live rank's http endpoint "
+                         "(/doctor — on-demand diagnosis), a "
+                         "flight-dump directory (offline, works on a "
+                         "dead world), or a saved verdict/dump JSON")
     ap.add_argument("--watch", type=float, metavar="SECONDS", default=None,
                     help="redraw the report every N seconds (exposition "
                          "file, http target or 'live'); Ctrl-C exits "
@@ -310,6 +411,17 @@ def main(argv=None):
     args = ap.parse_args(argv)
 
     def render_once() -> int:
+        if args.doctor:
+            try:
+                verdict = _doctor_verdict_for(args.target)
+            except Exception as exc:
+                print(f"cannot build doctor view from {args.target}: "
+                      f"{exc}")
+                return 1
+            print(json.dumps(_envelope("doctor", args.target, [],
+                                       doctor=verdict))
+                  if args.json else render_doctor(verdict))
+            return 0
         if args.fleet:
             try:
                 report = _fleet_report_for(args.target)

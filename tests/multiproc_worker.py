@@ -1063,6 +1063,141 @@ def main():
         # member — skip atexit entirely.
         print(f"proc {pid}: SCENARIO {scenario} PASSED", flush=True)
         os._exit(0)
+    elif scenario == "doctor_withheld":
+        # Hang-doctor acceptance (ISSUE 18): process 1's submit of
+        # 'held' is withheld through the faultline; process 0 stalls and
+        # the stall dump engages the doctor, whose verdict must be
+        # missing_submitter naming the EXACT tensor and rank. Process 1
+        # — healthy, and the one being blamed — reaches the identical
+        # verdict through on-demand hvd.diagnose() over the fleet/KV
+        # plane. Both engines via the test parametrization.
+        import time
+
+        from horovod_tpu.core import doctor, engine as eng
+        from horovod_tpu.core import faultline as flt
+        from horovod_tpu.core.engine import EngineError
+
+        e = eng.get_engine()
+        h = e.allreduce_async("warm", np.ones((2,), np.float32), False)
+        np.testing.assert_allclose(
+            e.synchronize(h), np.full((2,), float(local_devices * nproc)))
+
+        verdict = None
+        if pid == 1:
+            # Withhold exactly the next enqueue on THIS rank.
+            flt.configure("engine.submit:fail:1")
+            try:
+                e.allreduce_async("held", np.ones((2,), np.float32),
+                                  False)
+            except EngineError as err:
+                assert "injected fault" in str(err), str(err)
+            else:
+                raise SystemExit("injected submit fault did not fire")
+            flt.reset()
+            # Diagnose on demand until the peer's stall snapshot lands
+            # on the KV plane (its watchdog fires within ~one 1 s stall
+            # interval).
+            deadline = time.monotonic() + 30.0
+            while time.monotonic() < deadline:
+                v = hvd.diagnose()
+                if v.get("kind") == "missing_submitter":
+                    verdict = v
+                    break
+                time.sleep(0.25)
+        else:
+            h = e.allreduce_async("held", np.ones((2,), np.float32),
+                                  False)
+            # The stall watchdog dumps kind="stall" each interval; every
+            # dump re-runs the doctor, so the verdict appears without
+            # this thread doing anything (it is WEDGED in real hangs).
+            deadline = time.monotonic() + 30.0
+            while time.monotonic() < deadline:
+                v = doctor.last_verdict()
+                if v is not None and v.get("kind") == "missing_submitter":
+                    verdict = v
+                    break
+                time.sleep(0.25)
+            assert verdict is not None and verdict["trigger"] == "stall", \
+                verdict
+        assert verdict is not None, doctor.last_verdict()
+        # The acceptance bar: identical attribution on EVERY survivor.
+        assert verdict["tensor"] == "held", verdict
+        assert verdict["ranks"] == [1], verdict
+        assert "never announced 'held'" in verdict["detail"], verdict
+        print(f"proc {pid}: DOCTOR blames rank 1 tensor 'held' "
+              f"(trigger {verdict['trigger']})", flush=True)
+        if pid == 1:
+            # Release the stalled peer: submit 'held' for real.
+            h = e.allreduce_async("held", np.ones((2,), np.float32),
+                                  False)
+        np.testing.assert_allclose(
+            e.synchronize(h), np.full((2,), float(local_devices * nproc)))
+    elif scenario == "doctor_dead_peer":
+        # A SIGKILLed peer must classify as dead_peer (the elastic death
+        # note outranks missing_submitter), and the diagnoser must not
+        # wedge against the corpse. HVD_ELASTIC=1 + a short lease are
+        # set by the test; the survivor's orphaned submit rides the
+        # stall + attributed-negotiation-failure dumps, each of which
+        # re-runs the doctor with the hardened death note.
+        import signal as _signal
+        import time
+
+        from horovod_tpu.core import doctor, engine as eng
+        from horovod_tpu.core.engine import ShutdownError
+
+        e = eng.get_engine()
+        h = e.allreduce_async("warm", np.ones((2,), np.float32), False)
+        np.testing.assert_allclose(
+            e.synchronize(h), np.full((2,), float(local_devices * nproc)))
+        if pid == 1:
+            # Let a few elastic heartbeats land first: the beat loop's
+            # first publish is one interval (lease/4) after hvd.init,
+            # and a victim that never beat is "never heard from" —
+            # covered by the startup GRACE, not the lease, so the death
+            # note would lag by the full grace window.
+            time.sleep(1.5)
+            os.kill(os.getpid(), _signal.SIGKILL)
+        # Survivor: wait out the victim's lease so the orphaned submit
+        # negotiates against a peer the elastic plane has already
+        # declared dead (the liveness probe fails the round with the
+        # attribution, and the doctor sees both the death note and the
+        # still-pending victim on the negotiation-failure dump).
+        time.sleep(2.5)
+        h = e.allreduce_async("orphan", np.ones((2,), np.float32), False)
+        try:
+            e.synchronize(h)
+        except ShutdownError:
+            raise SystemExit("SIGKILL must not look like a clean shutdown")
+        except Exception as err:
+            print(f"proc {pid}: orphan failed as expected: {err}",
+                  flush=True)
+        else:
+            raise SystemExit("dead peer did not surface")
+        verdict = None
+        deadline = time.monotonic() + 30.0
+        while time.monotonic() < deadline:
+            v = doctor.last_verdict()
+            if v is not None and v.get("kind") == "dead_peer" \
+                    and v.get("ranks") == [1]:
+                verdict = v
+                break
+            time.sleep(0.25)
+        assert verdict is not None, doctor.last_verdict()
+        assert verdict["tensor"] == "orphan", verdict
+        assert "dead" in verdict["detail"], verdict
+        # The diagnoser itself must stay prompt with a corpse in the
+        # world: on-demand diagnosis returns, it does not wedge.
+        t0 = time.monotonic()
+        hvd.diagnose()
+        took = time.monotonic() - t0
+        assert took < 10.0, f"diagnoser wedged for {took:.1f}s"
+        print(f"proc {pid}: DOCTOR verdict dead_peer names rank 1",
+              flush=True)
+        # Same exit discipline as engine_peer_sigkill: the JAX
+        # coordination shutdown barrier can never pass with a SIGKILLed
+        # member — skip interpreter teardown after the PASS line.
+        print(f"proc {pid}: SCENARIO {scenario} PASSED", flush=True)
+        os._exit(0)
     elif scenario == "mismatch":
         os.environ["HVD_CONSISTENCY_CHECKS"] = "1"
         from horovod_tpu.common.topology import HorovodInternalError

@@ -59,7 +59,7 @@ def test_every_rule_is_cataloged_and_documented():
 # ---------------------------------------------------------------------------
 
 _CORE_FILES = ("engine.py", "native_engine.py", "bufferpool.py",
-               "timeline.py", "telemetry.py")
+               "timeline.py", "telemetry.py", "doctor.py")
 
 
 def _mini_root(tmp_path):
@@ -67,12 +67,16 @@ def _mini_root(tmp_path):
     be seeded without touching the live tree."""
     core = tmp_path / "horovod_tpu" / "core"
     native = core / "native"
+    utils = tmp_path / "horovod_tpu" / "utils"
     native.mkdir(parents=True)
+    utils.mkdir()
     for f in _CORE_FILES:
         shutil.copy(os.path.join(REPO, "horovod_tpu", "core", f), core)
     for f in ("hvdcore.cc", "__init__.py"):
         shutil.copy(os.path.join(REPO, "horovod_tpu", "core", "native", f),
                     native)
+    shutil.copy(os.path.join(REPO, "horovod_tpu", "utils", "stats.py"),
+                utils)
     shutil.copy(os.path.join(REPO, "bench.py"), tmp_path)
     shutil.copy(os.path.join(REPO, "horovod_tpu", "run.py"),
                 tmp_path / "horovod_tpu")
@@ -251,6 +255,41 @@ def test_parity_catches_renamed_latency_struct_field(tmp_path):
     assert any(f.rule == "parity-latency" and "phase_exec" in f.message
                for f in findings), findings
     assert any(f.rule == "abi-struct" for f in abi.check(root))
+
+
+def test_parity_doctor_catches_skewed_cxx_inspect_key(tmp_path):
+    """The issue's canonical seed: one C++ inspect-record JSON key
+    renamed — the doctor's cross-rank/cross-engine record diff would
+    silently lose that field's attribution."""
+    root = _mini_root(tmp_path)
+    _edit(root, _CC, '\\"phase_age_us\\":', '\\"phaseage_us\\":')
+    findings = parity.check(root)
+    assert any(f.rule == "parity-doctor" and "phaseage_us" in f.message
+               for f in findings), findings
+
+
+def test_parity_doctor_catches_renamed_verdict_kind(tmp_path):
+    """A verdict kind renamed in the classifier without the stats-CLI
+    consumer table following — every console would render it as
+    unknown-kind."""
+    root = _mini_root(tmp_path)
+    _edit(root, os.path.join("horovod_tpu", "core", "doctor.py"),
+          '"missing_submitter"', '"missing_sub"')
+    findings = parity.check(root)
+    assert any(f.rule == "parity-doctor" and "missing_sub" in f.message
+               for f in findings), findings
+
+
+def test_parity_doctor_catches_python_record_skew(tmp_path):
+    """The python twin's record builder drifting from the declared
+    contract is caught from the engine.py side alone."""
+    root = _mini_root(tmp_path)
+    _edit(root, os.path.join("horovod_tpu", "core", "engine.py"),
+          "phase_age_us=int((now - e.phase_since) * 1e6),",
+          "phase_age=int((now - e.phase_since) * 1e6),")
+    findings = parity.check(root)
+    assert any(f.rule == "parity-doctor" and "ENGINE_INSPECT_KEYS"
+               in f.message for f in findings), findings
 
 
 def test_parity_catches_renamed_latency_instrument(tmp_path):

@@ -368,8 +368,8 @@ def test_quiesce_deadline_reports_wedged_work(impl, mk):
     """Work wedged behind a dead peer cannot be drained — the report
     NAMES it instead of hanging (both engines: the report shape — name
     lists, not counts — is part of the same-observable-semantics
-    contract; the native binding reads the names off the C++ table via
-    hvd_engine_pending_names)."""
+    contract; the native binding projects the names off the inspect
+    table, ``hvd_engine_inspect``)."""
     from horovod_tpu.core import sentinel
 
     ex = GatedExecutor()

@@ -325,6 +325,61 @@ def test_two_process_fleet_rollup_and_sigkill_stale(engine, tmp_path):
     assert any("STALE after lease" in out for out in outs)
 
 
+@pytest.mark.parametrize("engine", ENGINES)
+def test_two_process_doctor_blames_withheld_submit(engine, tmp_path):
+    """Hang-doctor acceptance (ISSUE 18), BOTH engines: process 1's
+    submit of 'held' is withheld through the faultline; the stalled
+    process's verdict (riding its stall dump, within one stall-warning
+    interval) and the blamed process's on-demand ``hvd.diagnose()`` must
+    BOTH be ``missing_submitter`` naming the identical tensor and
+    missing rank (assertions live in multiproc_worker.py)."""
+    fdir = tmp_path / "fleet"
+    fdir.mkdir()
+    flight = tmp_path / "flight"
+    flight.mkdir()
+    outs = _run_world(
+        "doctor_withheld",
+        extra_env={"HVD_ENGINE": engine,
+                   "HVD_STALL_CHECK_TIME": "1",
+                   "HVD_FLEET_DIR": str(fdir),
+                   # Only explicit doctor publishes matter here; keep
+                   # the latency publisher quiet.
+                   "HVD_FLEET_INTERVAL_S": "60",
+                   "HVD_FLIGHT_DIR": str(flight),
+                   "HVD_FLIGHT_MIN_INTERVAL": "0"})
+    assert sum("DOCTOR blames rank 1 tensor 'held'" in out
+               for out in outs) == 2, outs[0][-3000:]
+
+
+@pytest.mark.parametrize("engine", ENGINES)
+def test_two_process_doctor_dead_peer_verdict(engine, tmp_path):
+    """A SIGKILLed peer classifies as ``dead_peer`` (the elastic death
+    note outranks missing_submitter) and the diagnoser stays prompt with
+    a corpse in the world — BOTH engines (ISSUE 18 satellite)."""
+    fdir = tmp_path / "fleet"
+    fdir.mkdir()
+    flight = tmp_path / "flight"
+    flight.mkdir()
+    edir = tmp_path / "elastic"
+    edir.mkdir()
+    outs = _run_world(
+        "doctor_dead_peer",
+        extra_env={"HVD_ENGINE": engine,
+                   "HVD_STALL_CHECK_TIME": "1",
+                   "HVD_NEGOTIATION_TIMEOUT": "6",
+                   "HVD_ELASTIC": "1",
+                   "HVD_ELASTIC_LEASE_S": "2",
+                   "HVD_ELASTIC_GRACE_S": "120",
+                   "HVD_ELASTIC_DIR": str(edir),
+                   "HVD_FLEET_DIR": str(fdir),
+                   "HVD_FLEET_INTERVAL_S": "60",
+                   "HVD_FLIGHT_DIR": str(flight),
+                   "HVD_FLIGHT_MIN_INTERVAL": "0"},
+        expect_dead=(1,), timeout=300)
+    assert any("DOCTOR verdict dead_peer names rank 1" in out
+               for out in outs), outs[0][-3000:]
+
+
 # ---------------------------------------------------------------------------
 # np=4 tier (VERDICT r2 item 5): negotiation with 3+ peers, failure
 # injection, parameter propagation, and a >2-process two-tier mesh.
