@@ -218,9 +218,11 @@ def run_engine(args, tl_path):
     kind = type(e).__name__
     lat_before = _tele.REGISTRY.histogram_counts()
     policy = args.compression or "none"
+    policy_dcn = args.compression_dcn or "none"
     print(f"# engine path ({kind}), fusion_threshold="
           f"{e.fusion_threshold}, tensors/iter={args.tensors}, "
-          f"compression={policy}, donate={args.donate}, "
+          f"compression={policy}, compression_dcn={policy_dcn}, "
+          f"donate={args.donate}, "
           f"HVD_POOL_MAX_BYTES="
           f"{_os.environ.get('HVD_POOL_MAX_BYTES', 'default')}")
     print(f"# {'size/tensor':>12s} {'total':>10s} {'time':>10s} "
@@ -279,22 +281,39 @@ def run_engine(args, tl_path):
 
         wire = {"submitted": _delta("engine.submitted.bytes"),
                 "wire": _delta("engine.wire_bytes"),
-                "compressed": _delta("engine.wire_bytes.compressed")}
+                "compressed": _delta("engine.wire_bytes.compressed"),
+                "dcn": _delta("engine.wire_bytes.dcn"),
+                "ici": _delta("engine.wire_bytes.ici")}
         if policy != "none":
             wire["payload"], wire["scales"] = _wire_split(
                 wire["compressed"], policy)
+        elif policy_dcn != "none" and wire["dcn"]:
+            # Two-phase route: the compressed counter IS the DCN tier.
+            wire["payload"], wire["scales"] = _wire_split(
+                wire["dcn"], policy_dcn)
         if wire["wire"]:
             wire["ratio"] = round(wire["submitted"] / wire["wire"], 3)
         row["wire_bytes"] = wire
         if args.decompose and wire["wire"]:
+            pol = policy if policy != "none" else policy_dcn
             parts = (f"payload={wire['payload']/1e6:.2f}MB "
                      f"scales={wire['scales']/1e6:.3f}MB "
-                     if policy != "none" else "")
-            print(f"#   bytes on the wire ({policy}): "
+                     if "payload" in wire else "")
+            print(f"#   bytes on the wire ({pol}): "
                   f"submitted={wire['submitted']/1e6:.2f}MB "
                   f"shipped={wire['wire']/1e6:.2f}MB {parts}"
                   f"-> {wire.get('ratio', 1.0):.2f}x fewer; "
                   f"digest={digest[:16]}")
+            if wire["dcn"] or wire["ici"]:
+                # Per-tier split of the hierarchical two-phase route:
+                # ICI ships full-width 1/L chunks, DCN only the
+                # quantized 1/L shard (+scales) — the cross-tier ratio
+                # is the number that scales with host count.
+                dcn_ratio = (wire["submitted"] / wire["dcn"]
+                             if wire["dcn"] else float("inf"))
+                print(f"#   per tier: ici={wire['ici']/1e6:.2f}MB "
+                      f"dcn={wire['dcn']/1e6:.3f}MB "
+                      f"-> {dcn_ratio:.1f}x fewer bytes cross-tier")
         if tl_path:
             from horovod_tpu.core import engine as _e
 
@@ -306,6 +325,7 @@ def run_engine(args, tl_path):
         rows.append(row)
     return {"mode": "engine", "engine": kind, "tensors": args.tensors,
             "iters": args.iters, "compression": policy,
+            "compression_dcn": policy_dcn,
             "donate": args.donate,
             "pool_max_bytes": _os.environ.get("HVD_POOL_MAX_BYTES",
                                               "default"),
@@ -463,6 +483,16 @@ def main():
                          "HOROVOD_HIERARCHICAL_ALLREDUCE). Needs a "
                          "two-tier world: multi-process, or "
                          "HVD_TWO_TIER_SHAPE=o,i to split one host.")
+    ap.add_argument("--compression-dcn", default=None,
+                    choices=["none", "int8", "fp8"],
+                    help="per-TIER engine wire policy: quantize ONLY the "
+                         "cross-tier (DCN) phase of the hierarchical "
+                         "two-phase route — ICI reduces at full width "
+                         "(sets HVD_COMPRESSION_DCN; implies "
+                         "--hierarchical; needs a two-tier world). With "
+                         "--decompose the per-size output gains the "
+                         "per-tier byte split from the "
+                         "engine.wire_bytes.dcn/.ici counters")
     ap.add_argument("--json", action="store_true",
                     help="additionally print ONE machine-readable JSON "
                          "line with the sweep results (and, with "
@@ -487,6 +517,9 @@ def main():
                               str(max(2 * args.tensors, 1024)))
     else:
         args.tensors = args.tensors or 1
+    if args.compression_dcn and args.compression_dcn != "none":
+        args.hierarchical = True
+        os.environ["HVD_COMPRESSION_DCN"] = args.compression_dcn
     if args.hierarchical:
         os.environ["HVD_HIERARCHICAL_ALLREDUCE"] = "1"
     if args.compression and args.compression != "none":

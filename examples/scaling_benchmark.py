@@ -63,6 +63,16 @@ def main():
     ap.add_argument("--batch-size", type=int, default=32)
     ap.add_argument("--image-size", type=int, default=224)
     ap.add_argument("--steps", type=int, default=10)
+    ap.add_argument("--hierarchical-sweep", action="store_true",
+                    help="instead of the chip-count sweep: on the full "
+                         "world, trend flat vs hierarchical vs "
+                         "hierarchical+int8-DCN allreduce per size — the "
+                         "two-tier route's cross-tier byte win, measured "
+                         "(simulates a multi-host mesh via "
+                         "--two-tier-shape on one host)")
+    ap.add_argument("--two-tier-shape", default=None,
+                    help="o,i (dcn,ici) split for --hierarchical-sweep "
+                         "(default: 2,<world/2> — two simulated hosts)")
     args = ap.parse_args()
 
     import jax
@@ -73,6 +83,8 @@ def main():
     hvd.init()
     world = hvd.size()
     hvd.shutdown()
+    if args.hierarchical_sweep:
+        return _hier_sweep(args, world)
     chips = args.chips or [n for n in (2 ** i for i in range(20))
                            if n <= world]
     skipped = [n for n in chips if n > world]
@@ -127,6 +139,73 @@ def main():
             row.append(f"{100 * eff:5.1f}%")
         print(" | ".join(row), flush=True)
         hvd.shutdown()
+
+
+def _hier_sweep(args, world):
+    """Flat vs hierarchical vs hierarchical+int8-DCN allreduce on the
+    full world: the two-tier composition's trend line. On one host the
+    (dcn, ici) split is SIMULATED (HVD_TWO_TIER_SHAPE), so the timing
+    columns share one interconnect — the structural number to watch is
+    the cross-tier byte column: int8-DCN ships bytes/(L*~4) across the
+    slow tier, the term that dominates once 'dcn' is a real network."""
+    import os
+
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    import horovod_tpu as hvd
+    from horovod_tpu.ops.collectives import ranked_allreduce
+
+    if world < 4:
+        raise SystemExit(f"--hierarchical-sweep needs >=4 chips to "
+                         f"split into two tiers; world has {world}")
+    shape = args.two_tier_shape or f"2,{world // 2}"
+    outer, inner = (int(v) for v in shape.split(","))
+    modes = (("flat", {}, "none"),
+             ("hier", {"HVD_TWO_TIER_SHAPE": shape,
+                       "HVD_HIERARCHICAL_ALLREDUCE": "1"}, "none"),
+             ("hier+int8dcn", {"HVD_TWO_TIER_SHAPE": shape,
+                               "HVD_HIERARCHICAL_ALLREDUCE": "1"}, "int8"))
+    print(f"# world: {world} chip(s); two-tier shape dcn={outer} x "
+          f"ici={inner} (simulated on one host)")
+    print(f"# {'size':>8s} | " + " | ".join(f"{m:>14s} ms" for m, _, _
+                                            in modes)
+          + " | cross-tier bytes flat vs int8-dcn")
+    for size_mb in args.sizes_mb:
+        elems = int(size_mb * 1024 * 1024 / 4)
+        times = []
+        for _, env, dcn_wire in modes:
+            for k, v in env.items():
+                os.environ[k] = v
+            hvd.init()
+            try:
+                x = jax.device_put(
+                    jnp.ones((world, elems), jnp.float32),
+                    NamedSharding(hvd.mesh(), PartitionSpec("hvd")))
+                fn = lambda: ranked_allreduce(x, dcn_wire=dcn_wire)  # noqa: E731
+                times.append(_timeit(
+                    fn, lambda o: float(np.asarray(o[0]))))
+            finally:
+                hvd.shutdown()
+                for k in env:
+                    os.environ.pop(k, None)
+        # Cross-tier byte model (per chip, one allreduce): flat ships
+        # the full ring volume across every hop; the two-phase route
+        # ships only the quantized 1/L shard (+ f32 scales per 512
+        # block) across the slow tier.
+        from horovod_tpu.jax import quantize as Q
+        from horovod_tpu.jax.compression import Compression
+
+        pol = Compression.int8
+        flat_bytes = elems * 4
+        n_ici = Q.padded_len(elems, inner) // inner
+        npad = Q.padded_len(n_ici, outer * pol.block)
+        dcn_bytes = npad + (npad // pol.block) * 4  # i8 payload + scales
+        print(f"# {size_mb:6.1f}MB | "
+              + " | ".join(f"{t * 1e3:14.3f}   " for t in times)
+              + f" | {flat_bytes / 1e6:.2f}MB vs {dcn_bytes / 1e6:.3f}MB "
+                f"({flat_bytes / dcn_bytes:.1f}x fewer)", flush=True)
 
 
 def _train_throughput(args, n):

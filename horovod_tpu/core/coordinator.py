@@ -383,12 +383,19 @@ class RequestMeta:
     # fail fast BY NAME at negotiation (the HVD_CACHE_CAPACITY
     # precedent: misconfiguration surfaces on the first round).
     compression: str = "none"
+    # Per-tier DCN wire policy of the hierarchical two-phase route
+    # (mutually exclusive with `compression` — core/engine.py
+    # check_wire_exclusive). Same cross-process fingerprint rule: a
+    # world where processes disagree on which tier quantizes would
+    # exchange mismatched payloads, so mixed per-tier policies fail
+    # fast BY NAME at negotiation.
+    compression_dcn: str = "none"
 
     def wire(self) -> list:
         return [self.name, self.op, self.dtype, self.itemsize,
                 list(self.shape), int(self.average), self.root_rank,
                 self.prescale, round(self.age_s, 3), self.nbytes,
-                self.compression]
+                self.compression, self.compression_dcn]
 
     @staticmethod
     def from_wire(w: list) -> "RequestMeta":
@@ -396,7 +403,9 @@ class RequestMeta:
                            shape=tuple(w[4]), average=bool(w[5]),
                            root_rank=w[6], prescale=w[7], age_s=w[8],
                            nbytes=w[9],
-                           compression=w[10] if len(w) > 10 else "none")
+                           compression=w[10] if len(w) > 10 else "none",
+                           compression_dcn=(w[11] if len(w) > 11
+                                            else "none"))
 
 
 @dataclass
@@ -458,7 +467,8 @@ class ResponseCache:
         first dim must renegotiate; everything except the submit-time
         ``age_s`` counts)."""
         return (m.op, m.dtype, m.itemsize, tuple(m.shape), m.average,
-                m.root_rank, m.prescale, m.nbytes, m.compression)
+                m.root_rank, m.prescale, m.nbytes, m.compression,
+                m.compression_dcn)
 
     def lookup(self, m: RequestMeta) -> Optional[int]:
         """Bit of a cached identical request, or None (a changed shape/
@@ -478,11 +488,12 @@ class ResponseCache:
             return None
         ident = self._slots[name][1]
         (op, dtype, itemsize, shape, average, root, prescale, nbytes,
-         compression) = ident
+         compression, compression_dcn) = ident
         return RequestMeta(name=name, op=op, dtype=dtype,
                            itemsize=itemsize, shape=shape, average=average,
                            root_rank=root, prescale=prescale,
-                           nbytes=nbytes, compression=compression)
+                           nbytes=nbytes, compression=compression,
+                           compression_dcn=compression_dcn)
 
     def wire_len(self, bit: int) -> int:
         name = self._names.get(bit)
@@ -589,7 +600,7 @@ def _fingerprint(m: RequestMeta):
     shape = m.shape[1:] if m.op == "allgather" else m.shape
     dim0 = ("*",) if m.op == "allgather" else ()
     return (m.op, m.dtype, m.itemsize, dim0 + tuple(shape), m.average,
-            m.root_rank, m.prescale, m.compression)
+            m.root_rank, m.prescale, m.compression, m.compression_dcn)
 
 
 def _mismatch_message(name: str, metas: Dict[int, RequestMeta]) -> str:
@@ -615,6 +626,14 @@ def _mismatch_message(name: str, metas: Dict[int, RequestMeta]) -> str:
                              "HVD_COMPRESSION / the Compression policy "
                              "identically on every process)",
                              a.compression, b.compression)
+        elif a.compression_dcn != b.compression_dcn:
+            # Mixed per-tier policies: one side would quantize the
+            # cross-tier shard, the other would not — same fail-fast
+            # contract as the uniform wire policy above.
+            field, va, vb = ("DCN-tier wire policies (set "
+                             "HVD_COMPRESSION_DCN / compression_dcn "
+                             "identically on every process)",
+                             a.compression_dcn, b.compression_dcn)
         elif a.average != b.average or a.prescale != b.prescale:
             field, va, vb = ("reduction options",
                              (a.average, a.prescale), (b.average, b.prescale))
@@ -640,7 +659,8 @@ def _fuse_names(ready: Sequence[RequestMeta],
         if m.op != "allreduce" or fusion_threshold <= 0:
             name_groups.append([m.name])
             continue
-        key = (m.dtype, m.average, m.prescale, m.compression)
+        key = (m.dtype, m.average, m.prescale, m.compression,
+               m.compression_dcn)
         g = open_groups.get(key)
         if g is not None and open_bytes[key] + m.nbytes <= fusion_threshold:
             g.append(m.name)

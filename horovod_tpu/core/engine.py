@@ -105,6 +105,32 @@ def wire_policy_from_env() -> str:
                                or os.environ.get("HOROVOD_COMPRESSION"))
 
 
+def wire_dcn_policy_from_env() -> str:
+    """HVD_COMPRESSION_DCN: the engine-wide default DCN-tier wire format
+    for the hierarchical two-phase route (per-request
+    ``compression_dcn`` overrides it). Inert unless the world has
+    two-tier structure AND HVD_HIERARCHICAL_ALLREDUCE is on — a flat
+    world never quantizes through it."""
+    return resolve_wire_policy(os.environ.get("HVD_COMPRESSION_DCN")
+                               or os.environ.get("HOROVOD_COMPRESSION_DCN"))
+
+
+def check_wire_exclusive(wire: str, wire_dcn: str, name: str):
+    """A request's uniform wire policy and its per-tier DCN policy are
+    mutually exclusive: `wire` quantizes the WHOLE exchange (the flat
+    PR-12 route), `wire_dcn` quantizes only the 1/L cross-tier shard of
+    the hierarchical route — asking for both is ambiguous about which
+    pipeline runs, so the submit fails fast (shared by both engines)."""
+    if wire not in ("", "none") and wire_dcn not in ("", "none"):
+        raise EngineError(
+            f"request '{name}' on {_process_str()} sets both the uniform "
+            f"wire policy ({wire!r}) and the per-tier DCN policy "
+            f"({wire_dcn!r}): they are mutually exclusive — the uniform "
+            "policy quantizes the whole exchange, the DCN policy "
+            "quantizes only the 1/L cross-tier shard of the "
+            "hierarchical route. Pick one.")
+
+
 def _poison_result(fault, out: np.ndarray, private: bool = False) -> np.ndarray:
     """engine.exec 'poison' fault: NaN-fill a float result AFTER the real
     collective ran — the reduced value every rank hands back is poisoned,
@@ -205,6 +231,10 @@ class _Entry:
     root_rank: int = 0
     prescale: float = 1.0
     compression: str = "none"  # engine wire policy for this request
+    # Per-tier DCN wire policy (hierarchical two-phase route): quantizes
+    # ONLY the 1/L cross-tier shard; mutually exclusive with
+    # `compression` (check_wire_exclusive at submit).
+    compression_dcn: str = "none"
     # Ownership-handoff submit (allreduce_async(..., donate=True)): the
     # entry references the caller's buffer in place — no snapshot copy
     # was taken, and the engine only ever READS it (results land in
@@ -257,11 +287,12 @@ class SubmitRequest:
     module."""
 
     __slots__ = ("name", "tensor", "average", "root_rank", "prescale",
-                 "compression", "donate", "deadline_ms")
+                 "compression", "compression_dcn", "donate", "deadline_ms")
 
     def __init__(self, name: str, tensor, *, average: bool = False,
                  root_rank: int = 0, prescale: float = 1.0,
-                 compression: Optional[str] = None, donate: bool = False,
+                 compression: Optional[str] = None,
+                 compression_dcn: Optional[str] = None, donate: bool = False,
                  deadline_ms: Optional[float] = None):
         self.name = name
         self.tensor = tensor
@@ -269,6 +300,7 @@ class SubmitRequest:
         self.root_rank = root_rank
         self.prescale = prescale
         self.compression = compression
+        self.compression_dcn = compression_dcn
         self.donate = donate
         self.deadline_ms = deadline_ms
 
@@ -302,6 +334,14 @@ class JaxExecutor:
     wire_policy = "none"
     last_wire_bytes = 0
     last_wire_compressed = 0
+    # Per-tier DCN wire policy of the current call (the hierarchical
+    # two-phase route: ICI reduce-scatter at the resident dtype, ONLY
+    # the 1/L shard crosses the DCN tier quantized) and the per-tier
+    # byte split of the last call. Both stay 0 on every non-hierarchical
+    # route — the engines feed them into engine.wire_bytes.dcn/.ici.
+    wire_policy_dcn = "none"
+    last_wire_bytes_dcn = 0
+    last_wire_bytes_ici = 0
 
     @staticmethod
     def _ctx(arr: np.ndarray):
@@ -403,11 +443,60 @@ class JaxExecutor:
 
         return Compression.resolve(self.wire_policy, where="engine wire")
 
+    def _dcn_quantizer(self, flat: np.ndarray):
+        """The quantized DCN-tier policy for this call, or None. Gated
+        exactly like the compiled hierarchical route: float payload, a
+        multi-chip world with two-tier structure, the hierarchical knob
+        on, AND a cross tier of more than one group — a single-tier
+        outer axis elides the quantization (no wire hop to shrink), so
+        the digest stays on the unquantized path on both planes."""
+        if (self.wire_policy_dcn in ("", "none")
+                or flat.dtype.kind not in "f"):
+            return None
+        try:
+            from horovod_tpu.common import topology as _topo
+            from horovod_tpu.ops import collectives as C
+
+            st = _topo._require_init()
+            if (st.size <= 1 or st.two_tier is None
+                    or not C.hierarchical_allreduce_enabled()):
+                return None
+            # Tier dims come from the two-tier MESH, not the host
+            # split: a simulated topology (HVD_TWO_TIER_SHAPE) has
+            # several mesh groups inside one host/process.
+            if dict(st.two_tier.shape).get("dcn", 1) <= 1:
+                return None
+        except Exception:
+            return None
+        from horovod_tpu.jax.compression import Compression
+
+        return Compression.resolve(self.wire_policy_dcn,
+                                   where="engine dcn wire")
+
+    @staticmethod
+    def _two_tier_chunk_bytes(n: int, dpol) -> int:
+        """DCN-tier bytes one execution chunk of ``n`` elements ships on
+        the hierarchical route: the 1/L ICI-reduced shard, block-padded
+        and quantized (payload + f32 scales) — mirroring
+        spmd_allreduce's padding (outer_size * block) so the counter is
+        the TRUE cross-tier payload, not an estimate."""
+        from horovod_tpu.common import topology as _topo
+        from horovod_tpu.jax import quantize as Q
+
+        shape = dict(_topo._require_init().two_tier.shape)
+        local = shape["ici"]
+        cross = shape["dcn"]
+        n_ici = Q.padded_len(max(n, 1), local) // local
+        npad = Q.padded_len(n_ici, cross * dpol.block)
+        wire_itemsize = np.dtype(Q.np_wire_dtype(dpol)).itemsize
+        return npad * wire_itemsize + (npad // dpol.block) * 4
+
     def allreduce(self, flat: np.ndarray, average: bool) -> np.ndarray:
         from horovod_tpu.ops import collectives as C
 
         fault = flt.engine_exec("allreduce")  # stall sleeps, error raises
         pol = self._wire_quantizer(flat)
+        dpol = self._dcn_quantizer(flat) if pol is None else None
         n = flat.shape[0]
         # Pool-checked-out result buffer: private by construction (nothing
         # else holds a view), handed to callers as slices and recycled by
@@ -415,6 +504,8 @@ class JaxExecutor:
         out = self._checkout(n, flat.dtype)
         stage_s = 0.0
         wire = 0
+        wire_dcn = 0
+        wire_ici = 0
         with self._ctx(flat):
             off = 0
             while off < n:
@@ -435,6 +526,22 @@ class JaxExecutor:
                     res, chunk_wire = self._quantized_chunk(chunk, pol,
                                                             average)
                     wire += chunk_wire
+                elif dpol is not None:
+                    # Hierarchical two-phase route: the eager ranked
+                    # program reduce-scatters over ICI at the resident
+                    # dtype and ships ONLY the quantized 1/L shard
+                    # across the DCN tier — both engines execute it
+                    # through this shared call, so their digests are
+                    # bit-identical by construction.
+                    res = np.asarray(
+                        C.allreduce(self._stage(chunk), average=average,
+                                    dcn_wire=self.wire_policy_dcn))
+                    ici_b = chunk.nbytes
+                    dcn_b = self._two_tier_chunk_bytes(chunk.shape[0],
+                                                       dpol)
+                    wire += ici_b + dcn_b
+                    wire_ici += ici_b
+                    wire_dcn += dcn_b
                 else:
                     res = np.asarray(
                         C.allreduce(self._stage(chunk), average=average))
@@ -444,7 +551,9 @@ class JaxExecutor:
                 off += take
         self.last_stage_s = stage_s
         self.last_wire_bytes = wire
-        self.last_wire_compressed = wire if pol is not None else 0
+        self.last_wire_compressed = (wire if pol is not None else wire_dcn)
+        self.last_wire_bytes_dcn = wire_dcn
+        self.last_wire_bytes_ici = wire_ici
         return _poison_result(fault, out, private=True)
 
     def allgather(self, tensor: np.ndarray) -> np.ndarray:
@@ -453,6 +562,8 @@ class JaxExecutor:
         fault = flt.engine_exec("allgather")
         self.last_wire_bytes = tensor.nbytes
         self.last_wire_compressed = 0
+        self.last_wire_bytes_dcn = 0
+        self.last_wire_bytes_ici = 0
         with self._ctx(tensor):
             return _poison_result(
                 fault, np.asarray(C.allgather(self._stage(tensor))))
@@ -463,6 +574,8 @@ class JaxExecutor:
         fault = flt.engine_exec("broadcast")
         self.last_wire_bytes = tensor.nbytes
         self.last_wire_compressed = 0
+        self.last_wire_bytes_dcn = 0
+        self.last_wire_bytes_ici = 0
         with self._ctx(tensor):
             return _poison_result(
                 fault,
@@ -614,6 +727,17 @@ def record_wire(executor):
         tele.REGISTRY.counter("engine.wire_bytes").inc(wire)
     if comp:
         tele.REGISTRY.counter("engine.wire_bytes.compressed").inc(comp)
+    # Per-tier split of the hierarchical two-phase route (zero on every
+    # flat route): engine.wire_bytes.dcn is the quantized 1/L cross-tier
+    # payload, engine.wire_bytes.ici the full-width intra-tier share.
+    # The native engine feeds the SAME counters through its stats C API
+    # (hvd_result.wire_dcn/wire_ici -> hvd_engine_stats).
+    dcn = int(getattr(executor, "last_wire_bytes_dcn", 0))
+    ici = int(getattr(executor, "last_wire_bytes_ici", 0))
+    if dcn:
+        tele.REGISTRY.counter("engine.wire_bytes.dcn").inc(dcn)
+    if ici:
+        tele.REGISTRY.counter("engine.wire_bytes.ici").inc(ici)
 
 
 def record_cycle(elapsed_s: float):
@@ -732,6 +856,11 @@ class Engine:
         # Engine-wide default wire format (HVD_COMPRESSION); per-request
         # policies override it at submit. Fails fast on misspellings.
         self.wire_default = wire_policy_from_env()
+        # Per-tier DCN default (HVD_COMPRESSION_DCN) for the
+        # hierarchical two-phase route; inert without two-tier
+        # structure. Mutually exclusive with a uniform wire policy on
+        # any one request (check_wire_exclusive).
+        self.wire_dcn_default = wire_dcn_policy_from_env()
         # Deadline/cancel/drain plane: the engine-wide default deadline
         # (HVD_COLLECTIVE_DEADLINE_S), the count of in-flight entries
         # carrying a deadline (the sweep's zero-cost short circuit), and
@@ -883,17 +1012,25 @@ class Engine:
     def allreduce_async(self, name: str, tensor: np.ndarray, average: bool,
                         prescale: float = 1.0,
                         compression: Optional[str] = None,
+                        compression_dcn: Optional[str] = None,
                         donate: bool = False,
                         deadline_ms: Optional[float] = None) -> int:
         # `compression` is the per-request engine wire policy (frontend
         # Compression objects carry it as .engine_wire); None defers to
-        # the HVD_COMPRESSION default.
+        # the HVD_COMPRESSION default. `compression_dcn` is the per-TIER
+        # policy of the hierarchical route (HVD_COMPRESSION_DCN default)
+        # — mutually exclusive with a uniform wire policy.
         wire = (resolve_wire_policy(compression)
                 if compression is not None else self.wire_default)
+        wire_dcn = (resolve_wire_policy(compression_dcn)
+                    if compression_dcn is not None
+                    else self.wire_dcn_default)
+        check_wire_exclusive(wire, wire_dcn, name)
         snap, donated, flipped, span = self._snapshot(tensor, donate)
         return self._submit(
             _Entry(-1, name, "allreduce", snap, average=average,
-                   prescale=prescale, compression=wire, donated=donated,
+                   prescale=prescale, compression=wire,
+                   compression_dcn=wire_dcn, donated=donated,
                    deadline=self._abs_deadline(deadline_ms)),
             span, flipped)
 
@@ -953,10 +1090,27 @@ class Engine:
         injected = flt.engine_submit(reqs[0].name)
         if injected is not None:
             raise EngineError(injected)
+        # Wire-policy validation BEFORE any buffer is frozen or
+        # snapshotted: a bad spelling (or a uniform+per-tier conflict)
+        # must reject the batch while the engine still owns nothing —
+        # donated buffers frozen mid-loop would otherwise stay
+        # read-only after the raise.
+        wires: List[tuple] = []
+        for r in reqs:
+            wire = ("none" if op != "allreduce"
+                    else (resolve_wire_policy(r.compression)
+                          if r.compression is not None
+                          else self.wire_default))
+            wire_dcn = ("none" if op != "allreduce"
+                        else (resolve_wire_policy(r.compression_dcn)
+                              if r.compression_dcn is not None
+                              else self.wire_dcn_default))
+            check_wire_exclusive(wire, wire_dcn, r.name)
+            wires.append((wire, wire_dcn))
         entries: List[_Entry] = []
         spans = []
         flipped: List[np.ndarray] = []
-        for r in reqs:
+        for r, (wire, wire_dcn) in zip(reqs, wires):
             t0 = self.timeline.now_us()
             a = np.asarray(r.tensor)
             if r.donate and a.flags["C_CONTIGUOUS"]:
@@ -970,14 +1124,10 @@ class Engine:
                 args = {"pooled": tracked}
             args["batch_n"] = n
             spans.append((t0, self.timeline.now_us(), args))
-            wire = ("none" if op != "allreduce"
-                    else (resolve_wire_policy(r.compression)
-                          if r.compression is not None
-                          else self.wire_default))
             entries.append(_Entry(
                 -1, r.name, op, snap, average=r.average,
                 root_rank=r.root_rank, prescale=r.prescale,
-                compression=wire, donated=donated,
+                compression=wire, compression_dcn=wire_dcn, donated=donated,
                 deadline=self._abs_deadline(r.deadline_ms), batch_n=n))
         dup_failed = []
         handles: List[int] = []
@@ -1342,7 +1492,8 @@ class Engine:
                 shape=tuple(e.tensor.shape), average=e.average,
                 root_rank=e.root_rank, prescale=e.prescale,
                 age_s=now - e.enqueued_at, nbytes=e.tensor.nbytes,
-                compression=e.compression)
+                compression=e.compression,
+                compression_dcn=e.compression_dcn)
             for e in self._negotiating
         ]
         t_neg = time.monotonic()
@@ -1453,7 +1604,8 @@ class Engine:
             batch_bytes = 0
             for e in entries:
                 if e.op == "allreduce":
-                    key = (e.tensor.dtype, e.average, e.compression)
+                    key = (e.tensor.dtype, e.average, e.compression,
+                           e.compression_dcn)
                     if batch and (key != batch_key or
                                   batch_bytes + e.tensor.nbytes > self.fusion_threshold):
                         self._exec_allreduce_batch(batch)
@@ -1486,6 +1638,10 @@ class Engine:
                 # TensorArgs (no arg at full width) — hvdcheck
                 # parity-span-args pins the two vocabularies together.
                 args["wire"] = e.compression
+            if e.compression_dcn not in ("", "none"):
+                # Per-tier DCN policy of the hierarchical route; same
+                # parity contract as `wire` above.
+                args["wire_dcn"] = e.compression_dcn
             self.timeline.start(e.name, tl.WAIT_FOR_DATA, ts_us=t0_us)
             self.timeline.end(e.name, tl.WAIT_FOR_DATA, ts_us=split)
             self.timeline.start(e.name, activity, args, ts_us=split)
@@ -1553,6 +1709,7 @@ class Engine:
             # signature keep working (batches are policy-uniform — the
             # fusion key and the coordinator's grouping include it).
             self.executor.wire_policy = batch[0].compression
+            self.executor.wire_policy_dcn = batch[0].compression_dcn
             out = self.executor.allreduce(flat, batch[0].average)
             # Release the fusion input before any completion wakes a
             # waiter: the caller's next cycle must find the slab free

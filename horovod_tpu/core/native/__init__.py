@@ -142,6 +142,9 @@ class HvdRequest(ctypes.Structure):
         ("root_rank", ctypes.c_int),
         # Engine wire policy code (core/engine.py WIRE_CODES).
         ("wire", ctypes.c_int),
+        # Per-tier DCN policy code (hierarchical two-phase route) —
+        # mutually exclusive with a nonzero `wire`.
+        ("wire_dcn", ctypes.c_int),
         ("prescale", ctypes.c_double),
         # Seconds to the request's deadline at executor-call time (0 =
         # none; negative = already overdue — enforcement is the engine
@@ -177,6 +180,11 @@ class HvdResult(ctypes.Structure):
         # quantized wire policy) and the compressed-policy subset.
         ("wire_bytes", ctypes.c_longlong),
         ("wire_compressed", ctypes.c_longlong),
+        # Per-tier byte split of the hierarchical two-phase route (zero
+        # on flat routes): DCN = quantized 1/L cross-tier payload, ICI =
+        # full-width intra-tier share.
+        ("wire_dcn", ctypes.c_longlong),
+        ("wire_ici", ctypes.c_longlong),
         ("error", ctypes.c_char * 256),
     ]
 
@@ -198,6 +206,10 @@ class HvdStats(ctypes.Structure):
         ("queue_depth", ctypes.c_longlong),
         ("wire_bytes", ctypes.c_longlong),
         ("wire_bytes_compressed", ctypes.c_longlong),
+        # Per-tier split of the hierarchical route (engine.wire_bytes
+        # .dcn/.ici counter parity with the python engine).
+        ("wire_bytes_dcn", ctypes.c_longlong),
+        ("wire_bytes_ici", ctypes.c_longlong),
         # Buffer-pool accounting (hvdcore BufferPool — fed into the same
         # engine.pool.* telemetry the python pool feeds).
         ("pool_hits", ctypes.c_longlong),
@@ -283,7 +295,8 @@ def load_library():
         ctypes.c_void_p, ctypes.c_int, ctypes.c_char_p, ctypes.c_int,
         ctypes.c_int, ctypes.c_void_p, ctypes.POINTER(ctypes.c_longlong),
         ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_double,
-        ctypes.c_int, ctypes.c_int, ctypes.c_double, ctypes.c_char_p]
+        ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_double,
+        ctypes.c_char_p]
     lib.hvd_engine_enqueue_n.restype = ctypes.c_int
     lib.hvd_engine_enqueue_n.argtypes = [
         ctypes.c_void_p, ctypes.POINTER(HvdRequest), ctypes.c_int,

@@ -105,6 +105,14 @@ struct hvd_request {
   // plane behind the executor callback, which is what keeps the two
   // engines' reductions bit-identical under the same policy.
   int wire;
+  // Per-tier DCN wire policy code (same code space as `wire`) for the
+  // hierarchical two-phase route: the ICI phase reduce-scatters at the
+  // resident dtype and ONLY the 1/L cross-tier shard ships quantized.
+  // Mutually exclusive with a nonzero `wire` (the Python submit plane
+  // enforces it); opaque to C++ beyond fusion compatibility, the
+  // negotiation row and timeline args — like `wire`, the actual
+  // quantization lives in the shared data plane.
+  int wire_dcn;
   double prescale;
   // Seconds until the request's deadline at the moment the executor is
   // called (0 = no deadline; negative = already overdue — the waiter
@@ -154,6 +162,12 @@ struct hvd_result {
   // engine.wire_bytes{,.compressed} telemetry counters.
   long long wire_bytes;
   long long wire_compressed;
+  // Per-tier byte split of the hierarchical two-phase route (zero on
+  // every flat route): wire_dcn = quantized 1/L cross-tier payload,
+  // wire_ici = full-width intra-tier share. Accumulated into
+  // hvd_engine_stats -> engine.wire_bytes.dcn/.ici.
+  long long wire_dcn;
+  long long wire_ici;
   char error[256];
 };
 
@@ -196,6 +210,11 @@ struct hvd_engine_stats {
   long long queue_depth;    // in-flight tensors right now
   long long wire_bytes;     // bytes the mesh collectives shipped
   long long wire_bytes_compressed;  // subset under a quantized policy
+  // Per-tier split of the hierarchical two-phase route (zero on flat
+  // routes): DCN = quantized 1/L cross-tier payload, ICI = full-width
+  // intra-tier share.
+  long long wire_bytes_dcn;
+  long long wire_bytes_ici;
   // Buffer-pool accounting (entry snapshots, fusion buffers, result
   // buffers — hvdcore's twin of core/bufferpool.py, feeding the same
   // engine.pool.* telemetry through the Python stats sync).
@@ -513,7 +532,7 @@ const char* OpName(int op) {
 // not — that convention is how the analyzer tells span-args keys apart
 // from wire-protocol keys when diffing the two engines' vocabularies.
 std::string TensorArgs(int dtype_num, const std::vector<long long>& shape,
-                       int wire = 0) {
+                       int wire = 0, int wire_dcn = 0) {
   std::string out = "\"dtype\": \"";
   out += DtypeName(dtype_num);
   out += "\", \"shape\": [";
@@ -525,6 +544,11 @@ std::string TensorArgs(int dtype_num, const std::vector<long long>& shape,
   if (const char* w = WireName(wire)) {
     out += ", \"wire\": \"";
     out += w;
+    out += "\"";
+  }
+  if (const char* wd = WireName(wire_dcn)) {
+    out += ", \"wire_dcn\": \"";
+    out += wd;
     out += "\"";
   }
   return out;
@@ -729,6 +753,7 @@ struct Entry {
   int average;
   int root_rank;
   int wire;  // engine wire policy code (hvd_request.wire)
+  int wire_dcn = 0;  // per-tier DCN policy code (hvd_request.wire_dcn)
   double prescale;
   // Non-donated submits snapshot into a pool-checked-out slab (`data`,
   // returned to the pool at completion); donated submits reference the
@@ -949,7 +974,7 @@ class Engine {
   long long Enqueue(int op, const char* name, int dtype_num, int itemsize,
                     const void* data, const long long* shape, int ndim,
                     int average, int root_rank, double prescale, int wire,
-                    int donate, double deadline_s, char* err) {
+                    int wire_dcn, int donate, double deadline_s, char* err) {
     std::unique_lock<std::mutex> lk(mu_);
     FoldRingLocked();  // duplicate check must see ring-published names
     if (shutdown_) {
@@ -974,6 +999,7 @@ class Engine {
     e.average = average;
     e.root_rank = root_rank;
     e.wire = wire;
+    e.wire_dcn = wire_dcn;
     e.prescale = prescale;
     long long count = 1;
     for (int i = 0; i < ndim; ++i) count *= shape[i];
@@ -1098,6 +1124,7 @@ class Engine {
       e.average = r.average;
       e.root_rank = r.root_rank;
       e.wire = r.wire;
+      e.wire_dcn = r.wire_dcn;
       e.prescale = r.prescale;
       long long count = 1;
       for (int d = 0; d < r.ndim; ++d) count *= r.shape[d];
@@ -1617,7 +1644,8 @@ class Engine {
       table += pbuf;
       table += ",\"t\":" + std::to_string(SecondsSince(e.enqueued));
       table += ",\"b\":" + std::to_string(e.nbytes);
-      table += ",\"w\":" + std::to_string(e.wire) + "}";
+      table += ",\"w\":" + std::to_string(e.wire);
+      table += ",\"wd\":" + std::to_string(e.wire_dcn) + "}";
     }
     table += "]";
     hvd_negotiate_fn fn;
@@ -1771,6 +1799,7 @@ class Engine {
              fuse[0]->average == e.average &&
              fuse[0]->prescale == e.prescale &&
              fuse[0]->wire == e.wire &&
+             fuse[0]->wire_dcn == e.wire_dcn &&
              fuse_bytes + e.nbytes <= fusion_limit);
         if (!compatible) flush();
         fuse.push_back(&e);
@@ -1864,6 +1893,7 @@ class Engine {
     req.itemsize = itemsize;
     req.average = batch[0]->average;
     req.wire = batch[0]->wire;  // batch is policy-uniform (fusion key)
+    req.wire_dcn = batch[0]->wire_dcn;
     req.prescale = batch[0]->prescale;
     req.deadline_s = BatchDeadlineRemaining(batch);
     req.names = names.c_str();
@@ -1881,6 +1911,8 @@ class Engine {
       std::lock_guard<std::mutex> g(mu_);
       stats_.wire_bytes += res.wire_bytes;
       stats_.wire_bytes_compressed += res.wire_compressed;
+      stats_.wire_bytes_dcn += res.wire_dcn;
+      stats_.wire_bytes_ici += res.wire_ici;
     }
     {
       // WAIT_FOR_DATA = the host->device staging slice the executor
@@ -1893,7 +1925,8 @@ class Engine {
         timeline_.BeginAt(e->name, "WAIT_FOR_DATA", t0);
         timeline_.EndAt(e->name, "WAIT_FOR_DATA", split);
         timeline_.BeginAt(e->name, "ALLREDUCE", split,
-                          TensorArgs(e->dtype_num, e->shape, e->wire));
+                          TensorArgs(e->dtype_num, e->shape, e->wire,
+                                     e->wire_dcn));
         timeline_.EndAt(e->name, "ALLREDUCE", t1);
       }
     }
@@ -1938,6 +1971,7 @@ class Engine {
     req.average = e.average;
     req.root_rank = e.root_rank;
     req.wire = e.wire;
+    req.wire_dcn = e.wire_dcn;
     req.prescale = e.prescale;
     req.names = e.name.c_str();
     std::vector<char> bounce;
@@ -1966,6 +2000,8 @@ class Engine {
       std::lock_guard<std::mutex> g(mu_);
       stats_.wire_bytes += res.wire_bytes;
       stats_.wire_bytes_compressed += res.wire_compressed;
+      stats_.wire_bytes_dcn += res.wire_dcn;
+      stats_.wire_bytes_ici += res.wire_ici;
     }
     {
       long long t1 = timeline_.NowUs();
@@ -2370,11 +2406,12 @@ long long hvd_engine_enqueue(void* e, int op, const char* name, int dtype_num,
                              int itemsize, const void* data,
                              const long long* shape, int ndim, int average,
                              int root_rank, double prescale, int wire,
-                             int donate, double deadline_s, char* err) {
+                             int wire_dcn, int donate, double deadline_s,
+                             char* err) {
   return static_cast<Engine*>(e)->Enqueue(op, name, dtype_num, itemsize, data,
                                           shape, ndim, average, root_rank,
-                                          prescale, wire, donate, deadline_s,
-                                          err);
+                                          prescale, wire, wire_dcn, donate,
+                                          deadline_s, err);
 }
 
 int hvd_engine_enqueue_n(void* e, hvd_request* reqs, int n,

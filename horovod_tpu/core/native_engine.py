@@ -37,6 +37,7 @@ from horovod_tpu.core.engine import (
     _freeze_donated,
     _multi_controller,
     _negotiated,
+    check_wire_exclusive,
     collective_deadline_from_env,
     config_from_env,
     doctor_on_hang,
@@ -46,6 +47,7 @@ from horovod_tpu.core.engine import (
     record_submit,
     record_submit_batch,
     resolve_wire_policy,
+    wire_dcn_policy_from_env,
     wire_policy_from_env,
 )
 
@@ -110,7 +112,8 @@ def _make_negotiator(engine):
                     shape=tuple(r["s"]), average=bool(r["a"]),
                     root_rank=r["r"], prescale=r["p"], age_s=r["t"],
                     nbytes=r["b"],
-                    compression=WIRE_NAMES.get(r.get("w", 0), "none"))
+                    compression=WIRE_NAMES.get(r.get("w", 0), "none"),
+                    compression_dcn=WIRE_NAMES.get(r.get("wd", 0), "none"))
                 for r in rows
             ]
             t_neg = time.monotonic()
@@ -212,6 +215,8 @@ def _make_callback(executor):
             executor.last_stage_s = 0.0
             executor.last_wire_bytes = 0
             executor.last_wire_compressed = 0
+            executor.last_wire_bytes_dcn = 0
+            executor.last_wire_bytes_ici = 0
             if req.op == 0:  # allreduce (possibly fused)
                 if req.prescale != 1.0:
                     buf = buf * req.prescale
@@ -220,6 +225,8 @@ def _make_callback(executor):
                 # plane applies the quantized format per chunk, which is
                 # what makes the two engines' digests bit-identical.
                 executor.wire_policy = WIRE_NAMES.get(req.wire, "none")
+                executor.wire_policy_dcn = WIRE_NAMES.get(req.wire_dcn,
+                                                          "none")
                 out = executor.allreduce(buf, bool(req.average))
                 out = np.ascontiguousarray(out, dtype=dtype)
                 ctypes.memmove(dst, out.ctypes.data, nbytes)
@@ -256,6 +263,10 @@ def _make_callback(executor):
             res.wire_bytes = int(getattr(executor, "last_wire_bytes", 0))
             res.wire_compressed = int(
                 getattr(executor, "last_wire_compressed", 0))
+            res.wire_dcn = int(
+                getattr(executor, "last_wire_bytes_dcn", 0))
+            res.wire_ici = int(
+                getattr(executor, "last_wire_bytes_ici", 0))
             return 0
         except Exception as exc:  # surfaced at synchronize()
             msg = str(exc).encode()[:255]
@@ -297,6 +308,10 @@ class NativeEngine:
         # Engine-wide default wire format (HVD_COMPRESSION) — same rule
         # and fail-fast as the python twin.
         self.wire_default = wire_policy_from_env()
+        # Per-tier DCN default (HVD_COMPRESSION_DCN) for the
+        # hierarchical two-phase route — mutually exclusive with a
+        # uniform wire policy on any one request (check_wire_exclusive).
+        self.wire_dcn_default = wire_dcn_policy_from_env()
         # Deadline/cancel/drain plane (same knobs as the python twin):
         # the HVD_COLLECTIVE_DEADLINE_S default, the quiesce reason once
         # admission closes, and donated buffers whose waiter a deadline
@@ -378,6 +393,10 @@ class NativeEngine:
         ("engine.cycle_seconds_total", "cycle_seconds"),
         ("engine.wire_bytes", "wire_bytes"),
         ("engine.wire_bytes.compressed", "wire_bytes_compressed"),
+        # Per-tier split of the hierarchical two-phase route; the python
+        # twin feeds the same names through record_wire.
+        ("engine.wire_bytes.dcn", "wire_bytes_dcn"),
+        ("engine.wire_bytes.ici", "wire_bytes_ici"),
         # The C++ pool's events fold into the SAME counters the python
         # pool feeds (core/bufferpool.py).
         ("engine.pool.hits", "pool_hits"),
@@ -579,6 +598,7 @@ class NativeEngine:
                  average: bool = False, root_rank: int = 0,
                  prescale: float = 1.0,
                  compression: Optional[str] = None,
+                 compression_dcn: Optional[str] = None,
                  donate: bool = False,
                  deadline_ms: Optional[float] = None) -> int:
         # Fault site engine.submit (core/faultline.py) — in the python
@@ -614,9 +634,14 @@ class NativeEngine:
         # and the timeline never stamps a wire policy on them.
         if op != "allreduce":
             wire = "none"
+            wire_dcn = "none"
         else:
             wire = (resolve_wire_policy(compression)
                     if compression is not None else self.wire_default)
+            wire_dcn = (resolve_wire_policy(compression_dcn)
+                        if compression_dcn is not None
+                        else self.wire_dcn_default)
+            check_wire_exclusive(wire, wire_dcn, name)
         flipped = False
         if donate:
             # Ownership handoff: the C++ entry references this buffer in
@@ -630,7 +655,8 @@ class NativeEngine:
             self._ptr, _OPS[op], name.encode(), _DTYPE_CODE[tensor.dtype],
             tensor.dtype.itemsize, tensor.ctypes.data, shape, tensor.ndim,
             int(average), int(root_rank), float(prescale),
-            int(WIRE_CODES[wire]), int(donate), float(deadline_s), err)
+            int(WIRE_CODES[wire]), int(WIRE_CODES[wire_dcn]), int(donate),
+            float(deadline_s), err)
         if h < 0:
             # Rejected submit: the engine never took ownership — a
             # donated buffer we froze must become writable again.
@@ -654,10 +680,12 @@ class NativeEngine:
     def allreduce_async(self, name: str, tensor: np.ndarray, average: bool,
                         prescale: float = 1.0,
                         compression: Optional[str] = None,
+                        compression_dcn: Optional[str] = None,
                         donate: bool = False,
                         deadline_ms: Optional[float] = None) -> int:
         return self._enqueue("allreduce", name, tensor, average=average,
                              prescale=prescale, compression=compression,
+                             compression_dcn=compression_dcn,
                              donate=donate, deadline_ms=deadline_ms)
 
     def allgather_async(self, name: str, tensor: np.ndarray,
@@ -724,10 +752,15 @@ class NativeEngine:
                         "tensors with >8 dims are not supported")
                 if op != "allreduce":
                     wire = "none"
+                    wire_dcn = "none"
                 else:
                     wire = (resolve_wire_policy(r.compression)
                             if r.compression is not None
                             else self.wire_default)
+                    wire_dcn = (resolve_wire_policy(r.compression_dcn)
+                                if r.compression_dcn is not None
+                                else self.wire_dcn_default)
+                    check_wire_exclusive(wire, wire_dcn, r.name)
                 if do and _freeze_donated(tensor):
                     flipped.append(tensor)
                 if r.deadline_ms is not None:
@@ -743,6 +776,7 @@ class NativeEngine:
                 q.average = int(r.average)
                 q.root_rank = int(r.root_rank)
                 q.wire = int(WIRE_CODES[wire])
+                q.wire_dcn = int(WIRE_CODES[wire_dcn])
                 q.prescale = float(r.prescale)
                 q.deadline_s = float(deadline_s)
                 q.names = r.name.encode()

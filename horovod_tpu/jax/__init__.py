@@ -137,6 +137,18 @@ def allreduce(
                 ax = _C.rank_axes()
                 if ax is None:
                     _C._require_axis("allreduce")
+                if (isinstance(ax, tuple)
+                        and _C.hierarchical_allreduce_enabled()):
+                    # Two-tier composition: the ICI phase reduce-scatters
+                    # at the resident dtype and ONLY the 1/L shard
+                    # crosses the DCN tier block-scaled (payload+scales)
+                    # — the quantized wire applied where the bytes hurt.
+                    from horovod_tpu.parallel.hierarchical import (
+                        hierarchical_allreduce as _hier_ar,
+                    )
+
+                    return _hier_ar(tensor, average=average,
+                                    dcn_policy=compression)
                 return _quantize.spmd_allreduce(tensor, ax, average,
                                                 compression)
             _C._record_eager("allreduce", jnp.asarray(tensor))

@@ -327,9 +327,43 @@ def shard_update(
             # --- compiled SPMD path: scatter, update 1/N, gather -------
             n_axis = lax.psum(1, ax)  # static axis size
             idx = lax.axis_index(ax)
+            # Hierarchical (two-tier) quantized route: bound (dcn, ici)
+            # axes mean the step compiled over the two-tier mesh
+            # (HVD_HIERARCHICAL_ALLREDUCE + topology.two_tier()).
+            hier_q = qpol is not None and isinstance(ax, tuple)
+            if hier_q and ef:
+                raise ValueError(
+                    "int8_ef (error feedback) does not compose with the "
+                    "hierarchical two-tier route: the residual carrier "
+                    "is shaped for the flat exchange, but only the 1/L "
+                    "ICI-reduced chunk is quantized here. Use the "
+                    "stateless 'int8'/'fp8' policy with "
+                    "HVD_HIERARCHICAL_ALLREDUCE, or disable the "
+                    "hierarchical route for error-feedback runs.")
 
             def scatter(k, flat):
-                if qpol is not None:
+                if hier_q:
+                    # Two-phase exchange: reduce-scatter over ICI at the
+                    # RESIDENT dtype, then ship only the 1/L chunk across
+                    # DCN block-scaled (quantize → all_to_all payload +
+                    # scales over 'dcn' → f32 accumulate). The pre-permute
+                    # makes ICI chunk i carry the dcn-major global shards
+                    # [d*L+i for d], so the accumulated 1/N shard on chip
+                    # (d, i) is EXACTLY the flat psum_scatter's shard
+                    # d*L+i — sharded_state_specs layouts, checkpoints
+                    # and the flat route stay interchangeable.
+                    dax, iax = ax
+                    d_sz, i_sz = lax.psum(1, dax), lax.psum(1, iax)
+                    sub = flat.shape[0] // (d_sz * i_sz)
+                    xp = (flat.reshape(d_sz, i_sz, sub).swapaxes(0, 1)
+                          .reshape(flat.shape[0]))
+                    chunk = lax.psum_scatter(xp, iax, scatter_dimension=0,
+                                             tiled=True)
+                    payload, scales = _Q.quantize(
+                        chunk.astype(jnp.float32), qpol)
+                    shard = _Q.spmd_exchange_accumulate(payload, scales,
+                                                        dax, qpol)
+                elif qpol is not None:
                     # Quantized reduce-scatter phase: quantize (with the
                     # error-feedback residual added first), exchange the
                     # int8 payload + f32 scales via all_to_all, and
@@ -398,6 +432,22 @@ def shard_update(
             def gather(k, ushard):
                 if qpol is None:
                     return lax.all_gather(ushard, ax, axis=0, tiled=True)
+                if hier_q:
+                    # Inverse of the two-phase scatter: requantize the
+                    # 1/N shard, quantized all-gather over DCN (the only
+                    # cross-tier hop), dequantize to the resident dtype,
+                    # all-gather the 1/L chunk over ICI at full width,
+                    # then undo the dcn-major pre-permute.
+                    dax, iax = ax
+                    d_sz, i_sz = lax.psum(1, dax), lax.psum(1, iax)
+                    payload, scales = _Q.quantize(
+                        ushard.astype(jnp.float32), qpol)
+                    chunk = _Q.spmd_gather_dequantize(payload, scales,
+                                                      dax, qpol,
+                                                      ushard.dtype)
+                    out = lax.all_gather(chunk, iax, axis=0, tiled=True)
+                    return (out.reshape(i_sz, d_sz, ushard.shape[0])
+                            .swapaxes(0, 1).reshape(out.shape[0]))
                 # Requantize → quantized all-gather: the update delta
                 # ships at the wire width too; everyone (owner included)
                 # applies the dequantized values so state stays

@@ -147,12 +147,34 @@ def _psum_avg(x, world: int, average: bool, axis=HVD_AXIS):
     return r
 
 
-def _hier_allreduce(x, average: bool):
+def _hier_allreduce(x, average: bool, dcn_policy=None):
     """reduce-scatter(ICI) -> psum(DCN) -> all-gather(ICI) over the bound
-    two-tier axes; the lazy import keeps flax off the hot import path."""
+    two-tier axes; the lazy import keeps flax off the hot import path.
+    ``dcn_policy`` (quantized compression policy) swaps the DCN psum for
+    the block-scaled wire exchange of the 1/L shard."""
     from horovod_tpu.parallel.hierarchical import hierarchical_allreduce
 
-    return hierarchical_allreduce(x, ICI_AXIS, DCN_AXIS, average=average)
+    return hierarchical_allreduce(x, ICI_AXIS, DCN_AXIS, average=average,
+                                  dcn_policy=dcn_policy)
+
+
+def dcn_wire_policy(dcn_wire):
+    """Resolve a per-tier DCN wire-policy NAME (the engine vocabulary:
+    'none'/'int8'/'fp8') to the quantized compression policy object that
+    drives the hierarchical DCN phase; 'none'/None -> None. Non-quantized
+    spellings fail fast — the DCN tier-wire is the EQuARX block-scaled
+    pipeline, not a cast."""
+    if not dcn_wire or dcn_wire == "none":
+        return None
+    from horovod_tpu.jax.compression import Compression
+
+    pol = Compression.resolve(dcn_wire, where="dcn_wire")
+    if not getattr(pol, "quantized", False):
+        raise ValueError(
+            f"dcn_wire={dcn_wire!r} is not a quantized wire policy: the "
+            "hierarchical DCN phase ships the block-scaled payload+scales "
+            "format ('int8' or 'fp8')")
+    return pol
 
 
 def _spmd_allreduce(x, average: bool, ax):
@@ -187,7 +209,7 @@ def _rank_sharding(mesh, ndim: int):
 
 @functools.lru_cache(maxsize=None)
 def _ranked_program(op: str, mesh_key, root: int, average: bool,
-                    hier: bool = False):
+                    hier: bool = False, dcn_wire: str = "none"):
     """Build + cache a jitted collective over the current mesh. jit itself
     caches per shape/dtype, so one program object serves all tensors.
 
@@ -195,11 +217,14 @@ def _ranked_program(op: str, mesh_key, root: int, average: bool,
     with the hierarchical composition (reference: operations.cc:1194-1346,
     875-1010) instead of the flat world mesh — rank identity is unchanged
     because the two meshes hold the same devices in the same order
-    (topology._build_two_tier enforces it)."""
+    (topology._build_two_tier enforces it). ``dcn_wire`` (hier allreduce
+    only) quantizes the cross-tier phase: the ICI reduce-scatter stays at
+    the resident dtype and only the 1/L shard crosses DCN block-scaled."""
     st = _topo._require_init()
     mesh = st.two_tier if hier else st.mesh
     world = mesh.devices.size
     rank_spec = (DCN_AXIS, ICI_AXIS) if hier else HVD_AXIS
+    dcn_pol = dcn_wire_policy(dcn_wire) if hier else None
 
     def body(stacked):
         # stacked: local shard of the (size, *shape) array => (1, *shape);
@@ -207,7 +232,9 @@ def _ranked_program(op: str, mesh_key, root: int, average: bool,
         x = stacked[0]
         if op == "allreduce":
             if hier:
-                return _hier_allreduce(x, average)
+                pol = (dcn_pol if jnp.issubdtype(x.dtype, jnp.floating)
+                       else None)
+                return _hier_allreduce(x, average, pol)
             return _psum_avg(x, world, average)
         if op == "allgather":
             if hier:
@@ -287,12 +314,17 @@ def _replicated_stack(x):
     return jax.make_array_from_single_device_arrays(shape, sharding, shards)
 
 
-def ranked_allreduce(stacked, average: bool = False):
+def ranked_allreduce(stacked, average: bool = False,
+                     dcn_wire: str = "none"):
     """Sum (or mean) of per-rank tensors; result replicated to all ranks.
     Routed hierarchically (ICI/DCN split) when HVD_HIERARCHICAL_ALLREDUCE
-    is on and the world has a two-tier mesh."""
+    is on and the world has a two-tier mesh; ``dcn_wire`` then quantizes
+    the cross-tier phase (ignored on the flat route — there is no DCN
+    hop to shrink)."""
+    hier = _hier_allreduce_active()
     return _ranked_program("allreduce", _mesh_key(), 0, average,
-                           hier=_hier_allreduce_active())(stacked)
+                           hier=hier,
+                           dcn_wire=dcn_wire if hier else "none")(stacked)
 
 
 def ranked_allgather(stacked):
@@ -446,14 +478,17 @@ def fetch(x) -> np.ndarray:
         return np.asarray(multihost_utils.process_allgather(x, tiled=True))
 
 
-def allreduce(tensor, average: bool = True, name: Optional[str] = None):
+def allreduce(tensor, average: bool = True, name: Optional[str] = None,
+              dcn_wire: str = "none"):
     """Allreduce (reference API: horovod/tensorflow/mpi_ops.py:78-91 and
     horovod/common/operations.cc:1401-1496).
 
     Inside SPMD code this is ``lax.pmean``/``lax.psum`` over the chip mesh
     axis. Eagerly, every local chip contributes this controller's value.
     ``name`` is accepted for reference-API parity (negotiation needed names;
-    SPMD ordering does not) and used by the timeline.
+    SPMD ordering does not) and used by the timeline. ``dcn_wire`` (eager
+    path) quantizes the cross-tier phase of a hierarchically-routed call —
+    the engines' two-phase chunk route rides this.
     """
     if in_spmd(tensor):
         ax = rank_axes()
@@ -468,7 +503,7 @@ def allreduce(tensor, average: bool = True, name: Optional[str] = None):
     _record_eager("allreduce", tensor)
     _maybe_consistency_check(0, tensor, flags=int(average))
     return _localize(ranked_allreduce(_replicated_stack(tensor),
-                                      average=average))
+                                      average=average, dcn_wire=dcn_wire))
 
 
 def allgather(tensor, name: Optional[str] = None):

@@ -35,6 +35,7 @@ def hierarchical_allreduce(
     inner_axis: str = ICI_AXIS,
     outer_axis: str = DCN_AXIS,
     average: bool = False,
+    dcn_policy=None,
 ):
     """Allreduce ``x`` across both tiers, moving only 1/inner_size of the
     payload over the slow outer tier per chip.
@@ -44,11 +45,25 @@ def hierarchical_allreduce(
     L = inner size, with the bulk 2·N·(L-1)/L riding ICI — the same
     bandwidth argument as the reference's NCCL/MPI split
     (operations.cc:1194-1346).
+
+    ``dcn_policy`` (a quantized wire policy from
+    :mod:`horovod_tpu.jax.compression`) composes the EQuARX block-scaled
+    wire with the tier split: the ICI reduce-scatter stays at the resident
+    dtype, and ONLY the 1/L shard crosses the outer tier quantized
+    (payload + f32 scales, block-padded) — cross-tier bytes drop by both
+    the tier factor AND the wire factor. Requires a float ``x``; a
+    single-tier outer axis elides the quantization entirely (no wire hop
+    to shrink, and the digest stays on the unquantized path).
     """
     inner = lax.psum(1, inner_axis)  # static at trace time
     flat, pad = _padded_flat(x, inner)
     chunk = lax.psum_scatter(flat, inner_axis, scatter_dimension=0, tiled=True)
-    chunk = lax.psum(chunk, outer_axis)
+    if dcn_policy is not None and lax.psum(1, outer_axis) > 1:
+        from horovod_tpu.jax import quantize as _Q
+
+        chunk = _Q.spmd_allreduce(chunk, outer_axis, False, dcn_policy)
+    else:
+        chunk = lax.psum(chunk, outer_axis)
     out = lax.all_gather(chunk, inner_axis, axis=0, tiled=True)
     if pad:
         out = out[:-pad]

@@ -394,11 +394,13 @@ def skew_report(target: str, prom: Optional[str] = None) -> str:
 def _spans(trace: RankTrace, with_end_args: bool = False
            ) -> Dict[Tuple[str, str], List[tuple]]:
     """(tensor, activity) → [(begin, end)] in common time, from B/E
-    pairs; ``with_end_args`` appends the END event's args as a third
-    element (e.g. the `cached` attribution on NEGOTIATE spans).
-    Unbalanced begins (truncated trace) are dropped."""
+    pairs; ``with_end_args`` appends the span's args as a third element
+    — the BEGIN event's args updated with the END event's (e.g. the
+    `wire`/`wire_dcn` attribution stamped at span start, the `cached`
+    attribution on NEGOTIATE span ends). Unbalanced begins (truncated
+    trace) are dropped."""
     out: Dict[Tuple[str, str], List[tuple]] = {}
-    open_spans: Dict[Tuple[str, str], List[int]] = {}
+    open_spans: Dict[Tuple[str, str], List[tuple]] = {}
     for ev in trace.events:
         ph = ev.get("ph")
         if ph not in ("B", "E"):
@@ -408,13 +410,15 @@ def _spans(trace: RankTrace, with_end_args: bool = False
             continue
         key = (tensor, ev.get("name", ""))
         if ph == "B":
-            open_spans.setdefault(key, []).append(trace.common_ts(ev["ts"]))
+            open_spans.setdefault(key, []).append(
+                (trace.common_ts(ev["ts"]), ev.get("args") or {}))
         else:
             stack = open_spans.get(key)
             if stack:
-                span = (stack.pop(), trace.common_ts(ev["ts"]))
+                ts0, bargs = stack.pop()
+                span = (ts0, trace.common_ts(ev["ts"]))
                 if with_end_args:
-                    span += (ev.get("args", {}),)
+                    span += ({**bargs, **(ev.get("args") or {})},)
                 out.setdefault(key, []).append(span)
     for v in out.values():
         v.sort(key=lambda s: s[:2])  # args dicts are not orderable
@@ -458,6 +462,29 @@ def negotiate_attribution(span_dicts) -> dict:
             bucket = ("unknown" if cached is None
                       else "cached" if cached else "full")
             split[bucket].append(dur)
+    return {k: _span_stats(v) for k, v in split.items()}
+
+
+def wire_attribution(span_dicts) -> dict:
+    """Per-tier wire attribution of the collective phase: counts, total
+    µs and median µs of collective spans split by route — ``flat``
+    (full width, no wire arg), ``quantized`` (uniform wire policy, the
+    `wire` span arg) or ``two_tier`` (hierarchical with a DCN-only
+    policy, the `wire_dcn` span arg both engines stamp at span start).
+    Same one-pass span-dict input as :func:`negotiate_attribution`."""
+    split = {"flat": [], "quantized": [], "two_tier": []}
+    for spans in span_dicts:
+        for (tensor, act), sp in spans.items():
+            if act not in _COLLECTIVES:
+                continue
+            for b, e, args in sp:
+                if args.get("wire_dcn"):
+                    bucket = "two_tier"
+                elif args.get("wire"):
+                    bucket = "quantized"
+                else:
+                    bucket = "flat"
+                split[bucket].append(e - b)
     return {k: _span_stats(v) for k, v in split.items()}
 
 
@@ -546,7 +573,8 @@ def critical_path_data(target: str) -> dict:
     return {"instances": len(instances), "phase_us": phase_us,
             "shares": shares, "slowest": instances[:5],
             "negotiate": negotiate_attribution(span_dicts),
-            "memcpy": memcpy_attribution(span_dicts)}
+            "memcpy": memcpy_attribution(span_dicts),
+            "wire": wire_attribution(span_dicts)}
 
 
 def critical_path_report(target: str) -> str:
@@ -578,6 +606,17 @@ def critical_path_report(target: str) -> str:
                 parts.append(f"{k} n={s['count']} "
                              f"median={s['median_us'] / 1e3:.3f} ms")
         lines.append("copy spans (buffer pool): " + " | ".join(parts))
+    wire = d.get("wire", {})
+    if any(wire.get(k, {}).get("count") for k in ("quantized", "two_tier")):
+        # Wire-route attribution: which collective spans rode the
+        # uniform quantized wire vs the hierarchical per-tier route.
+        parts = []
+        for k in ("flat", "quantized", "two_tier"):
+            s = wire.get(k, {"count": 0})
+            if s["count"]:
+                parts.append(f"{k} n={s['count']} "
+                             f"median={s['median_us'] / 1e3:.3f} ms")
+        lines.append("collective spans (wire route): " + " | ".join(parts))
     if d["slowest"]:
         lines.append("slowest instances (the critical path):")
         for inst in d["slowest"]:

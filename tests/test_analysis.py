@@ -235,6 +235,39 @@ def test_parity_catches_wire_code_skew(tmp_path):
     assert any(f.rule == "parity-wire-codes" for f in findings), findings
 
 
+def test_abi_catches_skewed_wire_dcn_field(tmp_path):
+    """The per-tier DCN wire policy rides the C ABI (hvd_request.wire_dcn);
+    widening the ctypes mirror behind the C struct's back must be named."""
+    root = _mini_root(tmp_path)
+    _edit(root, _BINDING, '("wire_dcn", ctypes.c_int),',
+          '("wire_dcn", ctypes.c_longlong),')
+    findings = abi.check(root)
+    assert any(f.rule == "abi-struct" and "wire_dcn" in f.message
+               for f in findings), findings
+
+
+def test_parity_catches_renamed_tier_counter_field(tmp_path):
+    """The per-tier wire byte counters (wire_bytes_dcn/_ici) join the
+    machine-diffed stats vocabulary: renaming the C++ side without the
+    stats sync following is named by both checkers."""
+    root = _mini_root(tmp_path)
+    _edit(root, _CC, "long long wire_bytes_dcn;",
+          "long long wire_bytes_slow;")
+    rules = {f.rule for f in parity.check(root)}
+    assert "parity-stats-fields" in rules
+    assert any(f.rule == "abi-struct" for f in abi.check(root))
+
+
+def test_parity_catches_renamed_tier_span_arg(tmp_path):
+    """Timeline span args carry the per-tier policy ("wire_dcn"); the
+    C++ emitter drifting from the python vocabulary is a span-args skew."""
+    root = _mini_root(tmp_path)
+    _edit(root, _CC, 'out += ", \\"wire_dcn\\": \\"";',
+          'out += ", \\"dcn_wire\\": \\"";')
+    findings = parity.check(root)
+    assert any(f.rule == "parity-span-args" for f in findings), findings
+
+
 def test_parity_catches_skewed_latency_bucket_edge(tmp_path):
     """The issue's canonical seed: one C++ bucket edge nudged — merged
     world histograms would silently corrupt every fleet quantile."""

@@ -112,6 +112,35 @@ class TestDecide:
         assert "Mismatched data types" in groups[0].error
         assert "process 2" in groups[0].error
 
+    def test_mismatched_dcn_wire_policy_fails_fast_by_name(self):
+        # One side would quantize the cross-tier shard, the other would
+        # not — the error must name the per-tier knob, not just "shapes".
+        a = [meta("x", compression_dcn="int8")]
+        b = [meta("x")]
+        for mine in (a, b):
+            groups = decide({0: a, 1: b}, mine, fusion_threshold=0)
+            assert len(groups) == 1 and groups[0].error
+            assert "DCN-tier wire policies" in groups[0].error
+            assert "HVD_COMPRESSION_DCN" in groups[0].error
+
+    def test_dcn_wire_policy_splits_fusion_groups(self):
+        # Same dtype, different per-tier policy: fusing them would run
+        # one batch under one executor wire setting — they must not fuse.
+        a = [meta("a", compression_dcn="int8"), meta("b"),
+             meta("c", compression_dcn="int8")]
+        groups = decide({0: a, 1: a}, a, fusion_threshold=1 << 20)
+        assert [g.indices for g in groups] == [[0, 2], [1]]
+        assert all(g.error is None for g in groups)
+
+    def test_wire_roundtrip_preserves_dcn_policy(self):
+        m = meta("x", compression_dcn="int8")
+        m2 = RequestMeta.from_wire(m.wire())
+        assert m2.compression_dcn == "int8"
+        assert m2 == m
+        # Back-compat: a pre-per-tier peer's 11-element row defaults it.
+        legacy = RequestMeta.from_wire(meta("x").wire()[:11])
+        assert legacy.compression_dcn == "none"
+
     def test_allgather_first_dim_may_differ(self):
         a = [meta("g", op="allgather", shape=(2, 3))]
         b = [meta("g", op="allgather", shape=(5, 3))]

@@ -1,0 +1,200 @@
+"""Hierarchical two-tier collectives composed with the int8 wire: the
+ICI phase reduce-scatters at the resident dtype and only the 1/L
+quantized shard crosses the DCN tier (reference:
+HOROVOD_HIERARCHICAL_ALLREDUCE composed with the grpc/compression wire,
+operations.cc:1194-1346 + the PR-12 block-scaled int8 pipeline).
+
+Covers, on the simulated 2x4 (dcn, ici) split of the 8-device world:
+- the compiled SPMD route, HLO-pinned: the cross-tier all-to-all payload
+  is i8 and exactly 1/(L*D) of the full tensor;
+- the engines' two-phase chunk route: python and native digests are
+  bit-identical, and the new per-tier counters account DCN bytes at
+  exactly flat-quantized-wire / L;
+- the mutual-exclusion (uniform ``compression`` vs per-tier
+  ``compression_dcn``) fail-fast;
+- the degenerate-tier elisions (no two-tier mesh; dcn size 1)."""
+
+import re
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+import horovod_tpu as hvd
+import horovod_tpu.jax as hvd_jax
+from horovod_tpu.common import topology
+from horovod_tpu.core import telemetry as tele
+from horovod_tpu.core.engine import Engine, EngineError
+from horovod_tpu.core.native_engine import NativeEngine
+from horovod_tpu.jax.compression import Compression
+from horovod_tpu.ops import collectives as C
+
+D, L = 2, 4  # HVD_TWO_TIER_SHAPE: dcn-major split of the 8-chip world
+
+
+@pytest.fixture
+def two_tier_world(monkeypatch):
+    monkeypatch.setenv("HVD_TWO_TIER_SHAPE", f"{D},{L}")
+    monkeypatch.setenv("HVD_HIERARCHICAL_ALLREDUCE", "1")
+    hvd.shutdown()
+    hvd.init()
+    yield hvd
+    monkeypatch.undo()
+    hvd.shutdown()
+    hvd.init()
+
+
+# ---------------------------------------------------------------------------
+# compiled route
+# ---------------------------------------------------------------------------
+
+
+def test_ranked_dcn_wire_matches_flat(two_tier_world):
+    """Distinct per-rank values: the quantized cross-tier phase stays
+    within the block-scaled int8 tolerance of the exact sum; the
+    full-width hierarchical route stays (near-)exact."""
+    rng = np.random.default_rng(0)
+    vals = [rng.standard_normal(4096).astype(np.float32)
+            for _ in range(8)]
+    ref = np.sum(vals, axis=0)
+    stacked = C.make_ranked(vals)
+    full = np.asarray(C.ranked_allreduce(stacked))
+    np.testing.assert_allclose(full, ref, rtol=1e-5, atol=1e-5)
+    out_q = np.asarray(C.ranked_allreduce(stacked, dcn_wire="int8"))
+    rel = np.abs(out_q - ref).max() / np.abs(ref).max()
+    assert rel < 0.05, rel
+
+
+def test_dcn_wire_rejects_non_quantized(two_tier_world):
+    with pytest.raises(ValueError, match="quantized"):
+        C.dcn_wire_policy("bf16")
+
+
+def test_compiled_crosstier_payload_is_i8_and_fractional(two_tier_world):
+    """HLO pin (the issue's acceptance bound): with the int8 policy the
+    ONLY cross-tier collective payload is i8 sized exactly n/(L*D) per
+    participant — the full-width f32 tensor never crosses the DCN tier."""
+    n = L * D * 512 * 4  # divisible by every pad unit -> exact shapes
+    lowered = hvd_jax.jit(
+        lambda x: hvd_jax.allreduce(x, average=False,
+                                    compression=Compression.int8),
+        in_specs=(P(),), out_specs=P()).lower(jnp.zeros((n,), jnp.float32))
+    hlo = lowered.compile().as_text()
+    a2a_i8 = [l for l in hlo.splitlines()
+              if "all-to-all" in l and "s8[" in l]
+    assert a2a_i8, "no i8 cross-tier all-to-all in:\n" + hlo
+    shapes = [m.group(1) for l in a2a_i8
+              for m in [re.search(r"s8\[([\d,]+)\]", l)] if m]
+    sizes = {int(np.prod([int(d) for d in s.split(",")])) for s in shapes}
+    assert sizes == {n // (L * D)}, (sizes, n // (L * D))
+
+
+def test_compiled_full_width_has_no_i8(two_tier_world):
+    n = L * D * 512 * 4
+    hlo = hvd_jax.jit(
+        lambda x: hvd_jax.allreduce(x, average=False),
+        in_specs=(P(),), out_specs=P()).lower(
+        jnp.zeros((n,), jnp.float32)).compile().as_text()
+    assert "s8[" not in hlo
+
+
+def test_compiled_hier_int8_numerics(two_tier_world):
+    @hvd_jax.jit(in_specs=(P(),), out_specs=P())
+    def step(x):
+        return hvd_jax.allreduce(x, average=False,
+                                 compression=Compression.int8)
+
+    y = np.asarray(step(jnp.ones((64, 32), jnp.float32)))
+    np.testing.assert_allclose(y, 8.0, rtol=0.05)
+
+
+# ---------------------------------------------------------------------------
+# engine two-phase route
+# ---------------------------------------------------------------------------
+
+_KEYS = ("engine.wire_bytes", "engine.wire_bytes.compressed",
+         "engine.wire_bytes.dcn", "engine.wire_bytes.ici")
+
+
+def _run_engine(engine_cls, x, **kw):
+    eng = engine_cls()
+    try:
+        h = eng.allreduce_async("t", x.copy(), average=False, **kw)
+        return np.asarray(eng.synchronize(h)).copy()
+    finally:
+        eng.shutdown()
+
+
+def _counter_deltas(engine_cls, x, **kw):
+    base = tele.REGISTRY.flat_counters()
+    out = _run_engine(engine_cls, x, **kw)
+    cur = tele.REGISTRY.flat_counters()
+    return out, {k: cur.get(k, 0) - base.get(k, 0) for k in _KEYS}
+
+
+def test_engine_two_phase_bit_identical_and_tier_bytes(two_tier_world):
+    """The issue's acceptance bound, asserted from the checked-in
+    per-tier counters: with the int8 DCN wire, the cross-tier bytes
+    (payload + scales) are exactly the flat quantized wire / L — the
+    slow tier carries only the 1/L shard. Python and native engines
+    produce bit-identical digests (same eager program underneath)."""
+    n = 4096  # divisible by L*D*block -> byte math is exact
+    x = np.random.default_rng(1).standard_normal(n).astype(np.float32)
+    ref = _run_engine(Engine, x)
+    py, c_py = _counter_deltas(Engine, x, compression_dcn="int8")
+    nat, c_nat = _counter_deltas(NativeEngine, x, compression_dcn="int8")
+    np.testing.assert_array_equal(py, nat)
+    assert c_py == c_nat, (c_py, c_nat)
+    rel = np.abs(py - ref).max() / np.abs(ref).max()
+    assert rel < 0.05, rel
+    _, c_flat_q = _counter_deltas(Engine, x, compression="int8")
+    assert c_py["engine.wire_bytes.dcn"] > 0
+    assert (c_py["engine.wire_bytes.dcn"] * L
+            == c_flat_q["engine.wire_bytes"]), (c_py, c_flat_q)
+    assert c_py["engine.wire_bytes.ici"] == n * 4
+    assert c_flat_q["engine.wire_bytes.dcn"] == 0  # flat route: no tiers
+
+
+@pytest.mark.parametrize("engine_cls", [Engine, NativeEngine])
+def test_engine_wire_exclusive_fail_fast(two_tier_world, engine_cls):
+    eng = engine_cls()
+    try:
+        with pytest.raises(EngineError, match="both the uniform"):
+            eng.allreduce_async("x", np.ones((4,), np.float32), False,
+                                compression="int8",
+                                compression_dcn="int8")
+    finally:
+        eng.shutdown()
+
+
+def test_engine_dcn_wire_elides_without_two_tier(hvd):
+    """Flat (single-tier) world: compression_dcn falls back to the
+    full-width route — exact result, zero tier bytes."""
+    assert topology.two_tier() is None
+    x = np.arange(64, dtype=np.float32)
+    _, c_plain = _counter_deltas(Engine, x)
+    out, c = _counter_deltas(Engine, x, compression_dcn="int8")
+    np.testing.assert_array_equal(out, x * 8)
+    assert c["engine.wire_bytes.dcn"] == 0
+    assert c["engine.wire_bytes.ici"] == 0
+    assert c["engine.wire_bytes"] == c_plain["engine.wire_bytes"]
+
+
+def test_engine_dcn_wire_elides_on_degenerate_outer_tier(monkeypatch):
+    """dcn size 1 (a two-tier mesh with nothing across the slow tier):
+    the quantized cross-tier phase elides bit-exactly."""
+    monkeypatch.setenv("HVD_TWO_TIER_SHAPE", "1,8")
+    monkeypatch.setenv("HVD_HIERARCHICAL_ALLREDUCE", "1")
+    hvd.shutdown()
+    hvd.init()
+    try:
+        assert dict(topology.two_tier().shape)["dcn"] == 1
+        x = np.arange(64, dtype=np.float32)
+        out, c = _counter_deltas(Engine, x, compression_dcn="int8")
+        np.testing.assert_array_equal(out, x * 8)
+        assert c["engine.wire_bytes.dcn"] == 0
+    finally:
+        monkeypatch.undo()
+        hvd.shutdown()
+        hvd.init()

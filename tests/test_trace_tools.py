@@ -143,6 +143,22 @@ def test_negotiate_cached_vs_full_attribution():
     assert "[negotiate spans: 1 cached / 1 full]" in trace.skew_report(DATA)
 
 
+def test_wire_route_attribution():
+    """Collective spans split by wire route from the `wire`/`wire_dcn`
+    args the engines stamp at span START: the fixture's first allreduce
+    is full-width, the second rode the hierarchical per-tier route."""
+    from horovod_tpu.utils import trace
+
+    d = trace.critical_path_data(DATA)
+    w = d["wire"]
+    assert w["flat"]["count"] == 1 and w["flat"]["us"] == 7000
+    assert w["two_tier"]["count"] == 1 and w["two_tier"]["us"] == 500
+    assert w["quantized"]["count"] == 0
+    report = trace.critical_path_report(DATA)
+    assert "collective spans (wire route)" in report
+    assert "two_tier n=1" in report and "flat n=1" in report
+
+
 def test_trace_cli_subcommands(tmp_path, capsys):
     from horovod_tpu.utils import trace
 
