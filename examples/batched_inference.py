@@ -1,0 +1,155 @@
+#!/usr/bin/env python
+"""Data-parallel batched inference on the serving plane.
+
+The mixed train+serve shape from docs/running.md "Serving plane": eval
+batches are sharded over the world mesh and scored by a compiled forward
+pass (the hot path needs no engine), while the per-batch serving metric —
+a class histogram every rank must agree on — rides the ENGINE as a
+``priority='high'`` allreduce with a per-request deadline. Background
+training-style traffic (big, ``priority='low'`` gradient-sized buffers)
+runs concurrently; the scheduler drains the high class first, so serving
+latency stays bounded no matter how much bulk work is queued behind it.
+
+Each request carries a client budget: if the metric reduction has not
+completed within ``--client-timeout-ms`` the client walks away and the
+request is cooperatively cancelled (``Engine.cancel`` — the PR 15
+doctrine: cancellation at a safe point, never mid-collective). Admission
+state (queue depth, per-class in-flight vs budgets) is printed at the
+end — the same body ``/healthz`` serves.
+
+Run: PYTHONPATH=. python examples/batched_inference.py --batches 8
+Multi-process:
+    python -m horovod_tpu.run -np 2 --cpu -- python \
+        examples/batched_inference.py --batches 8
+"""
+
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+import horovod_tpu as hvd
+from horovod_tpu.core.engine import (
+    CollectiveTimeout,
+    admission_summary,
+    get_engine,
+)
+from horovod_tpu.jax import mpi_ops
+from horovod_tpu.ops.collectives import HVD_AXIS
+
+from common import shard_batch, synthetic_mnist
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--batches", type=int, default=8,
+                    help="eval batches to serve")
+    ap.add_argument("--batch-size", type=int, default=32,
+                    help="per-chip eval batch size")
+    ap.add_argument("--deadline-ms", type=float, default=5000.0,
+                    help="engine-side deadline on the metric reduction")
+    ap.add_argument("--client-timeout-ms", type=float, default=4000.0,
+                    help="client walk-away budget; overdue requests are "
+                         "cooperatively cancelled")
+    ap.add_argument("--background-mb", type=float, default=4.0,
+                    help="size of the concurrent low-priority training "
+                         "buffer (0 disables the mixed-load shape)")
+    args = ap.parse_args()
+
+    hvd.init()
+    rank, world = hvd.rank(), hvd.size()
+    eng = get_engine()
+    mesh = hvd.mesh()
+
+    (_, _), (xte, yte) = synthetic_mnist()
+    # A fixed random projection scored by a compiled, sharded forward
+    # pass — the model itself is beside the point; the serving plumbing
+    # around it is the example.
+    w = np.random.RandomState(1).randn(784, 10).astype(np.float32)
+    w = mpi_ops.broadcast(w, root_rank=0, name="serve.model.w")
+
+    @jax.jit
+    def forward(wp, x):
+        return jnp.argmax(x.reshape(x.shape[0], -1) @ wp, axis=-1)
+
+    wp = jnp.asarray(w)
+    per_global = args.batch_size * hvd.local_size()
+
+    served = cancelled = timed_out = 0
+    latencies_ms = []
+    bg_handle = None
+    for b in range(args.batches):
+        lo = (b * per_global) % max(1, len(xte) - per_global)
+        batch = shard_batch(xte[lo:lo + per_global], mesh, HVD_AXIS)
+        preds = np.asarray(jax.device_get(forward(wp, batch)))
+
+        # Bulk work queued BEHIND the serving request: a training-sized
+        # low-class buffer per batch (fire-and-forget, drained at exit).
+        if args.background_mb > 0 and bg_handle is None:
+            n = int(args.background_mb * 1e6 / 4)
+            bg_handle = mpi_ops.allreduce_async(
+                np.ones(n, dtype=np.float32), name="serve.background",
+                priority="low", deadline_ms=30000)
+
+        hist = np.bincount(preds, minlength=10).astype(np.float64)
+        t0 = time.monotonic()
+        h = mpi_ops.allreduce_async(
+            hist, average=False, name=f"serve.metric.{b}",
+            priority="high", deadline_ms=args.deadline_ms)
+        # The client polls with its own budget; on walk-away the request
+        # is cancelled so it stops holding an admission slot.
+        while not eng.poll(h):
+            if (time.monotonic() - t0) * 1e3 > args.client_timeout_ms:
+                break
+            time.sleep(0.001)
+        if eng.poll(h):
+            try:
+                global_hist = mpi_ops.synchronize(h)
+            except CollectiveTimeout:
+                timed_out += 1
+                continue
+            latencies_ms.append((time.monotonic() - t0) * 1e3)
+            served += 1
+            if rank == 0 and b == 0:
+                top = int(np.argmax(global_hist))
+                print(f"batch {b}: served {int(global_hist.sum())} "
+                      f"examples across {world} rank(s), modal class "
+                      f"{top}", flush=True)
+        else:
+            eng.cancel(h)
+            cancelled += 1
+            try:
+                mpi_ops.synchronize(h)
+            except Exception:
+                pass  # cancelled/overdue — the client already left
+
+        if bg_handle is not None and eng.poll(bg_handle):
+            mpi_ops.synchronize(bg_handle)
+            bg_handle = None
+
+    if bg_handle is not None:
+        try:
+            mpi_ops.synchronize(bg_handle)
+        except Exception:
+            pass
+
+    adm = admission_summary() or {}
+    p50 = (sorted(latencies_ms)[len(latencies_ms) // 2]
+           if latencies_ms else None)
+    print(f"rank {rank}: served={served} cancelled={cancelled} "
+          f"timed_out={timed_out} p50_ms="
+          f"{p50 if p50 is None else round(p50, 2)} "
+          f"queue_depth={adm.get('queue_depth')} "
+          f"saturated={adm.get('saturated')}", flush=True)
+    hvd.shutdown()
+    sys.exit(0 if served > 0 else 1)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,266 @@
+#!/usr/bin/env python
+"""Serving-plane load harness: mixed-priority traffic under injected chaos.
+
+The overload acceptance gate (ISSUE 20): drive concurrent mixed-priority
+requests through the engine — single submits and fused batches together —
+while faultline injects exec stalls / KV delays, and prove that overload
+is a governed regime, not a failure mode:
+
+- high-class p99 latency stays bounded by its deadline knob,
+- admission rejections land on the LOW class only (budgets are per class),
+- zero torn fused batches (admission is all-or-nothing per batch),
+- zero poisonings: every non-shed completion digest-verifies against the
+  exact expected reduction (integer-valued payloads, ``average=False``).
+
+Every rank derives the SAME request schedule from ``--seed`` (names,
+classes, deadlines — collectives are symmetric; a mixed-priority world
+for one tensor fails fast by name), and each rank contributes a payload
+that depends on its rank, so the expected sum is known in closed form.
+
+Run (single process, quick smoke):
+    PYTHONPATH=. python examples/serving_load_harness.py --requests 40
+
+The 2-process acceptance shape (small low-class budget to force
+rejections, exec stalls + KV delays on rank 0):
+    python -m horovod_tpu.run -np 2 --cpu -- python \
+        examples/serving_load_harness.py --requests 120 \
+        --max-inflight-low 2 --deadline-high-ms 8000 \
+        --faults engine.exec:stall:3:0.1,kv.get:delay:5:0.02 \
+        --assert-acceptance
+
+Prints exactly one JSON line per rank (per-class latency quantiles,
+shed/rejected/timeout tallies, digest failures, admission counters).
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+
+def _percentile(values, q):
+    if not values:
+        return None
+    xs = sorted(values)
+    idx = min(len(xs) - 1, max(0, int(round(q * (len(xs) - 1)))))
+    return xs[idx]
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--requests", type=int, default=120,
+                    help="total single-submit requests (fused batches ride "
+                         "on top, one per wave)")
+    ap.add_argument("--wave", type=int, default=12,
+                    help="max requests in flight at once")
+    ap.add_argument("--size", type=int, default=512,
+                    help="elements per tensor")
+    ap.add_argument("--batch-tensors", type=int, default=4,
+                    help="tensors per fused batch (one batch per wave; "
+                         "0 disables)")
+    ap.add_argument("--deadline-high-ms", type=float, default=8000.0,
+                    help="high-class deadline — the acceptance knob")
+    ap.add_argument("--deadline-low-ms", type=float, default=4000.0,
+                    help="normal/low-class deadline (bounds recovery when "
+                         "admission diverges across ranks under chaos)")
+    ap.add_argument("--seed", type=int, default=20)
+    ap.add_argument("--max-inflight-low", type=int, default=0,
+                    help="set HVD_ADMISSION_MAX_INFLIGHT_LOW before the "
+                         "engine starts (0 = leave env alone)")
+    ap.add_argument("--faults", default="",
+                    help="HVD_FAULTS spec to arm in THIS process (the "
+                         "launcher's --faults RANK:SPEC scopes per rank)")
+    ap.add_argument("--assert-acceptance", action="store_true",
+                    help="exit nonzero unless the ISSUE-20 gate holds: "
+                         "high p99 <= deadline knob, rejections on low "
+                         "only (and present), zero torn batches, zero "
+                         "digest failures")
+    args = ap.parse_args()
+
+    # Knobs must land in the env BEFORE the engine singleton is built
+    # (config_from_env reads them once, at construction).
+    if args.max_inflight_low > 0:
+        os.environ["HVD_ADMISSION_MAX_INFLIGHT_LOW"] = str(
+            args.max_inflight_low)
+    if args.faults:
+        os.environ["HVD_FAULTS"] = args.faults
+
+    import numpy as np
+
+    import horovod_tpu as hvd
+    from horovod_tpu.core import telemetry as tele
+    from horovod_tpu.core.engine import (
+        AdmissionRejected,
+        CollectiveTimeout,
+        admission_summary,
+        get_engine,
+    )
+    from horovod_tpu.jax import mpi_ops
+
+    hvd.init()
+    rank, world = hvd.rank(), hvd.size()
+    pid, nproc = hvd.process_index(), hvd.num_processes()
+    local = hvd.local_size()
+    eng = get_engine()
+
+    rng = np.random.RandomState(args.seed)  # IDENTICAL on every rank
+    classes = rng.choice(["high", "normal", "low"], size=args.requests,
+                         p=[0.25, 0.30, 0.45])
+    deadline_ms = {"high": args.deadline_high_ms,
+                   "normal": args.deadline_low_ms,
+                   "low": args.deadline_low_ms}
+    # Engine allreduce semantics: the staged host buffer rides the chip
+    # mesh, so process q's payload is counted local_size(q) times. With
+    # payload i on process p = (i % 11 + 1) * (p + 1) and equal local
+    # sizes, the exact sum is (i % 11 + 1) * local * nproc*(nproc+1)/2.
+    rank_sum = local * nproc * (nproc + 1) / 2.0
+
+    stats = {c: dict(submitted=0, completed=0, rejected=0, timeout=0,
+                     failed=0, latencies_ms=[]) for c in
+             ("high", "normal", "low")}
+    digest_failures = 0
+    torn_batches = 0
+    outstanding = {}  # handle -> (name, cls, t0, expected, batch_id)
+    batch_state = {}  # batch_id -> dict(done=0, bad=0, n=N)
+
+    def drain(budget):
+        """Poll outstanding handles; resolve completions/errors."""
+        nonlocal digest_failures, torn_batches
+        resolved = 0
+        for h in list(outstanding):
+            if not eng.poll(h):
+                continue
+            name, cls, t0, expected, batch_id = outstanding.pop(h)
+            lat_ms = (time.monotonic() - t0) * 1e3
+            try:
+                out = mpi_ops.synchronize(h)
+            except CollectiveTimeout:
+                stats[cls]["timeout"] += 1
+                if batch_id is not None:
+                    batch_state[batch_id]["bad"] += 1
+            except Exception:
+                stats[cls]["failed"] += 1
+                if batch_id is not None:
+                    batch_state[batch_id]["bad"] += 1
+            else:
+                stats[cls]["completed"] += 1
+                stats[cls]["latencies_ms"].append(lat_ms)
+                if not np.allclose(np.asarray(out), expected):
+                    digest_failures += 1
+                if batch_id is not None:
+                    batch_state[batch_id]["done"] += 1
+            resolved += 1
+            if resolved >= budget:
+                break
+        return resolved
+
+    next_batch = 0
+    for i in range(args.requests):
+        cls = str(classes[i])
+        value = float(i % 11 + 1)
+        payload = np.full(args.size, value * (pid + 1), dtype=np.float32)
+        expected = value * rank_sum
+        # Back-pressure: keep at most --wave requests in flight. This is
+        # the continuous-admission shape — the queue stays loaded, so the
+        # per-class budgets actually bite.
+        while len(outstanding) >= args.wave:
+            if drain(args.wave) == 0:
+                time.sleep(0.002)
+        t0 = time.monotonic()
+        try:
+            h = mpi_ops.allreduce_async(
+                payload, average=False, name=f"serve.{cls}.{i}",
+                priority=cls, deadline_ms=deadline_ms[cls])
+        except AdmissionRejected:
+            stats[cls]["rejected"] += 1
+        else:
+            stats[cls]["submitted"] += 1
+            outstanding[h] = (f"serve.{cls}.{i}", cls, t0, expected, None)
+        # One fused batch per wave: uniform class (fusion is
+        # priority-uniform), admission is all-or-nothing for the batch.
+        if (args.batch_tensors and i % args.wave == args.wave - 1):
+            bid = next_batch
+            next_batch += 1
+            bvals = [float((bid + k) % 7 + 1)
+                     for k in range(args.batch_tensors)]
+            tensors = [np.full(args.size, v * (pid + 1), dtype=np.float32)
+                       for v in bvals]
+            names = [f"serve.batch.{bid}.{k}"
+                     for k in range(args.batch_tensors)]
+            t0 = time.monotonic()
+            try:
+                hs = mpi_ops.allreduce_n_async(
+                    tensors, average=False, names=names, priority="normal",
+                    deadline_ms=args.deadline_low_ms)
+            except AdmissionRejected:
+                stats["normal"]["rejected"] += args.batch_tensors
+            else:
+                batch_state[bid] = dict(done=0, bad=0,
+                                        n=args.batch_tensors)
+                for k, h in enumerate(hs):
+                    stats["normal"]["submitted"] += 1
+                    outstanding[h] = (names[k], "normal", t0,
+                                      bvals[k] * rank_sum, bid)
+
+    while outstanding:
+        if drain(len(outstanding)) == 0:
+            time.sleep(0.002)
+
+    # A torn batch = some members completed while others failed. The
+    # admission contract makes submit-time tearing impossible; mid-flight
+    # the members share one deadline, so they resolve together.
+    for st in batch_state.values():
+        if st["done"] and st["done"] + st["bad"] == st["n"] and st["bad"]:
+            torn_batches += 1
+
+    flat = tele.REGISTRY.flat_counters()  # syncs the native fold too
+    report = {
+        "rank": rank, "world": world, "engine": type(eng).__name__,
+        "classes": {
+            c: dict(submitted=st["submitted"], completed=st["completed"],
+                    rejected=st["rejected"], timeout=st["timeout"],
+                    failed=st["failed"],
+                    p50_ms=_percentile(st["latencies_ms"], 0.50),
+                    p99_ms=_percentile(st["latencies_ms"], 0.99))
+            for c, st in stats.items()},
+        "digest_failures": digest_failures,
+        "torn_batches": torn_batches,
+        "counters": {
+            "engine.admission.rejected":
+                int(flat.get("engine.admission.rejected", 0)),
+            "engine.admission.shed":
+                int(flat.get("engine.admission.shed", 0)),
+            "numerics.nonfinite.steps":
+                int(flat.get("numerics.nonfinite.steps", 0)),
+        },
+        "admission": admission_summary(),
+    }
+    print(json.dumps(report), flush=True)
+
+    ok = True
+    if args.assert_acceptance:
+        high_p99 = report["classes"]["high"]["p99_ms"]
+        ok = (digest_failures == 0 and torn_batches == 0
+              and report["counters"]["numerics.nonfinite.steps"] == 0
+              and stats["high"]["rejected"] == 0
+              and stats["normal"]["rejected"] == 0
+              and stats["low"]["rejected"] > 0
+              and high_p99 is not None
+              and high_p99 <= args.deadline_high_ms)
+        if not ok:
+            print(f"[rank {rank}] acceptance FAILED: high_p99={high_p99} "
+                  f"rejected={[stats[c]['rejected'] for c in stats]} "
+                  f"digest={digest_failures} torn={torn_batches}",
+                  file=sys.stderr, flush=True)
+    elif digest_failures or torn_batches:
+        ok = False
+
+    hvd.shutdown()
+    sys.exit(0 if ok else 1)
+
+
+if __name__ == "__main__":
+    main()

@@ -390,12 +390,18 @@ class RequestMeta:
     # exchange mismatched payloads, so mixed per-tier policies fail
     # fast BY NAME at negotiation.
     compression_dcn: str = "none"
+    # Priority class code (core/engine.py PRIORITY_CODES; lower drains
+    # first). Part of the cross-process fingerprint: a world where
+    # processes disagree on a tensor's class would compose different
+    # fused batches and drain in different orders, so mixed priorities
+    # fail fast BY NAME at negotiation (the HVD_COMPRESSION precedent).
+    priority: int = 1
 
     def wire(self) -> list:
         return [self.name, self.op, self.dtype, self.itemsize,
                 list(self.shape), int(self.average), self.root_rank,
                 self.prescale, round(self.age_s, 3), self.nbytes,
-                self.compression, self.compression_dcn]
+                self.compression, self.compression_dcn, self.priority]
 
     @staticmethod
     def from_wire(w: list) -> "RequestMeta":
@@ -405,7 +411,8 @@ class RequestMeta:
                            nbytes=w[9],
                            compression=w[10] if len(w) > 10 else "none",
                            compression_dcn=(w[11] if len(w) > 11
-                                            else "none"))
+                                            else "none"),
+                           priority=int(w[12]) if len(w) > 12 else 1)
 
 
 @dataclass
@@ -468,7 +475,7 @@ class ResponseCache:
         ``age_s`` counts)."""
         return (m.op, m.dtype, m.itemsize, tuple(m.shape), m.average,
                 m.root_rank, m.prescale, m.nbytes, m.compression,
-                m.compression_dcn)
+                m.compression_dcn, m.priority)
 
     def lookup(self, m: RequestMeta) -> Optional[int]:
         """Bit of a cached identical request, or None (a changed shape/
@@ -488,12 +495,13 @@ class ResponseCache:
             return None
         ident = self._slots[name][1]
         (op, dtype, itemsize, shape, average, root, prescale, nbytes,
-         compression, compression_dcn) = ident
+         compression, compression_dcn, priority) = ident
         return RequestMeta(name=name, op=op, dtype=dtype,
                            itemsize=itemsize, shape=shape, average=average,
                            root_rank=root, prescale=prescale,
                            nbytes=nbytes, compression=compression,
-                           compression_dcn=compression_dcn)
+                           compression_dcn=compression_dcn,
+                           priority=priority)
 
     def wire_len(self, bit: int) -> int:
         name = self._names.get(bit)
@@ -600,7 +608,8 @@ def _fingerprint(m: RequestMeta):
     shape = m.shape[1:] if m.op == "allgather" else m.shape
     dim0 = ("*",) if m.op == "allgather" else ()
     return (m.op, m.dtype, m.itemsize, dim0 + tuple(shape), m.average,
-            m.root_rank, m.prescale, m.compression, m.compression_dcn)
+            m.root_rank, m.prescale, m.compression, m.compression_dcn,
+            m.priority)
 
 
 def _mismatch_message(name: str, metas: Dict[int, RequestMeta]) -> str:
@@ -634,6 +643,13 @@ def _mismatch_message(name: str, metas: Dict[int, RequestMeta]) -> str:
                              "HVD_COMPRESSION_DCN / compression_dcn "
                              "identically on every process)",
                              a.compression_dcn, b.compression_dcn)
+        elif a.priority != b.priority:
+            # Mixed priority classes would compose different fused
+            # batches and drain in different orders across the world —
+            # same fail-fast contract as the wire policies above.
+            field, va, vb = ("priority classes (set HVD_PRIORITY / the "
+                             "per-request priority identically on every "
+                             "process)", a.priority, b.priority)
         elif a.average != b.average or a.prescale != b.prescale:
             field, va, vb = ("reduction options",
                              (a.average, a.prescale), (b.average, b.prescale))
@@ -648,18 +664,22 @@ def _mismatch_message(name: str, metas: Dict[int, RequestMeta]) -> str:
 
 def _fuse_names(ready: Sequence[RequestMeta],
                 fusion_threshold: int) -> List[List[str]]:
-    """Group ready requests for execution: lexicographic name order,
-    allreduces fused per (dtype, average, prescale) up to the threshold.
-    Pure + deterministic — shared by ``decide`` (full rounds) and the
-    response-cache fast path (which memoizes the result)."""
+    """Group ready requests for execution: (priority, name) order —
+    lower class codes drain first, lexicographic names within a class —
+    with allreduces fused per (priority, dtype, average, prescale) up
+    to the threshold, so fused batches stay priority-uniform. Pure +
+    deterministic — shared by ``decide`` (full rounds) and the
+    response-cache fast path (which memoizes the result). Deadline
+    margin is deliberately NOT in this shared key: it is clock-local
+    and would diverge across processes."""
     name_groups: List[List[str]] = []
     open_groups: Dict[tuple, List[str]] = {}
     open_bytes: Dict[tuple, int] = {}
-    for m in sorted(ready, key=lambda m: m.name):
+    for m in sorted(ready, key=lambda m: (m.priority, m.name)):
         if m.op != "allreduce" or fusion_threshold <= 0:
             name_groups.append([m.name])
             continue
-        key = (m.dtype, m.average, m.prescale, m.compression,
+        key = (m.priority, m.dtype, m.average, m.prescale, m.compression,
                m.compression_dcn)
         g = open_groups.get(key)
         if g is not None and open_bytes[key] + m.nbytes <= fusion_threshold:

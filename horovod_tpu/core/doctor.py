@@ -44,6 +44,7 @@ from horovod_tpu.core import telemetry as tele
 VERDICT_KINDS = (
     "dead_peer",           # a missing rank has an elastic death note
     "draining",            # a missing/quiesced rank is deliberately draining
+    "overload",            # a rank's admission budget is tripped (serving plane)
     "missing_submitter",   # tensor + the exact ranks that never announced it
     "metadata_mismatch",   # per-rank shape/dtype/wire skew on one name
     "slow_executor",       # phase age far beyond the phase-latency median
@@ -107,6 +108,18 @@ def _draining_reason() -> Optional[str]:
         return None
 
 
+def _admission_state() -> Optional[dict]:
+    """This rank's serving-plane admission snapshot (both engines
+    produce the same shape via core/engine.py build_admission_summary),
+    or None before any engine exists."""
+    try:
+        from horovod_tpu.core import engine as _eng
+
+        return _eng.admission_summary()
+    except Exception:  # pragma: no cover - defensive
+        return None
+
+
 def _kv_failovers() -> int:
     try:
         return int(tele.REGISTRY.counter("world.kv_failovers").snapshot())
@@ -148,6 +161,7 @@ def local_snapshot(table: List[dict], rank: Optional[int] = None,
                    if reason is not None else None),
         "entries": list(table or []),
         "draining": _draining_reason(),
+        "admission": _admission_state(),
         "kv_failovers": _kv_failovers(),
         "exec_median_us": _exec_median_us(),
     }
@@ -304,6 +318,24 @@ def classify(snaps: List[dict], nproc: Optional[int] = None,
             findings.append({
                 "kind": "draining", "tensor": None, "ranks": [r],
                 "detail": f"rank {r} is draining: {why}"})
+    # overload: a rank whose admission budget is tripped right now — the
+    # engine there is load-shedding, so a peer waiting on its submission
+    # sees a stall that is really serving-plane saturation. The verdict
+    # names the class and the budget so the fix is one knob away.
+    for r, s in sorted(by_rank.items()):
+        adm = s.get("admission") or {}
+        trip = adm.get("tripped")
+        if trip:
+            cls = trip.get("cls")
+            info = (adm.get("classes") or {}).get(cls) or {}
+            findings.append({
+                "kind": "overload", "tensor": None, "ranks": [r],
+                "detail": f"rank {r} is overloaded: priority class "
+                          f"'{cls}' tripped its {trip.get('budget')} "
+                          f"admission budget "
+                          f"({info.get('inflight')} in flight, queue "
+                          f"depth {adm.get('queue_depth')}) — new "
+                          "submits in that class are being rejected"})
     # slow_executor: an exec-phase entry far beyond the local median.
     for r, s in sorted(by_rank.items()):
         median = s.get("exec_median_us")
@@ -450,6 +482,7 @@ def diagnose_dumps(paths: List[str]) -> dict:
             "reason": payload.get("reason"),
             "entries": payload.get("inspect") or [],
             "draining": None,
+            "admission": None,
             "kv_failovers": int(telem.get("world.kv_failovers", 0)),
             "exec_median_us": None,
         })

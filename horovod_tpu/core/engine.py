@@ -55,6 +55,16 @@ ENGINE_WIRE_POLICIES = ("none", "int8", "fp8")
 WIRE_CODES = {name: i for i, name in enumerate(ENGINE_WIRE_POLICIES)}
 WIRE_NAMES = {i: name for name, i in WIRE_CODES.items()}
 
+# Priority classes on the submit plane (the serving-plane subsystem):
+# codes are the `priority` field of the C ABI (hvdcore.cc hvd_request) —
+# a LOWER code drains first, so the tuple order below IS the drain
+# order. The cycle loop composes fused batches and drains ready work in
+# (priority, deadline-margin, name) order; admission budgets
+# (HVD_ADMISSION_MAX_*) are accounted per class.
+PRIORITY_CLASSES = ("high", "normal", "low")
+PRIORITY_CODES = {name: i for i, name in enumerate(PRIORITY_CLASSES)}
+PRIORITY_NAMES = {i: name for name, i in PRIORITY_CODES.items()}
+
 # Per-entry introspection record shape (``Engine.inspect`` /
 # ``NativeEngine.inspect`` / the ``hvd_engine_inspect`` C ABI): key names
 # AND their order are machine-diffed against the C++ Inspect writer by
@@ -65,7 +75,7 @@ WIRE_NAMES = {i: name for name, i in WIRE_CODES.items()}
 # vocabulary lint (hvdcheck parity-span-args).
 ENGINE_INSPECT_KEYS = (
     "name", "op", "phase", "phase_age_us", "bytes", "dtype", "wire",
-    "batch_n", "deadline_remaining_us", "round",
+    "batch_n", "priority", "deadline_remaining_us", "round",
 )
 
 
@@ -185,6 +195,18 @@ class ShutdownError(EngineError):
     SHUT_DOWN_ERROR, operations.cc:1833-1848)."""
 
 
+class AdmissionRejected(EngineError):
+    """The serving-plane admission controller rejected this submit
+    SYNCHRONOUSLY at the boundary: the request's priority class is at
+    its in-flight budget (HVD_ADMISSION_MAX_INFLIGHT /
+    HVD_ADMISSION_MAX_BYTES), or the deadline-aware fast-fail shed it
+    because its remaining deadline is provably smaller than the current
+    p50 queue+negotiate latency. Nothing was admitted — no handle, no
+    queue state, no peer announcement — so the caller may retry,
+    degrade, or drop; in-flight work is NEVER rejected mid-flight and a
+    fused batch is never torn (the cancel doctrine)."""
+
+
 class CollectiveTimeout(EngineError):
     """A per-request deadline fired before the collective completed. The
     message names the PHASE the entry was stuck in (QUEUE / NEGOTIATE /
@@ -219,6 +241,95 @@ def collective_deadline_from_env() -> Optional[float]:
             f"bad HVD_COLLECTIVE_DEADLINE_S {raw!r} on {_process_str()}: "
             "want seconds (a float)") from None
     return val if val > 0 else None
+
+
+def resolve_priority(priority, name: str = "") -> int:
+    """Normalize a priority-class spelling (or its integer code) to the
+    code, failing FAST with rank attribution on unknown values — the
+    same contract as :func:`resolve_wire_policy`. ``None`` means
+    'normal' (callers that defer to the engine default resolve
+    HVD_PRIORITY themselves via :func:`priority_from_env`)."""
+    if priority is None:
+        return PRIORITY_CODES["normal"]
+    if isinstance(priority, (int, np.integer)) \
+            and int(priority) in PRIORITY_NAMES:
+        return int(priority)
+    val = str(priority).lower()
+    if val in PRIORITY_CODES:
+        return PRIORITY_CODES[val]
+    raise EngineError(
+        f"unknown priority class {priority!r}"
+        + (f" for '{name}'" if name else "")
+        + f" on {_process_str()}: expected one of "
+        f"{list(PRIORITY_CLASSES)} (or a code 0/1/2)")
+
+
+def priority_from_env() -> int:
+    """HVD_PRIORITY: the engine-wide default priority class for submits
+    that name none ('normal' when unset). Misspellings fail fast at
+    engine construction."""
+    raw = (os.environ.get("HVD_PRIORITY")
+           or os.environ.get("HOROVOD_PRIORITY") or "").strip()
+    return resolve_priority(raw or None)
+
+
+def _admission_limit(env: str, cls: str) -> int:
+    """One per-class admission budget: ``{env}_{CLS}`` overrides the
+    class-wide ``{env}``; unset/empty/0 means unlimited."""
+    key = f"{env}_{cls.upper()}"
+    raw = (os.environ.get(key) or os.environ.get(env) or "").strip()
+    if not raw:
+        return 0
+    try:
+        val = int(raw)
+    except ValueError:
+        raise EngineError(
+            f"bad {key if os.environ.get(key) else env} {raw!r} on "
+            f"{_process_str()}: want an integer (0 = unlimited)"
+        ) from None
+    return max(val, 0)
+
+
+def admission_from_env():
+    """HVD_ADMISSION_MAX_INFLIGHT / HVD_ADMISSION_MAX_BYTES: bounded
+    per-class queue budgets for the serving plane (admission control).
+    Each knob is the default for EVERY class; ``_HIGH`` / ``_NORMAL`` /
+    ``_LOW`` suffixes override one class. 0/unset = unlimited (the
+    historical behavior). Returns (max_inflight, max_bytes) as lists
+    ordered like PRIORITY_CLASSES — shared by both engines; the native
+    engine pushes the arrays through ``hvd_engine_set_admission`` at
+    construction so its lock-free submit path enforces the same
+    budgets."""
+    mi = [_admission_limit("HVD_ADMISSION_MAX_INFLIGHT", c)
+          for c in PRIORITY_CLASSES]
+    mb = [_admission_limit("HVD_ADMISSION_MAX_BYTES", c)
+          for c in PRIORITY_CLASSES]
+    return mi, mb
+
+
+# Deadline-aware shedding engages only once the phase histograms hold a
+# meaningful sample (a cold engine must not shed on startup noise).
+SHED_MIN_SAMPLES = 8
+
+
+def queue_latency_estimate() -> Optional[float]:
+    """Current p50 queue (+ negotiate, when that phase has samples)
+    residency in seconds, read from the engine.phase.* histograms — the
+    deadline-aware fast-fail's shedding threshold. None until
+    SHED_MIN_SAMPLES observations exist, so a cold engine never
+    sheds."""
+    h = tele.REGISTRY.histogram("engine.phase.queue")
+    if h.count < SHED_MIN_SAMPLES:
+        return None
+    est = tele.quantile_from_buckets(h.bounds, h.counts, 0.5)
+    if est is None:
+        return None
+    hn = tele.REGISTRY.histogram("engine.phase.negotiate")
+    if hn.count >= SHED_MIN_SAMPLES:
+        neg = tele.quantile_from_buckets(hn.bounds, hn.counts, 0.5)
+        if neg is not None:
+            est += neg
+    return est
 
 
 @dataclass
@@ -263,6 +374,11 @@ class _Entry:
     # QUEUE/MEMCPY span args so the trace critical path can attribute a
     # batch's queue share per member, not N x.
     batch_n: int = 1
+    # Priority class code (PRIORITY_CODES; lower drains first). Joins
+    # the drain sort key, the fusion key and — in negotiated worlds —
+    # the request fingerprint, so batches stay priority-uniform and
+    # mixed-priority worlds for one tensor fail fast by name.
+    priority: int = 1
 
 
 class _Handle:
@@ -287,13 +403,15 @@ class SubmitRequest:
     module."""
 
     __slots__ = ("name", "tensor", "average", "root_rank", "prescale",
-                 "compression", "compression_dcn", "donate", "deadline_ms")
+                 "compression", "compression_dcn", "donate", "deadline_ms",
+                 "priority")
 
     def __init__(self, name: str, tensor, *, average: bool = False,
                  root_rank: int = 0, prescale: float = 1.0,
                  compression: Optional[str] = None,
                  compression_dcn: Optional[str] = None, donate: bool = False,
-                 deadline_ms: Optional[float] = None):
+                 deadline_ms: Optional[float] = None,
+                 priority: Optional[str] = None):
         self.name = name
         self.tensor = tensor
         self.average = average
@@ -303,6 +421,7 @@ class SubmitRequest:
         self.compression_dcn = compression_dcn
         self.donate = donate
         self.deadline_ms = deadline_ms
+        self.priority = priority
 
 
 class JaxExecutor:
@@ -774,21 +893,130 @@ def record_phase(cls: str, seconds: float):
 
 
 def record_complete_latency(op: str, latency_s: float,
-                            margin_s: Optional[float] = None):
+                            margin_s: Optional[float] = None,
+                            priority: Optional[int] = None):
     """End-to-end submit→complete latency of ONE engine collective, per
     op class, plus — when the request carried a deadline — the margin
     remaining at completion (clipped at 0: a deadline-fired entry that
-    completes late reports zero margin). Same parity contract as
-    :func:`record_phase`. The compiled/AOT hot path feeds nothing here
-    (hvd.jax.jit collectives stay uninstrumented — the bench headline's
-    standing rule)."""
+    completes late reports zero margin), plus — when a priority class
+    is given — the per-class serving-plane split
+    (engine.latency.class.*) the overload acceptance gate reads. Same
+    parity contract as :func:`record_phase`. The compiled/AOT hot path
+    feeds nothing here (hvd.jax.jit collectives stay uninstrumented —
+    the bench headline's standing rule)."""
     tele.REGISTRY.histogram(
         "engine.latency.allreduce" if op == "allreduce" else
         "engine.latency.allgather" if op == "allgather" else
         "engine.latency.broadcast").observe(latency_s)
+    if priority is not None:
+        tele.REGISTRY.histogram(
+            "engine.latency.class.high" if priority == 0 else
+            "engine.latency.class.low" if priority == 2 else
+            "engine.latency.class.normal").observe(latency_s)
     if margin_s is not None:
         tele.REGISTRY.histogram("engine.deadline.margin").observe(
             max(float(margin_s), 0.0))
+
+
+def record_admission_rejected(shed: bool = False):
+    """One admission-plane rejection. ``shed`` says the deadline-aware
+    fast-fail (remaining deadline < current p50 queue+negotiate
+    latency) rejected the request, rather than a class budget. Counter
+    names are the cross-engine parity contract — the native engine
+    feeds the SAME counters through its stats C API
+    (hvd_engine_stats.admission_rejected / admission_shed)."""
+    tele.REGISTRY.counter(
+        "engine.admission.shed" if shed
+        else "engine.admission.rejected").inc()
+
+
+def record_admission(inflight):
+    """Per-class in-flight gauges (ordered like PRIORITY_CLASSES) — the
+    saturation view /healthz, the doctor and the fleet console read.
+    The native engine calls this from its stats sync with its
+    ``admission_inflight_*`` stats fields."""
+    tele.REGISTRY.gauge("engine.admission.inflight.high").set(
+        int(inflight[0]))
+    tele.REGISTRY.gauge("engine.admission.inflight.normal").set(
+        int(inflight[1]))
+    tele.REGISTRY.gauge("engine.admission.inflight.low").set(
+        int(inflight[2]))
+
+
+# Reserved name prefix of the synthetic submits the engine.admit burst
+# fault injects — the injector skips its own names, so a burst can
+# never recurse.
+ADMIT_BURST_PREFIX = "_hvd.admit.burst."
+_admit_burst_seq = 0
+
+
+def admission_burst_inject(engine, name: str):
+    """Fault site ``engine.admit`` (mode ``burst``, core/faultline.py):
+    deterministically inject N synthetic LOW-priority 1-element
+    allreduces ahead of this submit, so admission/shedding behavior is
+    chaos-testable without the full load harness. Rejected synthetic
+    submits are swallowed (saturation rejecting the burst IS the
+    scenario under test); survivors carry a short deadline and are
+    retired by a daemon waiter, so they cannot wedge a negotiated world
+    where peers never announce them. Shared by both engines — called at
+    the top of the single-submit path (batched submits bypass it, like
+    the per-request shed check)."""
+    global _admit_burst_seq
+    if name.startswith(ADMIT_BURST_PREFIX):
+        return
+    burst = flt.engine_admit_burst()
+    if not burst:
+        return
+    handles = []
+    for _ in range(int(burst)):
+        _admit_burst_seq += 1
+        try:
+            handles.append(engine.allreduce_async(
+                f"{ADMIT_BURST_PREFIX}{os.getpid()}.{_admit_burst_seq}",
+                np.zeros(1, np.float32), False, deadline_ms=10000.0,
+                priority="low"))
+        except EngineError:
+            continue
+    if handles:
+        def _retire():
+            for h in handles:
+                try:
+                    engine.synchronize(h)
+                except EngineError:
+                    pass
+
+        threading.Thread(target=_retire, name="hvd-admit-burst",
+                         daemon=True).start()
+
+
+def build_admission_summary(queue_depth, inflight, inflight_bytes,
+                            max_inflight, max_bytes):
+    """The admission-state body BOTH engines hand to /healthz, the
+    doctor snapshot and the fleet console: queue depth, per-class
+    in-flight counts/bytes against their budgets, and which
+    class+budget is tripped (saturated). Built with ``dict(keyword=...)``
+    on purpose — dict literals in this module are swept by the
+    span-args vocabulary lint (hvdcheck parity-span-args)."""
+    classes = {}
+    saturated = []
+    tripped_first = None
+    for i, cls in enumerate(PRIORITY_CLASSES):
+        tripped = []
+        if max_inflight[i] > 0 and inflight[i] >= max_inflight[i]:
+            tripped.append("max_inflight")
+        if max_bytes[i] > 0 and inflight_bytes[i] >= max_bytes[i]:
+            tripped.append("max_bytes")
+        classes[cls] = dict(inflight=int(inflight[i]),
+                            inflight_bytes=int(inflight_bytes[i]),
+                            max_inflight=int(max_inflight[i]),
+                            max_bytes=int(max_bytes[i]),
+                            tripped=tripped)
+        if tripped:
+            saturated.append(cls)
+            if tripped_first is None:
+                tripped_first = dict(cls=cls, budget=tripped[0])
+    return dict(queue_depth=int(queue_depth), classes=classes,
+                saturated=saturated, tripped=tripped_first)
 
 
 def doctor_on_hang(reason, kind, table, rank):
@@ -868,6 +1096,15 @@ class Engine:
         self.default_deadline_s = collective_deadline_from_env()
         self._deadline_count = 0
         self._quiesced: Optional[str] = None
+        # Serving-plane admission control: the default priority class
+        # (HVD_PRIORITY) and the per-class in-flight budgets
+        # (HVD_ADMISSION_MAX_INFLIGHT / _MAX_BYTES with per-class
+        # overrides; 0 = unlimited), plus the per-class accounting the
+        # budgets are enforced against (guarded by self._lock).
+        self.priority_default = priority_from_env()
+        self.adm_max_inflight, self.adm_max_bytes = admission_from_env()
+        self._adm_inflight = [0] * len(PRIORITY_CLASSES)
+        self._adm_bytes = [0] * len(PRIORITY_CLASSES)
         self.timeline = timeline if timeline is not None else tl.from_env()
         if self.timeline.enabled:
             # Staging time feeds the WAIT_FOR_DATA spans; only measured
@@ -915,6 +1152,9 @@ class Engine:
     # operations.cc:2264-2380) ------------------------------------------------
 
     def _enqueue(self, entry: _Entry, mem_span=None) -> int:
+        # Fault site engine.admit (burst mode): synthetic low-priority
+        # submits land ahead of this one (a no-op without the fault).
+        admission_burst_inject(self, entry.name)
         # Fault site engine.submit (core/faultline.py): a failed submit
         # raises before any handle/queue state exists — same observable
         # shape as an organic enqueue rejection.
@@ -937,15 +1177,20 @@ class Engine:
                     f"a collective named '{entry.name}' is already pending; "
                     "names must be unique among in-flight tensors"
                 )
+            self._check_admission_locked(entry)
             h = _Handle(entry.name)
             entry.handle = self._next_handle
             self._next_handle += 1
             self._handles[entry.handle] = h
             self._pending_names[entry.name] = entry
+            self._adm_inflight[entry.priority] += 1
+            self._adm_bytes[entry.priority] += int(entry.tensor.nbytes)
+            adm = list(self._adm_inflight)
             if entry.deadline is not None:
                 self._deadline_count += 1
                 self._stall_kick.set()
             depth = len(self._pending_names)
+        record_admission(adm)
         record_submit(entry.op, entry.tensor.nbytes, depth)
         # Numerics (core/numerics.py): the local nonfinite count of the
         # SNAPSHOT is the attribution side of the synchronize-time check
@@ -965,6 +1210,49 @@ class Engine:
         self._queue.put(entry)
         self._wake.set()
         return entry.handle
+
+    def _check_admission_locked(self, entry: _Entry):
+        """Admission control (the serving-plane subsystem): reject a
+        submit SYNCHRONOUSLY when its priority class is at budget, and
+        shed a deadline'd submit whose remaining margin is provably
+        smaller than the current p50 queue+negotiate latency — instead
+        of letting it rot in QUEUE past its deadline. Rejection happens
+        at the submit boundary ONLY: never mid-flight, never tearing a
+        fused batch (the cancel doctrine). Runs under the engine lock;
+        raises :class:`AdmissionRejected`."""
+        cls = entry.priority
+        limit = self.adm_max_inflight[cls]
+        blimit = self.adm_max_bytes[cls]
+        nbytes = int(entry.tensor.nbytes)
+        if limit > 0 and self._adm_inflight[cls] + 1 > limit:
+            record_admission_rejected()
+            raise AdmissionRejected(
+                f"admission rejected for '{entry.name}' on "
+                f"{_process_str()}: priority class "
+                f"'{PRIORITY_NAMES[cls]}' is at its in-flight budget "
+                f"({self._adm_inflight[cls]}/{limit} requests, "
+                "HVD_ADMISSION_MAX_INFLIGHT); resubmit after in-flight "
+                "work completes, or raise the budget")
+        if blimit > 0 and self._adm_bytes[cls] + nbytes > blimit:
+            record_admission_rejected()
+            raise AdmissionRejected(
+                f"admission rejected for '{entry.name}' on "
+                f"{_process_str()}: priority class "
+                f"'{PRIORITY_NAMES[cls]}' is at its bytes budget "
+                f"({self._adm_bytes[cls]} in flight + {nbytes} > "
+                f"{blimit} bytes, HVD_ADMISSION_MAX_BYTES); resubmit "
+                "after in-flight work completes, or raise the budget")
+        if entry.deadline is not None:
+            est = queue_latency_estimate()
+            if (est is not None
+                    and entry.deadline - time.monotonic() < est):
+                record_admission_rejected(shed=True)
+                raise AdmissionRejected(
+                    f"shed '{entry.name}' on {_process_str()}: its "
+                    "remaining deadline is smaller than the current "
+                    f"p50 queue+negotiate latency ({est * 1e3:.1f} ms) "
+                    "— it would expire in QUEUE (deadline-aware "
+                    "fast-fail; counted in engine.admission.shed)")
 
     # Submit-time SNAPSHOT (pool-slab copy — np.array before the pool):
     # the C++ engine memcpys at enqueue (hvdcore.cc), so a caller
@@ -1009,12 +1297,18 @@ class Engine:
             return time.monotonic() + self.default_deadline_s
         return None
 
+    def _priority(self, priority, name: str) -> int:
+        """Per-request priority class (None defers to HVD_PRIORITY)."""
+        return (resolve_priority(priority, name)
+                if priority is not None else self.priority_default)
+
     def allreduce_async(self, name: str, tensor: np.ndarray, average: bool,
                         prescale: float = 1.0,
                         compression: Optional[str] = None,
                         compression_dcn: Optional[str] = None,
                         donate: bool = False,
-                        deadline_ms: Optional[float] = None) -> int:
+                        deadline_ms: Optional[float] = None,
+                        priority: Optional[str] = None) -> int:
         # `compression` is the per-request engine wire policy (frontend
         # Compression objects carry it as .engine_wire); None defers to
         # the HVD_COMPRESSION default. `compression_dcn` is the per-TIER
@@ -1026,31 +1320,39 @@ class Engine:
                     if compression_dcn is not None
                     else self.wire_dcn_default)
         check_wire_exclusive(wire, wire_dcn, name)
+        prio = self._priority(priority, name)
         snap, donated, flipped, span = self._snapshot(tensor, donate)
         return self._submit(
             _Entry(-1, name, "allreduce", snap, average=average,
                    prescale=prescale, compression=wire,
                    compression_dcn=wire_dcn, donated=donated,
-                   deadline=self._abs_deadline(deadline_ms)),
+                   deadline=self._abs_deadline(deadline_ms),
+                   priority=prio),
             span, flipped)
 
     def allgather_async(self, name: str, tensor: np.ndarray,
                         donate: bool = False,
-                        deadline_ms: Optional[float] = None) -> int:
+                        deadline_ms: Optional[float] = None,
+                        priority: Optional[str] = None) -> int:
+        prio = self._priority(priority, name)
         snap, donated, flipped, span = self._snapshot(tensor, donate)
         return self._submit(
             _Entry(-1, name, "allgather", snap, donated=donated,
-                   deadline=self._abs_deadline(deadline_ms)),
+                   deadline=self._abs_deadline(deadline_ms),
+                   priority=prio),
             span, flipped)
 
     def broadcast_async(self, name: str, tensor: np.ndarray, root_rank: int,
                         donate: bool = False,
-                        deadline_ms: Optional[float] = None) -> int:
+                        deadline_ms: Optional[float] = None,
+                        priority: Optional[str] = None) -> int:
+        prio = self._priority(priority, name)
         snap, donated, flipped, span = self._snapshot(tensor, donate)
         return self._submit(
             _Entry(-1, name, "broadcast", snap, root_rank=root_rank,
                    donated=donated,
-                   deadline=self._abs_deadline(deadline_ms)),
+                   deadline=self._abs_deadline(deadline_ms),
+                   priority=prio),
             span, flipped)
 
     def submit_n(self, op: str, requests) -> List[int]:
@@ -1106,11 +1408,15 @@ class Engine:
                               if r.compression_dcn is not None
                               else self.wire_dcn_default))
             check_wire_exclusive(wire, wire_dcn, r.name)
-            wires.append((wire, wire_dcn))
+            # Priority resolves here too — a bad spelling must reject
+            # the batch before any buffer is frozen.
+            wires.append((wire, wire_dcn,
+                          self._priority(getattr(r, "priority", None),
+                                         r.name)))
         entries: List[_Entry] = []
         spans = []
         flipped: List[np.ndarray] = []
-        for r, (wire, wire_dcn) in zip(reqs, wires):
+        for r, (wire, wire_dcn, prio) in zip(reqs, wires):
             t0 = self.timeline.now_us()
             a = np.asarray(r.tensor)
             if r.donate and a.flags["C_CONTIGUOUS"]:
@@ -1128,7 +1434,8 @@ class Engine:
                 -1, r.name, op, snap, average=r.average,
                 root_rank=r.root_rank, prescale=r.prescale,
                 compression=wire, compression_dcn=wire_dcn, donated=donated,
-                deadline=self._abs_deadline(r.deadline_ms), batch_n=n))
+                deadline=self._abs_deadline(r.deadline_ms), batch_n=n,
+                priority=prio))
         dup_failed = []
         handles: List[int] = []
         with self._lock:
@@ -1143,6 +1450,37 @@ class Engine:
                     f"engine is draining ({self._quiesced}): submissions "
                     "are closed — the engine is completing in-flight "
                     "work before shutdown (quiesce)")
+            # Whole-batch admission pre-check, all-or-nothing: a
+            # batched submit over budget rejects synchronously BEFORE
+            # any handle exists — admission never tears a batch (the
+            # per-request shed fast-fail stays single-submit-only; same
+            # rule as the C++ EnqueueN pre-check).
+            need_n = [0] * len(PRIORITY_CLASSES)
+            need_b = [0] * len(PRIORITY_CLASSES)
+            for e in entries:
+                need_n[e.priority] += 1
+                need_b[e.priority] += int(e.tensor.nbytes)
+            for cls in range(len(PRIORITY_CLASSES)):
+                limit = self.adm_max_inflight[cls]
+                blimit = self.adm_max_bytes[cls]
+                if ((limit > 0
+                     and self._adm_inflight[cls] + need_n[cls] > limit)
+                        or (blimit > 0
+                            and self._adm_bytes[cls] + need_b[cls]
+                            > blimit)):
+                    for a in flipped:
+                        a.flags.writeable = True
+                    record_admission_rejected()
+                    raise AdmissionRejected(
+                        f"admission rejected for a batched submit of "
+                        f"{n} on {_process_str()}: priority class "
+                        f"'{PRIORITY_NAMES[cls]}' is over budget "
+                        f"({self._adm_inflight[cls]} in flight + "
+                        f"{need_n[cls]} requested, "
+                        "HVD_ADMISSION_MAX_INFLIGHT / "
+                        "HVD_ADMISSION_MAX_BYTES); the batch is "
+                        "rejected whole — admission never tears a "
+                        "fused batch")
             for e in entries:
                 h = _Handle(e.name)
                 e.handle = self._next_handle
@@ -1155,10 +1493,14 @@ class Engine:
                     dup_failed.append((e, h))
                     continue
                 self._pending_names[e.name] = e
+                self._adm_inflight[e.priority] += 1
+                self._adm_bytes[e.priority] += int(e.tensor.nbytes)
                 if e.deadline is not None:
                     self._deadline_count += 1
                     self._stall_kick.set()
+            adm = list(self._adm_inflight)
             depth = len(self._pending_names)
+        record_admission(adm)
         # All N requests count as submitted — the native engine cannot
         # know at submit which will dup-fail at its async fold, so the
         # python twin counts identically to keep the counters parable.
@@ -1405,11 +1747,24 @@ class Engine:
                     dtype=str(e.tensor.dtype),
                     wire=e.compression,
                     batch_n=int(e.batch_n),
+                    priority=PRIORITY_NAMES.get(e.priority, "normal"),
                     deadline_remaining_us=(
                         None if e.deadline is None
                         else int((e.deadline - now) * 1e6)),
                     round=rnd))
         return out
+
+    def admission_summary(self) -> dict:
+        """Queue depth + per-class admission state for /healthz, the
+        doctor snapshot and the fleet console (shared shape with the
+        native engine via :func:`build_admission_summary`)."""
+        with self._lock:
+            inflight = list(self._adm_inflight)
+            nbytes = list(self._adm_bytes)
+            depth = len(self._pending_names)
+        return build_admission_summary(depth, inflight, nbytes,
+                                       self.adm_max_inflight,
+                                       self.adm_max_bytes)
 
     def set_params(self, cycle_time_s: Optional[float] = None,
                    fusion_threshold: Optional[int] = None):
@@ -1493,7 +1848,8 @@ class Engine:
                 root_rank=e.root_rank, prescale=e.prescale,
                 age_s=now - e.enqueued_at, nbytes=e.tensor.nbytes,
                 compression=e.compression,
-                compression_dcn=e.compression_dcn)
+                compression_dcn=e.compression_dcn,
+                priority=e.priority)
             for e in self._negotiating
         ]
         t_neg = time.monotonic()
@@ -1581,31 +1937,49 @@ class Engine:
             self._negotiated_cycle(entries)
             return
         entries = self._cull(entries)  # cancelled/overdue: retire locally
-        if len(entries) > 1 and _multi_controller():
-            # Fallback (negotiation disabled/unavailable): sort each
-            # drained cycle by name so thread-racy enqueue order within a
-            # cycle cannot diverge across processes. This is per-cycle
-            # only — drain-boundary skew can still split a batch
-            # differently on different processes, so this mode requires a
-            # single enqueue thread with identical program order (the
-            # negotiated path has no such requirement).
-            entries.sort(key=lambda e: e.name)
+        if len(entries) > 1:
+            if _multi_controller():
+                # Fallback (negotiation disabled/unavailable): sort each
+                # drained cycle by (priority, name) so thread-racy
+                # enqueue order within a cycle cannot diverge across
+                # processes. Deadline margin is deliberately NOT in this
+                # key — it is clock-local and would diverge. This is
+                # per-cycle only — drain-boundary skew can still split a
+                # batch differently on different processes, so this mode
+                # requires a single enqueue thread with identical
+                # program order (the negotiated path has no such
+                # requirement).
+                entries.sort(key=lambda e: (e.priority, e.name))
+            else:
+                # Single controller: drain in (priority, deadline
+                # margin, name) order, so latency-sensitive serving
+                # work overtakes bulk training traffic sharing the
+                # cycle and tight deadlines run first within a class.
+                now = time.monotonic()
+                entries.sort(key=lambda e: (
+                    e.priority,
+                    e.deadline - now if e.deadline is not None
+                    else float("inf"),
+                    e.name))
         if entries and self._param_manager is not None:
             # One update per engine cycle with that cycle's traffic — the
             # manager's scoring window contract (parameter_manager.cc
             # scores bytes per cycle tick).
             self._param_manager.update(sum(e.tensor.nbytes for e in entries))
         if entries:
-            # Fuse allreduces per (dtype, average) in request order up to the
-            # threshold (reference: operations.cc:2035-2074); other ops run
-            # singly in order.
+            # Fuse allreduces per (priority, dtype, average) in drain
+            # order up to the threshold (reference: operations.cc:
+            # 2035-2074); other ops run singly in order. Priority joins
+            # the key so fused batches stay priority-uniform — a batch
+            # is scheduled at its own class, never dragging high-class
+            # work behind bulk traffic (or vice versa).
             batch: list[_Entry] = []
             batch_key = None
             batch_bytes = 0
             for e in entries:
                 if e.op == "allreduce":
-                    key = (e.tensor.dtype, e.average, e.compression,
-                           e.compression_dcn)
+                    key = (e.priority, e.tensor.dtype, e.average,
+                           e.compression, e.compression_dcn)
                     if batch and (key != batch_key or
                                   batch_bytes + e.tensor.nbytes > self.fusion_threshold):
                         self._exec_allreduce_batch(batch)
@@ -1642,6 +2016,10 @@ class Engine:
                 # Per-tier DCN policy of the hierarchical route; same
                 # parity contract as `wire` above.
                 args["wire_dcn"] = e.compression_dcn
+            if e.priority != PRIORITY_CODES["normal"]:
+                # Serving-plane class attribution (no arg for the
+                # default class, like the wire policies above).
+                args["priority"] = PRIORITY_CLASSES[e.priority]
             self.timeline.start(e.name, tl.WAIT_FOR_DATA, ts_us=t0_us)
             self.timeline.end(e.name, tl.WAIT_FOR_DATA, ts_us=split)
             self.timeline.start(e.name, activity, args, ts_us=split)
@@ -1759,7 +2137,8 @@ class Engine:
         record_phase(_phase_class(e.phase), now - e.phase_since)
         record_complete_latency(
             e.op, now - e.enqueued_at,
-            None if e.deadline is None else e.deadline - now)
+            None if e.deadline is None else e.deadline - now,
+            e.priority)
         if e.cancelled and err is None:
             # Cooperative cancel: the result (if the entry executed —
             # post-agreement cancels complete cross-rank) is DISCARDED
@@ -1778,11 +2157,17 @@ class Engine:
             self._pending_names.pop(e.name, None)
             if e.deadline is not None and self._deadline_count > 0:
                 self._deadline_count -= 1
+            if self._adm_inflight[e.priority] > 0:
+                self._adm_inflight[e.priority] -= 1
+            self._adm_bytes[e.priority] = max(
+                0, self._adm_bytes[e.priority] - int(e.tensor.nbytes))
+            adm = list(self._adm_inflight)
             depth = len(self._pending_names)
             h = self._handles.get(e.handle)
         tele.REGISTRY.counter(
             "engine.errors" if err is not None else "engine.completed").inc()
         tele.REGISTRY.gauge("engine.queue_depth").set(depth)
+        record_admission(adm)
         # Release the snapshot slab BEFORE waking the waiter: the cycle
         # loop's local batch list is the last engine-side reference, and
         # a submit-then-wait caller's next enqueue must find the slab
@@ -1899,6 +2284,8 @@ class Engine:
             handles = list(self._handles.values())
             self._handles.clear()
             self._pending_names.clear()
+            self._adm_inflight = [0] * len(PRIORITY_CLASSES)
+            self._adm_bytes = [0] * len(PRIORITY_CLASSES)
         for h in handles:
             if not h.event.is_set():
                 h.error = ShutdownError(
@@ -1928,6 +2315,8 @@ class Engine:
             handles = list(self._handles.values())
             self._handles.clear()
             self._pending_names.clear()
+            self._adm_inflight = [0] * len(PRIORITY_CLASSES)
+            self._adm_bytes = [0] * len(PRIORITY_CLASSES)
         for h in handles:
             if not h.event.is_set():
                 h.error = ShutdownError("Horovod engine has been shut down")
@@ -1984,6 +2373,19 @@ def quiesce_drain(reason: str, deadline_s: float, already: bool,
     live in exactly one place. ``pending_names`` is each engine's view
     of its in-flight table; ``wake`` nudges an idle loop (a no-op for
     the C++ engine, whose loop ticks on its own)."""
+
+    def _shed_level() -> int:
+        # Work leaving the table WITHOUT completing (deadline expiry,
+        # cooperative cancel, admission shed) — sampled before/after
+        # the drain window so the report splits shed from drained.
+        # flat_counters() runs the registry syncs, so the native
+        # engine's stats fold in before each sample.
+        flat = tele.REGISTRY.flat_counters()
+        return int(flat.get("engine.deadline_exceeded", 0)
+                   + flat.get("engine.cancelled", 0)
+                   + flat.get("engine.admission.shed", 0))
+
+    shed0 = _shed_level()
     before = pending_names()
     tele.REGISTRY.gauge("engine.draining").set(1)
     try:
@@ -2001,7 +2403,8 @@ def quiesce_drain(reason: str, deadline_s: float, already: bool,
     drained = [n for n in before if n not in pending]
     report = dict(reason=reason, drained=drained,
                   still_pending=pending,
-                  deadline_hit=bool(pending), already=already)
+                  deadline_hit=bool(pending), already=already,
+                  shed=max(0, _shed_level() - shed0))
     if pending:
         LOG.warning(
             "engine quiesce: drained %d of %d in-flight collective(s)"
@@ -2028,6 +2431,21 @@ def quiesce_engine(deadline_s: float,
         return e.quiesce(deadline_s, reason=reason)
     except Exception:
         LOG.warning("engine quiesce failed", exc_info=True)
+        return None
+
+
+def admission_summary():
+    """Admission/saturation snapshot of the engine singleton, or None
+    when no engine was ever built — the /healthz serving-plane body
+    (queue depth, per-class in-flight vs budgets, tripped class)."""
+    with _engine_lock:
+        e = _engine
+    if e is None:
+        return None
+    try:
+        return e.admission_summary()
+    except Exception:
+        LOG.debug("admission summary failed", exc_info=True)
         return None
 
 

@@ -43,6 +43,10 @@ Sites and modes::
     hb.beat      skip | freeze | vanish            heartbeat publish (skip/freeze stop
                                                    the counter; vanish deletes the key)
     engine.submit  fail                            *_async enqueue raises
+    engine.admit   burst(param=N)                  admission pressure: pile N synthetic
+                                                   low-priority 1-element submits onto
+                                                   the queue ahead of this submit, so
+                                                   class budgets saturate on demand
     engine.exec    stall(param=s) | poison | error  executor call (poison = NaN result)
     engine.pool    exhausted                       buffer-pool checkout behaves as if
                                                    the resident cap were reached (fresh
@@ -73,8 +77,8 @@ LOG = logging.getLogger("horovod_tpu.faultline")
 
 #: The valid injection sites (parse errors name this list).
 SITES = ("kv.get", "kv.set", "kv.try_get", "hb.beat",
-         "engine.submit", "engine.exec", "engine.pool", "ckpt.write",
-         "preempt.signal")
+         "engine.submit", "engine.admit", "engine.exec", "engine.pool",
+         "ckpt.write", "preempt.signal")
 
 _MODES = {
     "kv.get": ("delay", "error"),
@@ -82,6 +86,7 @@ _MODES = {
     "kv.try_get": ("delay", "vanish"),
     "hb.beat": ("skip", "freeze", "vanish"),
     "engine.submit": ("fail",),
+    "engine.admit": ("burst",),
     "engine.exec": ("stall", "poison", "error"),
     "engine.pool": ("exhausted",),
     "ckpt.write": ("torn",),
@@ -380,6 +385,21 @@ def engine_submit(name: str) -> Optional[str]:
     if f is None or f.mode != "fail":
         return None
     return f.describe() + f" tensor={name}"
+
+
+def engine_admit_burst() -> int:
+    """engine.admit site: how many synthetic low-priority submits to
+    pile onto the queue BEFORE the real submit is admitted (0 = site
+    quiet). The engines' single-submit paths call this through
+    ``core/engine.py admission_burst_inject`` so class budgets can be
+    driven to saturation deterministically."""
+    f = check("engine.admit")
+    if f is None or f.mode != "burst":
+        return 0
+    try:
+        return max(0, int(f.param))
+    except (TypeError, ValueError):
+        return 8
 
 
 def engine_exec(op: str) -> Optional[Fault]:

@@ -157,6 +157,16 @@ def local_snapshot(rank: Optional[int] = None, seq: int = 0,
         numerics = (h.get("numerics") or {}).get("verdicts")
     except Exception:  # pragma: no cover - defensive
         pass
+    # Serving-plane admission state (core/engine.py admission_summary,
+    # one shape for both engines): queue depth + per-class in-flight vs
+    # budget — the fleet console's saturation view rides the snapshot.
+    admission = None
+    try:
+        from horovod_tpu.core import engine as _eng
+
+        admission = _eng.admission_summary()
+    except Exception:  # pragma: no cover - defensive
+        pass
     # The hang doctor's latest attributed blame (core/doctor.py), in
     # compact form: the fleet console's blamed-tensor line rides the
     # ordinary snapshot plane — no extra keys, no extra reads.
@@ -184,6 +194,7 @@ def local_snapshot(rank: Optional[int] = None, seq: int = 0,
         "rings": rings,
         "health": health,
         "numerics": numerics,
+        "admission": admission,
         "doctor": doctor,
     }
 
@@ -286,6 +297,7 @@ def merge_snapshots(snaps: List[dict],
     step_last: Dict[int, Optional[float]] = {}
     sparkline: List[float] = []
     doctor: Optional[dict] = None
+    saturated_ranks: Dict[int, List[str]] = {}
     generation = epoch = 0
     for snap in snaps:
         rank = int(snap["rank"])
@@ -306,7 +318,11 @@ def merge_snapshots(snaps: List[dict],
             "pool_bytes": (snap.get("gauges") or {}).get(
                 "engine.pool.bytes_resident"),
             "step_s": step_last[rank],
+            "saturated": sorted((snap.get("admission") or {}).get(
+                "saturated") or []),
         }
+        if ranks[rank]["saturated"]:
+            saturated_ranks[rank] = ranks[rank]["saturated"]
         blame = snap.get("doctor")
         if blame and blame.get("kind") and (
                 doctor is None
@@ -339,6 +355,14 @@ def merge_snapshots(snaps: List[dict],
         if name.startswith("engine.phase.") and h["count"]:
             phases[name.split(".")[-1]] = dict(
                 count=h["count"], **_quantiles_us(bounds, h["counts"]))
+    # Per-priority-class completion latency (the serving-plane SLO
+    # view): merged exactly like the per-op histograms above.
+    classes = {}
+    for cls in ("high", "normal", "low"):
+        h = hists.get(f"engine.latency.class.{cls}")
+        if h and h["count"]:
+            classes[cls] = dict(count=h["count"], **_quantiles_us(
+                bounds, h["counts"]))
     margin = hists.get("engine.deadline.margin")
 
     gauges = {}
@@ -361,6 +385,7 @@ def merge_snapshots(snaps: List[dict],
         "ranks": {str(r): info for r, info in sorted(ranks.items())},
         "ops": ops,
         "phases": phases,
+        "classes": classes,
         "deadline": {
             "margin_p50_s": (
                 None if not (margin and margin["count"]) else round(
@@ -369,6 +394,19 @@ def merge_snapshots(snaps: List[dict],
             "exceeded": counters.get("engine.deadline_exceeded", 0),
             "cancelled": counters.get("engine.cancelled", 0),
             "ring_full": counters.get("engine.ring.full", 0),
+        },
+        # Serving-plane rollup: summed rejection/shed counters, the
+        # world in-flight per class (summed gauges), and which ranks are
+        # saturated right now (their classes at budget).
+        "admission": {
+            "rejected": counters.get("engine.admission.rejected", 0),
+            "shed": counters.get("engine.admission.shed", 0),
+            "inflight": {
+                cls: sum((gauges_per_rank.get(
+                    f"engine.admission.inflight.{cls}") or {}).values())
+                for cls in ("high", "normal", "low")},
+            "saturated_ranks": {str(r): cls for r, cls
+                                in sorted(saturated_ranks.items())},
         },
         "counters": counters,
         "gauges": gauges,

@@ -164,6 +164,9 @@ class HvdRequest(ctypes.Structure):
         # single-enqueue `donate` argument. Engine->executor requests
         # always carry 0 here.
         ("donate", ctypes.c_int),
+        # Priority class code (core/engine.py PRIORITY_CODES; lower
+        # drains first) — the serving-plane scheduling key.
+        ("priority", ctypes.c_int),
     ]
 
 
@@ -225,6 +228,17 @@ class HvdStats(ctypes.Structure):
         ("ring_full", ctypes.c_longlong),
         ("ring_spins", ctypes.c_longlong),
         ("pool_bound_hits", ctypes.c_longlong),
+        # Serving-plane admission control (engine.admission.* counter/
+        # gauge parity with the python engine): boundary rejections,
+        # deadline-aware sheds, and per-class in-flight counts.
+        ("admission_rejected", ctypes.c_longlong),
+        ("admission_shed", ctypes.c_longlong),
+        ("admission_inflight_high", ctypes.c_longlong),
+        ("admission_inflight_normal", ctypes.c_longlong),
+        ("admission_inflight_low", ctypes.c_longlong),
+        ("admission_bytes_high", ctypes.c_longlong),
+        ("admission_bytes_normal", ctypes.c_longlong),
+        ("admission_bytes_low", ctypes.c_longlong),
     ]
 
 
@@ -244,6 +258,11 @@ class HvdLatency(ctypes.Structure):
         ("phase_memcpy", ctypes.c_longlong * 13),
         ("phase_exec", ctypes.c_longlong * 13),
         ("deadline_margin", ctypes.c_longlong * 13),
+        # Per-priority-class serving-plane latency split
+        # (engine.latency.class.* histogram parity).
+        ("class_high", ctypes.c_longlong * 13),
+        ("class_normal", ctypes.c_longlong * 13),
+        ("class_low", ctypes.c_longlong * 13),
         ("allreduce_sum", ctypes.c_double),
         ("allgather_sum", ctypes.c_double),
         ("broadcast_sum", ctypes.c_double),
@@ -252,6 +271,9 @@ class HvdLatency(ctypes.Structure):
         ("phase_memcpy_sum", ctypes.c_double),
         ("phase_exec_sum", ctypes.c_double),
         ("deadline_margin_sum", ctypes.c_double),
+        ("class_high_sum", ctypes.c_double),
+        ("class_normal_sum", ctypes.c_double),
+        ("class_low_sum", ctypes.c_double),
     ]
 
 
@@ -295,8 +317,11 @@ def load_library():
         ctypes.c_void_p, ctypes.c_int, ctypes.c_char_p, ctypes.c_int,
         ctypes.c_int, ctypes.c_void_p, ctypes.POINTER(ctypes.c_longlong),
         ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_double,
-        ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_double,
-        ctypes.c_char_p]
+        ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_int,
+        ctypes.c_double, ctypes.c_char_p]
+    lib.hvd_engine_set_admission.argtypes = [
+        ctypes.c_void_p, ctypes.POINTER(ctypes.c_longlong),
+        ctypes.POINTER(ctypes.c_longlong)]
     lib.hvd_engine_enqueue_n.restype = ctypes.c_int
     lib.hvd_engine_enqueue_n.argtypes = [
         ctypes.c_void_p, ctypes.POINTER(HvdRequest), ctypes.c_int,

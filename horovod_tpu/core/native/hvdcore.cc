@@ -29,6 +29,7 @@
 #include <cstring>
 #include <deque>
 #include <fstream>
+#include <limits>
 #include <map>
 #include <memory>
 #include <mutex>
@@ -138,6 +139,12 @@ struct hvd_request {
   // requests always carry 0 here (donated inputs reach the data plane
   // through the data/out split instead).
   int donate;
+  // Priority class code (PRIORITY_CODES in core/engine.py; lower drains
+  // first). The serving-plane scheduling key: the cycle loop sorts ready
+  // work by (priority, margin, name), fusion only merges equal-priority
+  // entries, and admission budgets are accounted per class. Opaque to
+  // the data plane beyond the negotiation row and timeline args.
+  int priority;
 };
 
 struct hvd_result {
@@ -234,6 +241,17 @@ struct hvd_engine_stats {
   long long ring_full;
   long long ring_spins;
   long long pool_bound_hits;
+  // Serving-plane admission control (engine.admission.* counter/gauge
+  // parity with the python engine): boundary rejections at submit,
+  // deadline-aware sheds, and per-class in-flight entry counts.
+  long long admission_rejected;
+  long long admission_shed;
+  long long admission_inflight_high;
+  long long admission_inflight_normal;
+  long long admission_inflight_low;
+  long long admission_bytes_high;
+  long long admission_bytes_normal;
+  long long admission_bytes_low;
 };
 
 // Latency histogram bucket boundaries in seconds. MUST equal
@@ -261,6 +279,9 @@ struct hvd_engine_latency {
   long long phase_memcpy[13];     // engine.phase.memcpy (s)
   long long phase_exec[13];       // engine.phase.exec (s)
   long long deadline_margin[13];  // engine.deadline.margin (s, clipped >= 0)
+  long long class_high[13];       // engine.latency.class.high (s)
+  long long class_normal[13];     // engine.latency.class.normal (s)
+  long long class_low[13];        // engine.latency.class.low (s)
   double allreduce_sum;
   double allgather_sum;
   double broadcast_sum;
@@ -269,6 +290,9 @@ struct hvd_engine_latency {
   double phase_memcpy_sum;
   double phase_exec_sum;
   double deadline_margin_sum;
+  double class_high_sum;
+  double class_normal_sum;
+  double class_low_sum;
 };
 
 void* hvd_alloc(long long nbytes) { return malloc((size_t)nbytes); }
@@ -522,6 +546,24 @@ const char* OpName(int op) {
   }
 }
 
+// Priority class names by code (hvd_request.priority) — the inspect
+// records' `priority` field; mirrors PRIORITY_NAMES in core/engine.py
+// (0 high, 1 normal, 2 low; lower drains first).
+const char* PriorityName(int priority) {
+  switch (priority) {
+    case 0: return "high";
+    case 2: return "low";
+    default: return "normal";
+  }
+}
+
+// Clamp an hvd_request.priority code into the class table (the Python
+// submit plane validates; this is belt-and-braces for raw C callers so
+// admission accounting can never index out of bounds).
+int PriorityClass(int priority) {
+  return priority < 0 ? 0 : (priority > 2 ? 2 : priority);
+}
+
 // Pre-rendered args body for timeline events — dtype + shape (+ the wire
 // policy when one applies), the detail the reference writer records
 // (timeline.cc:98-188).
@@ -532,7 +574,7 @@ const char* OpName(int op) {
 // not — that convention is how the analyzer tells span-args keys apart
 // from wire-protocol keys when diffing the two engines' vocabularies.
 std::string TensorArgs(int dtype_num, const std::vector<long long>& shape,
-                       int wire = 0, int wire_dcn = 0) {
+                       int wire = 0, int wire_dcn = 0, int priority = 1) {
   std::string out = "\"dtype\": \"";
   out += DtypeName(dtype_num);
   out += "\", \"shape\": [";
@@ -549,6 +591,13 @@ std::string TensorArgs(int dtype_num, const std::vector<long long>& shape,
   if (const char* wd = WireName(wire_dcn)) {
     out += ", \"wire_dcn\": \"";
     out += wd;
+    out += "\"";
+  }
+  if (priority != 1) {
+    // Serving-plane class attribution (no arg for the default class,
+    // like the wire policies above) — same parity-span-args contract.
+    out += ", \"priority\": \"";
+    out += PriorityName(priority);
     out += "\"";
   }
   return out;
@@ -776,6 +825,9 @@ struct Entry {
   // instead of the shared buckets at completion).
   int batch_n = 1;
   bool bound = false;
+  // Priority class code (hvd_request.priority; lower drains first) —
+  // the cycle loop's primary sort key and the fusion compatibility key.
+  int priority = 1;
 
   const char* bytes() const { return ext ? ext : data.data(); }
 };
@@ -804,6 +856,9 @@ struct Pending {
   int dtype_num = 0;
   int wire = 0;
   int batch_n = 1;
+  // Priority class code, mirrored from the Entry so admission accounting
+  // can decrement the right class at completion and Inspect can name it.
+  int priority = 1;
 };
 
 // One hvd_engine_enqueue_n call's worth of fully-built entries, published
@@ -971,10 +1026,26 @@ class Engine {
     sort_by_name_ = on != 0;
   }
 
+  // Admission budgets per priority class (index = class code; 0 =
+  // unlimited; a null array leaves that budget family unchanged).
+  // Atomics, not mu_: the batched submit fast path reads them without
+  // the engine lock.
+  void SetAdmission(const long long* max_inflight,
+                    const long long* max_bytes) {
+    for (int i = 0; i < 3; ++i) {
+      if (max_inflight)
+        adm_max_inflight_[i].store(max_inflight[i],
+                                   std::memory_order_relaxed);
+      if (max_bytes)
+        adm_max_bytes_[i].store(max_bytes[i], std::memory_order_relaxed);
+    }
+  }
+
   long long Enqueue(int op, const char* name, int dtype_num, int itemsize,
                     const void* data, const long long* shape, int ndim,
                     int average, int root_rank, double prescale, int wire,
-                    int wire_dcn, int donate, double deadline_s, char* err) {
+                    int wire_dcn, int donate, int priority, double deadline_s,
+                    char* err) {
     std::unique_lock<std::mutex> lk(mu_);
     FoldRingLocked();  // duplicate check must see ring-published names
     if (shutdown_) {
@@ -990,6 +1061,56 @@ class Engine {
                "unique among in-flight tensors", name);
       return -1;
     }
+    // Admission control (serving plane; twin of _check_admission_locked
+    // in engine.py): a class at budget is rejected SYNCHRONOUSLY at the
+    // submit boundary — never mid-flight, never tearing a fused batch —
+    // and a deadline'd submit whose remaining margin is provably under
+    // the observed p50 queue+negotiate residency is shed up front
+    // instead of rotting in QUEUE. The lowercase 'admission'/'shed'
+    // markers are the binding's contract for mapping these errors onto
+    // AdmissionRejected.
+    int cls = PriorityClass(priority);
+    {
+      long long count = 1;
+      for (int i = 0; i < ndim; ++i) count *= shape[i];
+      long long nbytes = count * itemsize;
+      long long limit = adm_max_inflight_[cls].load(std::memory_order_relaxed);
+      long long blimit = adm_max_bytes_[cls].load(std::memory_order_relaxed);
+      if (limit > 0 &&
+          adm_inflight_[cls].load(std::memory_order_relaxed) + 1 > limit) {
+        admission_rejected_.fetch_add(1, std::memory_order_relaxed);
+        snprintf(err, 256,
+                 "admission rejected for '%s': priority class '%s' is at "
+                 "its in-flight budget (%lld requests, "
+                 "HVD_ADMISSION_MAX_INFLIGHT); resubmit after in-flight "
+                 "work completes, or raise the budget",
+                 name, PriorityName(cls), limit);
+        return -1;
+      }
+      if (blimit > 0 &&
+          adm_bytes_[cls].load(std::memory_order_relaxed) + nbytes > blimit) {
+        admission_rejected_.fetch_add(1, std::memory_order_relaxed);
+        snprintf(err, 256,
+                 "admission rejected for '%s': priority class '%s' is at "
+                 "its bytes budget (%lld bytes, HVD_ADMISSION_MAX_BYTES); "
+                 "resubmit after in-flight work completes, or raise the "
+                 "budget", name, PriorityName(cls), blimit);
+        return -1;
+      }
+      if (deadline_s > 0) {
+        double est = QueueLatencyEstimateLocked();
+        if (est >= 0 && deadline_s < est) {
+          admission_shed_.fetch_add(1, std::memory_order_relaxed);
+          snprintf(err, 256,
+                   "shed '%s': its remaining deadline is smaller than the "
+                   "current p50 queue+negotiate latency (%.1f ms) — it "
+                   "would expire in QUEUE (deadline-aware fast-fail; "
+                   "counted in engine.admission.shed)",
+                   name, est * 1e3);
+          return -1;
+        }
+      }
+    }
     Entry e;
     e.handle = next_handle_++;
     e.name = std::move(sname);
@@ -1001,6 +1122,7 @@ class Engine {
     e.wire = wire;
     e.wire_dcn = wire_dcn;
     e.prescale = prescale;
+    e.priority = cls;
     long long count = 1;
     for (int i = 0; i < ndim; ++i) count *= shape[i];
     e.nbytes = count * itemsize;
@@ -1032,6 +1154,7 @@ class Engine {
     p.dtype_num = e.dtype_num;
     p.wire = e.wire;
     p.batch_n = e.batch_n;
+    p.priority = e.priority;
     if (deadline_s > 0) {
       e.has_deadline = true;
       e.deadline = e.enqueued + std::chrono::duration_cast<Clock::duration>(
@@ -1047,6 +1170,10 @@ class Engine {
     pending_names_[e.name] = p;
     if (op >= 0 && op < 3) stats_.submitted[op]++;
     stats_.submitted_bytes += e.nbytes;
+    // Admission accounting: incremented once per admitted entry,
+    // decremented once at Stage (every completion path).
+    adm_inflight_[cls].fetch_add(1, std::memory_order_relaxed);
+    adm_bytes_[cls].fetch_add(e.nbytes, std::memory_order_relaxed);
     auto hs = std::make_shared<HandleState>();
     hs->pool = pool_;
     handles_[e.handle] = std::move(hs);
@@ -1108,6 +1235,64 @@ class Engine {
         }
       }
     }
+    // Whole-batch admission pre-check, all-or-nothing BEFORE any
+    // snapshot or handle is allocated: admission never tears a fused
+    // batch (the cancel doctrine), so a batch that would blow any class
+    // budget is rejected whole, synchronously. Check-then-add is two
+    // steps without mu_ (this is the lock-free fast path) — concurrent
+    // producers can overshoot a budget by one batch; budgets are
+    // backpressure, not hard caps. The in-flight reservation is
+    // released at Stage, or at AdmitEntryLocked's fail path for entries
+    // that never reach it.
+    {
+      long long need_n[3] = {0, 0, 0};
+      long long need_b[3] = {0, 0, 0};
+      for (int i = 0; i < n; ++i) {
+        int cls = PriorityClass(reqs[i].priority);
+        long long count = 1;
+        for (int d = 0; d < reqs[i].ndim; ++d) count *= reqs[i].shape[d];
+        need_n[cls]++;
+        need_b[cls] += count * reqs[i].itemsize;
+      }
+      for (int cls = 0; cls < 3; ++cls) {
+        if (!need_n[cls]) continue;
+        long long limit =
+            adm_max_inflight_[cls].load(std::memory_order_relaxed);
+        long long blimit =
+            adm_max_bytes_[cls].load(std::memory_order_relaxed);
+        if (limit > 0 &&
+            adm_inflight_[cls].load(std::memory_order_relaxed) +
+                    need_n[cls] > limit) {
+          admission_rejected_.fetch_add(1, std::memory_order_relaxed);
+          snprintf(err, 256,
+                   "admission rejected for this batched submit: %lld "
+                   "requests in priority class '%s' would exceed its "
+                   "in-flight budget (%lld requests, "
+                   "HVD_ADMISSION_MAX_INFLIGHT) — the batch is rejected "
+                   "whole; admission never tears a fused batch",
+                   need_n[cls], PriorityName(cls), limit);
+          return -1;
+        }
+        if (blimit > 0 &&
+            adm_bytes_[cls].load(std::memory_order_relaxed) +
+                    need_b[cls] > blimit) {
+          admission_rejected_.fetch_add(1, std::memory_order_relaxed);
+          snprintf(err, 256,
+                   "admission rejected for this batched submit: %lld "
+                   "bytes in priority class '%s' would exceed its bytes "
+                   "budget (%lld bytes, HVD_ADMISSION_MAX_BYTES) — the "
+                   "batch is rejected whole; admission never tears a "
+                   "fused batch",
+                   need_b[cls], PriorityName(cls), blimit);
+          return -1;
+        }
+      }
+      for (int cls = 0; cls < 3; ++cls) {
+        if (!need_n[cls]) continue;
+        adm_inflight_[cls].fetch_add(need_n[cls], std::memory_order_relaxed);
+        adm_bytes_[cls].fetch_add(need_b[cls], std::memory_order_relaxed);
+      }
+    }
     auto* b = new SubmitBatch;
     b->entries.reserve(n);
     b->handles.reserve(n);
@@ -1126,6 +1311,7 @@ class Engine {
       e.wire = r.wire;
       e.wire_dcn = r.wire_dcn;
       e.prescale = r.prescale;
+      e.priority = PriorityClass(r.priority);
       long long count = 1;
       for (int d = 0; d < r.ndim; ++d) count *= r.shape[d];
       e.nbytes = count * r.itemsize;
@@ -1322,6 +1508,9 @@ class Engine {
       const char* w = WireName(p.wire);
       rec += w ? w : "none";
       rec += "\",\"batch_n\":" + std::to_string(p.batch_n);
+      rec += ",\"priority\":\"";
+      rec += PriorityName(p.priority);
+      rec += "\"";
       if (p.has_deadline) {
         long long rem_us = (long long)(
             std::chrono::duration<double>(p.deadline - now).count() * 1e6);
@@ -1349,6 +1538,19 @@ class Engine {
     }
     out->ring_full = ring_full_.load(std::memory_order_relaxed);
     out->ring_spins = ring_spins_.load(std::memory_order_relaxed);
+    out->admission_rejected =
+        admission_rejected_.load(std::memory_order_relaxed);
+    out->admission_shed = admission_shed_.load(std::memory_order_relaxed);
+    out->admission_inflight_high =
+        adm_inflight_[0].load(std::memory_order_relaxed);
+    out->admission_inflight_normal =
+        adm_inflight_[1].load(std::memory_order_relaxed);
+    out->admission_inflight_low =
+        adm_inflight_[2].load(std::memory_order_relaxed);
+    out->admission_bytes_high = adm_bytes_[0].load(std::memory_order_relaxed);
+    out->admission_bytes_normal =
+        adm_bytes_[1].load(std::memory_order_relaxed);
+    out->admission_bytes_low = adm_bytes_[2].load(std::memory_order_relaxed);
     pool_->Stats(&out->pool_hits, &out->pool_misses, &out->pool_checkouts,
                  &out->pool_bytes_resident, &out->pool_bound_hits);
   }
@@ -1390,15 +1592,50 @@ class Engine {
     ObserveInto(latency_.phase_memcpy, &latency_.phase_memcpy_sum, v);
   }
 
-  // End-to-end submit->complete latency per op class, mirroring
+  // End-to-end submit->complete latency per op class AND per priority
+  // class (the serving-plane engine.latency.class.* split), mirroring
   // record_complete_latency in engine.py.
-  void ObserveCompleteLocked(int op, double latency_s) {
+  void ObserveCompleteLocked(int op, double latency_s, int priority) {
     if (op == HVD_ALLGATHER)
       ObserveInto(latency_.allgather, &latency_.allgather_sum, latency_s);
     else if (op == HVD_BROADCAST)
       ObserveInto(latency_.broadcast, &latency_.broadcast_sum, latency_s);
     else
       ObserveInto(latency_.allreduce, &latency_.allreduce_sum, latency_s);
+    int cls = PriorityClass(priority);
+    if (cls == 0)
+      ObserveInto(latency_.class_high, &latency_.class_high_sum, latency_s);
+    else if (cls == 2)
+      ObserveInto(latency_.class_low, &latency_.class_low_sum, latency_s);
+    else
+      ObserveInto(latency_.class_normal, &latency_.class_normal_sum,
+                  latency_s);
+  }
+
+  // p50(queue) + p50(negotiate) from the phase-residency histograms —
+  // the shed gate's latency floor. Negative until the queue histogram
+  // holds 8+ samples (SHED_MIN_SAMPLES in engine.py: a cold engine
+  // never sheds); negotiate joins only once it has samples of its own.
+  // The estimate is the median bucket's upper edge — coarser than the
+  // python twin's log interpolation; only the shed counter vocabulary
+  // is parity-checked, not the estimate. Caller holds mu_.
+  double QueueLatencyEstimateLocked() {
+    double q = BucketP50(latency_.phase_queue);
+    if (q < 0) return -1.0;
+    double neg = BucketP50(latency_.phase_negotiate);
+    return neg < 0 ? q : q + neg;
+  }
+
+  static double BucketP50(const long long* counts) {
+    long long total = 0;
+    for (int i = 0; i < 13; ++i) total += counts[i];
+    if (total < 8) return -1.0;
+    long long half = (total + 1) / 2, cum = 0;
+    for (int i = 0; i < 12; ++i) {
+      cum += counts[i];
+      if (cum >= half) return kLatencyBucketsS[i];
+    }
+    return kLatencyBucketsS[11];  // median in the +Inf overflow bucket
   }
 
   void GetLatency(hvd_engine_latency* out) {
@@ -1486,6 +1723,12 @@ class Engine {
       stats_.errors++;
       hs->error = fail;
       hs->done = true;
+      // This entry never reaches Stage: release its EnqueueN-time
+      // admission reservation here.
+      adm_inflight_[PriorityClass(e.priority)].fetch_sub(
+          1, std::memory_order_relaxed);
+      adm_bytes_[PriorityClass(e.priority)].fetch_sub(
+          e.nbytes, std::memory_order_relaxed);
       std::string qargs;
       if (e.batch_n > 1)
         qargs = "\"batch_n\": " + std::to_string(e.batch_n);
@@ -1508,6 +1751,7 @@ class Engine {
     p.dtype_num = e.dtype_num;
     p.wire = e.wire;
     p.batch_n = e.batch_n;
+    p.priority = e.priority;
     if (e.has_deadline) {
       p.has_deadline = true;
       p.deadline = e.deadline;
@@ -1645,7 +1889,8 @@ class Engine {
       table += ",\"t\":" + std::to_string(SecondsSince(e.enqueued));
       table += ",\"b\":" + std::to_string(e.nbytes);
       table += ",\"w\":" + std::to_string(e.wire);
-      table += ",\"wd\":" + std::to_string(e.wire_dcn) + "}";
+      table += ",\"wd\":" + std::to_string(e.wire_dcn);
+      table += ",\"y\":" + std::to_string(e.priority) + "}";
     }
     table += "]";
     hvd_negotiate_fn fn;
@@ -1776,11 +2021,35 @@ class Engine {
       fusion_limit = fusion_bytes_;
       sort_by_name = sort_by_name_;
     }
-    if (sort_by_name && entries.size() > 1)
-      std::stable_sort(entries.begin(), entries.end(),
-                       [](const Entry& a, const Entry& b) {
-                         return a.name < b.name;
-                       });
+    if (entries.size() > 1) {
+      // Serving-plane drain order (twin of _run_cycle in engine.py):
+      // priority class first, always. Deadline margin breaks ties ONLY
+      // in single-controller mode — the margin clock is process-local,
+      // so the multi-controller no-KV fallback must keep the
+      // cross-rank-deterministic (priority, name) key. Name last for
+      // determinism either way.
+      Clock::time_point now = Clock::now();
+      bool by_name = sort_by_name;
+      std::stable_sort(
+          entries.begin(), entries.end(),
+          [now, by_name](const Entry& a, const Entry& b) {
+            if (a.priority != b.priority) return a.priority < b.priority;
+            if (!by_name) {
+              double ma =
+                  a.has_deadline
+                      ? std::chrono::duration<double>(a.deadline - now)
+                            .count()
+                      : std::numeric_limits<double>::infinity();
+              double mb =
+                  b.has_deadline
+                      ? std::chrono::duration<double>(b.deadline - now)
+                            .count()
+                      : std::numeric_limits<double>::infinity();
+              if (ma != mb) return ma < mb;
+            }
+            return a.name < b.name;
+          });
+    }
     std::vector<Entry*> fuse;
     long long fuse_bytes = 0;
     long long cycle_bytes = 0;
@@ -1795,7 +2064,8 @@ class Engine {
       if (e.op == HVD_ALLREDUCE) {
         bool compatible =
             fuse.empty() ||
-            (fuse[0]->dtype_num == e.dtype_num &&
+            (fuse[0]->priority == e.priority &&
+             fuse[0]->dtype_num == e.dtype_num &&
              fuse[0]->average == e.average &&
              fuse[0]->prescale == e.prescale &&
              fuse[0]->wire == e.wire &&
@@ -1894,6 +2164,7 @@ class Engine {
     req.average = batch[0]->average;
     req.wire = batch[0]->wire;  // batch is policy-uniform (fusion key)
     req.wire_dcn = batch[0]->wire_dcn;
+    req.priority = batch[0]->priority;  // priority-uniform too
     req.prescale = batch[0]->prescale;
     req.deadline_s = BatchDeadlineRemaining(batch);
     req.names = names.c_str();
@@ -1926,7 +2197,7 @@ class Engine {
         timeline_.EndAt(e->name, "WAIT_FOR_DATA", split);
         timeline_.BeginAt(e->name, "ALLREDUCE", split,
                           TensorArgs(e->dtype_num, e->shape, e->wire,
-                                     e->wire_dcn));
+                                     e->wire_dcn, e->priority));
         timeline_.EndAt(e->name, "ALLREDUCE", t1);
       }
     }
@@ -1972,6 +2243,7 @@ class Engine {
     req.root_rank = e.root_rank;
     req.wire = e.wire;
     req.wire_dcn = e.wire_dcn;
+    req.priority = e.priority;
     req.prescale = e.prescale;
     req.names = e.name.c_str();
     std::vector<char> bounce;
@@ -2009,7 +2281,8 @@ class Engine {
       if (split > t1) split = t1;
       timeline_.BeginAt(e.name, "WAIT_FOR_DATA", t0);
       timeline_.EndAt(e.name, "WAIT_FOR_DATA", split);
-      timeline_.BeginAt(e.name, phase, split, TensorArgs(e.dtype_num, e.shape));
+      timeline_.BeginAt(e.name, phase, split,
+                        TensorArgs(e.dtype_num, e.shape, 0, 0, e.priority));
       timeline_.EndAt(e.name, phase, t1);
     }
     std::shared_ptr<HandleState> hs;
@@ -2060,7 +2333,8 @@ class Engine {
             std::chrono::duration<double>(now - pit->second.phase_since)
                 .count());
         ObserveCompleteLocked(
-            e.op, std::chrono::duration<double>(now - e.enqueued).count());
+            e.op, std::chrono::duration<double>(now - e.enqueued).count(),
+            e.priority);
         if (pit->second.has_deadline) {
           double margin =
               std::chrono::duration<double>(pit->second.deadline - now)
@@ -2078,6 +2352,12 @@ class Engine {
       // Counted whether or not the handle is still live (the Python twin
       // counts every completion the same way).
       if (error || cancelled) stats_.errors++; else stats_.completed++;
+      // Release the admission reservation: every admitted entry passes
+      // through Stage exactly once (success, error, cancel, shutdown).
+      adm_inflight_[PriorityClass(e.priority)].fetch_sub(
+          1, std::memory_order_relaxed);
+      adm_bytes_[PriorityClass(e.priority)].fetch_sub(
+          e.nbytes, std::memory_order_relaxed);
       auto it = handles_.find(e.handle);
       if (it != handles_.end()) {
         hs = it->second;
@@ -2347,6 +2627,16 @@ class Engine {
   std::atomic<bool> shutdown_flag_{false};
   SubmitRing ring_;
   std::atomic<long long> ring_full_{0}, ring_spins_{0};
+  // Serving-plane admission state (index = priority class code).
+  // Atomics, not mu_: the batched submit fast path pre-checks and
+  // reserves without the engine lock. Budgets are 0 = unlimited;
+  // in-flight counts/bytes are incremented at admission and released
+  // at Stage (or AdmitEntryLocked's fail path).
+  std::atomic<long long> adm_max_inflight_[3]{};
+  std::atomic<long long> adm_max_bytes_[3]{};
+  std::atomic<long long> adm_inflight_[3]{};
+  std::atomic<long long> adm_bytes_[3]{};
+  std::atomic<long long> admission_rejected_{0}, admission_shed_{0};
   bool sort_by_name_ = false;
   hvd_exec_fn exec_fn_ = nullptr;
   void* exec_ctx_ = nullptr;
@@ -2394,6 +2684,11 @@ void hvd_engine_set_sort_by_name(void* e, int on) {
   static_cast<Engine*>(e)->SetSortByName(on);
 }
 
+void hvd_engine_set_admission(void* e, const long long* max_inflight,
+                              const long long* max_bytes) {
+  static_cast<Engine*>(e)->SetAdmission(max_inflight, max_bytes);
+}
+
 void hvd_engine_set_negotiator(void* e, hvd_negotiate_fn fn, void* ctx) {
   static_cast<Engine*>(e)->SetNegotiator(fn, ctx);
 }
@@ -2406,12 +2701,12 @@ long long hvd_engine_enqueue(void* e, int op, const char* name, int dtype_num,
                              int itemsize, const void* data,
                              const long long* shape, int ndim, int average,
                              int root_rank, double prescale, int wire,
-                             int wire_dcn, int donate, double deadline_s,
-                             char* err) {
+                             int wire_dcn, int donate, int priority,
+                             double deadline_s, char* err) {
   return static_cast<Engine*>(e)->Enqueue(op, name, dtype_num, itemsize, data,
                                           shape, ndim, average, root_rank,
                                           prescale, wire, wire_dcn, donate,
-                                          deadline_s, err);
+                                          priority, deadline_s, err);
 }
 
 int hvd_engine_enqueue_n(void* e, hvd_request* reqs, int n,

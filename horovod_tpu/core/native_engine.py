@@ -27,6 +27,7 @@ from horovod_tpu.core.engine import (
     STALL_WARNING_TIME_S,
     WIRE_CODES,
     WIRE_NAMES,
+    AdmissionRejected,
     CancelledError,
     CollectiveTimeout,
     DuplicateNameError,
@@ -37,15 +38,21 @@ from horovod_tpu.core.engine import (
     _freeze_donated,
     _multi_controller,
     _negotiated,
+    admission_burst_inject,
+    admission_from_env,
+    build_admission_summary,
     check_wire_exclusive,
     collective_deadline_from_env,
     config_from_env,
     doctor_on_hang,
     make_autotuner,
+    priority_from_env,
     quiesce_drain,
+    record_admission,
     record_cache_config,
     record_submit,
     record_submit_batch,
+    resolve_priority,
     resolve_wire_policy,
     wire_dcn_policy_from_env,
     wire_policy_from_env,
@@ -113,7 +120,8 @@ def _make_negotiator(engine):
                     root_rank=r["r"], prescale=r["p"], age_s=r["t"],
                     nbytes=r["b"],
                     compression=WIRE_NAMES.get(r.get("w", 0), "none"),
-                    compression_dcn=WIRE_NAMES.get(r.get("wd", 0), "none"))
+                    compression_dcn=WIRE_NAMES.get(r.get("wd", 0), "none"),
+                    priority=int(r.get("y", 1)))
                 for r in rows
             ]
             t_neg = time.monotonic()
@@ -312,6 +320,14 @@ class NativeEngine:
         # hierarchical two-phase route — mutually exclusive with a
         # uniform wire policy on any one request (check_wire_exclusive).
         self.wire_dcn_default = wire_dcn_policy_from_env()
+        # Serving plane: engine-wide default priority class
+        # (HVD_PRIORITY) and per-class admission budgets
+        # (HVD_ADMISSION_MAX_{INFLIGHT,BYTES}[_<CLASS>]) — same knobs,
+        # same fail-fast as the python twin; the budgets are pushed into
+        # the C++ engine below so its lock-free submit path enforces
+        # them.
+        self.priority_default = priority_from_env()
+        self.adm_max_inflight, self.adm_max_bytes = admission_from_env()
         # Deadline/cancel/drain plane (same knobs as the python twin):
         # the HVD_COLLECTIVE_DEADLINE_S default, the quiesce reason once
         # admission closes, and donated buffers whose waiter a deadline
@@ -331,6 +347,10 @@ class NativeEngine:
             float(self.cycle_time_s), int(self.fusion_threshold),
             float(stall_warning_s), timeline_path.encode())
         self._lib.hvd_engine_set_executor(self._ptr, self._cb, None)
+        self._lib.hvd_engine_set_admission(
+            self._ptr,
+            (ctypes.c_longlong * 3)(*self.adm_max_inflight),
+            (ctypes.c_longlong * 3)(*self.adm_max_bytes))
         # Distributed-tracing clock metadata: map the C++ timeline clock
         # (trace ts 0) onto the wall clock and record this process's
         # wall↔monotonic bridge as the default common-base offset (see
@@ -413,6 +433,13 @@ class NativeEngine:
         ("engine.ring.full", "ring_full"),
         ("engine.ring.spins", "ring_spins"),
         ("engine.pool.bound_hits", "pool_bound_hits"),
+        # Serving plane: synchronous admission rejections and
+        # deadline-aware fast-fail sheds. The C++ submit path counts
+        # them in its own atomics (it never calls back into python), so
+        # the shim must NOT also call record_admission_rejected — the
+        # fold below is the single writer for these names.
+        ("engine.admission.rejected", "admission_rejected"),
+        ("engine.admission.shed", "admission_shed"),
     )
 
     # Registry histogram name <- hvd_engine_latency field (the parity
@@ -430,6 +457,12 @@ class NativeEngine:
         ("engine.phase.memcpy", "phase_memcpy"),
         ("engine.phase.exec", "phase_exec"),
         ("engine.deadline.margin", "deadline_margin"),
+        # Per-priority-class completion latency (serving plane SLO
+        # view) — the python twin's record_complete_latency feeds the
+        # same names.
+        ("engine.latency.class.high", "class_high"),
+        ("engine.latency.class.normal", "class_normal"),
+        ("engine.latency.class.low", "class_low"),
     )
 
     def _collect_stats(self):
@@ -450,6 +483,9 @@ class NativeEngine:
                     self._last_stats[field] = value
             tele.REGISTRY.gauge("engine.queue_depth").set(
                 int(st.queue_depth))
+            record_admission([int(st.admission_inflight_high),
+                              int(st.admission_inflight_normal),
+                              int(st.admission_inflight_low)])
             # Resident bytes is a gauge: C++ pool + this engine's python
             # pool together (one data plane, one occupancy number).
             tele.REGISTRY.gauge("engine.pool.bytes_resident").set(
@@ -600,7 +636,14 @@ class NativeEngine:
                  compression: Optional[str] = None,
                  compression_dcn: Optional[str] = None,
                  donate: bool = False,
-                 deadline_ms: Optional[float] = None) -> int:
+                 deadline_ms: Optional[float] = None,
+                 priority: Optional[str] = None) -> int:
+        # Fault site engine.admit (core/faultline.py, burst mode): pile
+        # synthetic low-priority work onto the queue BEFORE this submit
+        # is admitted — drives the class budget toward saturation so
+        # admission rejections can be rehearsed. Same placement as the
+        # python twin: single-submit path only.
+        admission_burst_inject(self, name)
         # Fault site engine.submit (core/faultline.py) — in the python
         # shim, BEFORE the C++ enqueue, so both engines fail a submit at
         # the same point with the same observable shape.
@@ -642,6 +685,8 @@ class NativeEngine:
                         if compression_dcn is not None
                         else self.wire_dcn_default)
             check_wire_exclusive(wire, wire_dcn, name)
+        prio = (self.priority_default if priority is None
+                else resolve_priority(priority, name))
         flipped = False
         if donate:
             # Ownership handoff: the C++ entry references this buffer in
@@ -656,7 +701,7 @@ class NativeEngine:
             tensor.dtype.itemsize, tensor.ctypes.data, shape, tensor.ndim,
             int(average), int(root_rank), float(prescale),
             int(WIRE_CODES[wire]), int(WIRE_CODES[wire_dcn]), int(donate),
-            float(deadline_s), err)
+            int(prio), float(deadline_s), err)
         if h < 0:
             # Rejected submit: the engine never took ownership — a
             # donated buffer we froze must become writable again.
@@ -665,6 +710,11 @@ class NativeEngine:
             msg = err.value.decode()
             if "already pending" in msg:
                 raise DuplicateNameError(msg)
+            if "admission" in msg:
+                # Covers both the budget rejection and the deadline-
+                # aware shed (its message names engine.admission.shed) —
+                # the C++ side already counted it.
+                raise AdmissionRejected(msg)
             raise ShutdownError(msg)
         if donate:
             self._donated[int(h)] = tensor
@@ -682,23 +732,28 @@ class NativeEngine:
                         compression: Optional[str] = None,
                         compression_dcn: Optional[str] = None,
                         donate: bool = False,
-                        deadline_ms: Optional[float] = None) -> int:
+                        deadline_ms: Optional[float] = None,
+                        priority: Optional[str] = None) -> int:
         return self._enqueue("allreduce", name, tensor, average=average,
                              prescale=prescale, compression=compression,
                              compression_dcn=compression_dcn,
-                             donate=donate, deadline_ms=deadline_ms)
+                             donate=donate, deadline_ms=deadline_ms,
+                             priority=priority)
 
     def allgather_async(self, name: str, tensor: np.ndarray,
                         donate: bool = False,
-                        deadline_ms: Optional[float] = None) -> int:
+                        deadline_ms: Optional[float] = None,
+                        priority: Optional[str] = None) -> int:
         return self._enqueue("allgather", name, tensor, donate=donate,
-                             deadline_ms=deadline_ms)
+                             deadline_ms=deadline_ms, priority=priority)
 
     def broadcast_async(self, name: str, tensor: np.ndarray,
                         root_rank: int, donate: bool = False,
-                        deadline_ms: Optional[float] = None) -> int:
+                        deadline_ms: Optional[float] = None,
+                        priority: Optional[str] = None) -> int:
         return self._enqueue("broadcast", name, tensor, root_rank=root_rank,
-                             donate=donate, deadline_ms=deadline_ms)
+                             donate=donate, deadline_ms=deadline_ms,
+                             priority=priority)
 
     def submit_n(self, op: str, requests) -> List[int]:
         """Batched submit through ONE ``hvd_engine_enqueue_n`` call: one
@@ -779,6 +834,10 @@ class NativeEngine:
                 q.wire_dcn = int(WIRE_CODES[wire_dcn])
                 q.prescale = float(r.prescale)
                 q.deadline_s = float(deadline_s)
+                q.priority = int(
+                    self.priority_default
+                    if getattr(r, "priority", None) is None
+                    else resolve_priority(r.priority, r.name))
                 q.names = r.name.encode()
                 q.data = tensor.ctypes.data
                 q.out = tensor.ctypes.data
@@ -805,6 +864,11 @@ class NativeEngine:
             msg = err.value.decode()
             if "names must be unique" in msg:
                 raise DuplicateNameError(msg)
+            if "admission" in msg:
+                # Whole-batch all-or-nothing rejection: admission never
+                # tears a fused batch (the C++ pre-check refuses the
+                # batch before any entry is staged).
+                raise AdmissionRejected(msg)
             if "shut down" in msg:
                 raise ShutdownError(msg)
             raise EngineError(msg)
@@ -848,6 +912,27 @@ class NativeEngine:
         return quiesce_drain(reason, deadline_s, already,
                              self._pending_names, lambda: None,
                              min(self.cycle_time_s, 0.01))
+
+    def admission_summary(self) -> dict:
+        """Serving-plane admission snapshot (same shape as the python
+        twin's): queue depth, per-class in-flight counts/bytes against
+        their budgets, ``saturated``/``tripped`` flags — read straight
+        from the C++ engine's atomics via ``hvd_engine_get_stats``."""
+        if self._ptr is None:
+            return build_admission_summary(0, [0, 0, 0], [0, 0, 0],
+                                           self.adm_max_inflight,
+                                           self.adm_max_bytes)
+        st = native.HvdStats()
+        self._lib.hvd_engine_get_stats(self._ptr, ctypes.byref(st))
+        return build_admission_summary(
+            int(st.queue_depth),
+            [int(st.admission_inflight_high),
+             int(st.admission_inflight_normal),
+             int(st.admission_inflight_low)],
+            [int(st.admission_bytes_high),
+             int(st.admission_bytes_normal),
+             int(st.admission_bytes_low)],
+            self.adm_max_inflight, self.adm_max_bytes)
 
     def inspect(self) -> List[dict]:
         """Full per-entry state of every in-flight tensor, straight from

@@ -799,12 +799,28 @@ class Sentinel:
         # a numerics verdict can fire from the engine path before any
         # training step is observed (core/numerics.py), and /healthz
         # must degrade on it regardless.
+        # Serving-plane admission state (core/engine.py
+        # admission_summary — covers both engines via the singleton):
+        # queue depth, per-class in-flight vs budget, saturation.
+        admission = None
+        try:
+            from horovod_tpu.core import engine as _eng
+
+            admission = _eng.admission_summary()
+        except Exception:  # pragma: no cover - defensive
+            pass
         if draining is not None:
             # Deliberate drain (engine quiesce / graceful preemption):
             # load balancers must stop routing here NOW — the endpoint
             # serves non-200 for it (telemetry_http treats everything
             # outside ok/init as 503), and the payload says why.
             status = "draining"
+        elif admission is not None and admission.get("saturated"):
+            # Overload: at least one priority class is at its admission
+            # budget RIGHT NOW — new submits in that class are being
+            # rejected. Non-200 so load balancers route serving traffic
+            # elsewhere until in-flight work drains below the budget.
+            status = "saturated"
         elif recent_verdict or recent_stall:
             status = "warn"
         elif age is None:
@@ -835,6 +851,7 @@ class Sentinel:
         return {
             "status": status,
             "draining": draining,
+            "admission": admission,
             "world": world,
             "rank": tl._process_index(),
             "pid": os.getpid(),

@@ -59,37 +59,45 @@ def allreduce_async(tensor, average: bool = True,
                     name: Optional[str] = None,
                     compression: Optional[str] = None,
                     donate: bool = False,
-                    deadline_ms: Optional[float] = None) -> int:
+                    deadline_ms: Optional[float] = None,
+                    priority: Optional[str] = None) -> int:
     """Enqueue an allreduce; returns a handle for :func:`synchronize`.
     ``compression`` is the per-request engine wire policy ('int8'/'fp8');
     ``donate=True`` skips the submit snapshot (ownership handoff);
     ``deadline_ms`` bounds the wait — an overdue request fails its
     waiter with an attributed :class:`CollectiveTimeout` (overrides the
-    engine-wide ``HVD_COLLECTIVE_DEADLINE_S`` default)."""
+    engine-wide ``HVD_COLLECTIVE_DEADLINE_S`` default); ``priority``
+    ('high'/'normal'/'low') is the serving-plane scheduling class —
+    higher classes drain first and have their own admission budget
+    (overrides the engine-wide ``HVD_PRIORITY`` default)."""
     return get_engine().allreduce_async(
         _auto_name("allreduce", name), _np_of(tensor), average,
-        compression=compression, donate=donate, deadline_ms=deadline_ms)
+        compression=compression, donate=donate, deadline_ms=deadline_ms,
+        priority=priority)
 
 
 def allgather_async(tensor, name: Optional[str] = None,
                     donate: bool = False,
-                    deadline_ms: Optional[float] = None) -> int:
+                    deadline_ms: Optional[float] = None,
+                    priority: Optional[str] = None) -> int:
     return get_engine().allgather_async(
         _auto_name("allgather", name), _np_of(tensor), donate=donate,
-        deadline_ms=deadline_ms)
+        deadline_ms=deadline_ms, priority=priority)
 
 
 def broadcast_async(tensor, root_rank: int, name: Optional[str] = None,
                     donate: bool = False,
-                    deadline_ms: Optional[float] = None) -> int:
+                    deadline_ms: Optional[float] = None,
+                    priority: Optional[str] = None) -> int:
     return get_engine().broadcast_async(
         _auto_name("broadcast", name), _np_of(tensor), root_rank,
-        donate=donate, deadline_ms=deadline_ms)
+        donate=donate, deadline_ms=deadline_ms, priority=priority)
 
 
 def allreduce_n_async(tensors, average: bool = True, names=None,
                       compression=None, donate: bool = False,
-                      deadline_ms: Optional[float] = None) -> list:
+                      deadline_ms: Optional[float] = None,
+                      priority: Optional[str] = None) -> list:
     """Batched allreduce submit: the whole list rides ONE engine call
     (``Engine.submit_n`` / ``hvd_engine_enqueue_n``) — one GIL crossing,
     one snapshot pass over name-bound pool slabs, one engine wakeup.
@@ -107,14 +115,15 @@ def allreduce_n_async(tensors, average: bool = True, names=None,
              else [compression] * len(ts))
     reqs = [SubmitRequest(_auto_name("allreduce", nm), _np_of(t),
                           average=average, compression=c, donate=donate,
-                          deadline_ms=deadline_ms)
+                          deadline_ms=deadline_ms, priority=priority)
             for t, nm, c in zip(ts, names, comps)]
     return get_engine().submit_n("allreduce", reqs)
 
 
 def broadcast_n_async(tensors, root_rank: int, names=None,
                       donate: bool = False,
-                      deadline_ms: Optional[float] = None) -> list:
+                      deadline_ms: Optional[float] = None,
+                      priority: Optional[str] = None) -> list:
     """Batched broadcast submit — the grouped state-sync twin of
     :func:`allreduce_n_async` (one engine call for a whole parameter
     list)."""
@@ -125,7 +134,7 @@ def broadcast_n_async(tensors, root_rank: int, names=None,
         names = [None] * len(ts)
     reqs = [SubmitRequest(_auto_name("broadcast", nm), _np_of(t),
                           root_rank=root_rank, donate=donate,
-                          deadline_ms=deadline_ms)
+                          deadline_ms=deadline_ms, priority=priority)
             for t, nm in zip(ts, names)]
     return get_engine().submit_n("broadcast", reqs)
 

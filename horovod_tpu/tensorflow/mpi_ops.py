@@ -24,7 +24,7 @@ from horovod_tpu.ops import collectives as _C
 
 
 def _np_collective(kind: str, t: np.ndarray, *, name: str,
-                   average=False, root=0, wire=None):
+                   average=False, root=0, wire=None, priority=None):
     """Execute through the ENGINE, not the eager compiled collectives.
 
     TF's graph executor runs independent py_function nodes concurrently
@@ -47,16 +47,20 @@ def _np_collective(kind: str, t: np.ndarray, *, name: str,
     # only READS donated buffers; results land in its pooled buffers.
     if kind == "allreduce":
         # The engine wire format is >=1-d; restore scalar shape after.
-        # `wire` is the per-request engine wire policy ('int8'/'fp8').
+        # `wire` is the per-request engine wire policy ('int8'/'fp8');
+        # `priority` the serving-plane scheduling class.
         h = e.allreduce_async(name, np.atleast_1d(t), average,
-                              compression=wire, donate=True)
+                              compression=wire, donate=True,
+                              priority=priority)
         return e.synchronize(h).reshape(np.shape(t))
     if kind == "allgather":
         # Scalars ride the >=1-d wire as one gathered row apiece.
         return e.synchronize(e.allgather_async(name, np.atleast_1d(t),
-                                               donate=True))
+                                               donate=True,
+                                               priority=priority))
     if kind == "broadcast":
-        h = e.broadcast_async(name, np.atleast_1d(t), root, donate=True)
+        h = e.broadcast_async(name, np.atleast_1d(t), root, donate=True,
+                              priority=priority)
         return e.synchronize(h).reshape(np.shape(t))
     raise ValueError(kind)
 
@@ -90,7 +94,7 @@ def _seq_next(key: str) -> int:
 
 
 def _bridge_group(kind: str, tensors, names, *, average=False, root=0,
-                  wires=None):
+                  wires=None, priority=None):
     """Run N same-kind collectives through ONE py_function, submitting
     every engine request before waiting on any.
 
@@ -143,7 +147,8 @@ def _bridge_group(kind: str, tensors, names, *, average=False, root=0,
                 reqs = [_eng.SubmitRequest(
                             name, np.atleast_1d(np.asarray(t.numpy())),
                             average=average, root_rank=root,
-                            compression=w, donate=True)
+                            compression=w, donate=True,
+                            priority=priority)
                         for _, name, t, w in run]
                 handles.extend(e.submit_n(k, reqs))
                 continue
@@ -152,12 +157,15 @@ def _bridge_group(kind: str, tensors, names, *, average=False, root=0,
             if k == "allreduce":
                 handles.append(e.allreduce_async(name, a, average,
                                                  compression=w,
-                                                 donate=True))
+                                                 donate=True,
+                                                 priority=priority))
             elif k == "broadcast":
                 handles.append(e.broadcast_async(name, a, root,
-                                                 donate=True))
+                                                 donate=True,
+                                                 priority=priority))
             else:
-                handles.append(e.allgather_async(name, a, donate=True))
+                handles.append(e.allgather_async(name, a, donate=True,
+                                                 priority=priority))
         # Drain EVERY handle even when one errors (then re-raise the
         # first failure): an abandoned handle would orphan its donated
         # buffer's pin on the native engine, and the group's remaining
@@ -254,17 +262,19 @@ def rank() -> int:
 
 
 def _allreduce(tensor: tf.Tensor, average: bool = False,
-               name: Optional[str] = None, wire=None) -> tf.Tensor:
+               name: Optional[str] = None, wire=None,
+               priority=None) -> tf.Tensor:
     @tf.custom_gradient
     def op(x):
-        y = _bridge("allreduce", x, name=name, average=average, wire=wire)
+        y = _bridge("allreduce", x, name=name, average=average, wire=wire,
+                    priority=priority)
 
         def grad(dy):
             # Reference: allreduce's gradient is an allreduce
             # (tensorflow/mpi_ops.py:94-105).
             gname = f"{name}.grad" if name else None
             return _bridge("allreduce", dy, name=gname, average=average,
-                           wire=wire)
+                           wire=wire, priority=priority)
 
         return y, grad
 

@@ -111,7 +111,8 @@ def allreduce_async(tensor: torch.Tensor, average: bool = True,
                     name: Optional[str] = None,
                     compression: Optional[str] = None,
                     donate: bool = False,
-                    deadline_ms: Optional[float] = None) -> int:
+                    deadline_ms: Optional[float] = None,
+                    priority: Optional[str] = None) -> int:
     # `compression` here is the per-request ENGINE wire-format name
     # ('int8'/'fp8' — a Compressor's .engine_wire); cast compressors are
     # applied by the caller around the collective as in the reference.
@@ -123,11 +124,15 @@ def allreduce_async(tensor: torch.Tensor, average: bool = True,
     # behavior, the caller's promise to keep (see docs/running.md).
     # `deadline_ms` bounds the wait: an overdue request fails its waiter
     # with an attributed CollectiveTimeout (overrides the engine-wide
-    # HVD_COLLECTIVE_DEADLINE_S default).
+    # HVD_COLLECTIVE_DEADLINE_S default). `priority`
+    # ('high'/'normal'/'low') is the serving-plane scheduling class —
+    # higher classes drain first and own their admission budget
+    # (overrides the engine-wide HVD_PRIORITY default).
     out = torch.empty_like(tensor)
     h = get_engine().allreduce_async(
         _auto_name("allreduce", name), _np_of(tensor), average,
-        compression=compression, donate=donate, deadline_ms=deadline_ms
+        compression=compression, donate=donate, deadline_ms=deadline_ms,
+        priority=priority
     )
     _register(h, tensor, out)
     return h
@@ -137,7 +142,8 @@ def allreduce_async_(tensor: torch.Tensor, average: bool = True,
                      name: Optional[str] = None,
                      compression: Optional[str] = None,
                      donate: bool = False,
-                     deadline_ms: Optional[float] = None) -> int:
+                     deadline_ms: Optional[float] = None,
+                     priority: Optional[str] = None) -> int:
     # In-place + donation (PR 13 follow-up): the engine references the
     # tensor's host buffer in place and only READS it — the reduced
     # result lands in engine-pooled buffers and is copied back into the
@@ -149,14 +155,16 @@ def allreduce_async_(tensor: torch.Tensor, average: bool = True,
     # own donation (documented UB, docs/running.md).
     h = get_engine().allreduce_async(
         _auto_name("allreduce", name), _np_of(tensor), average,
-        compression=compression, donate=donate, deadline_ms=deadline_ms
+        compression=compression, donate=donate, deadline_ms=deadline_ms,
+        priority=priority
     )
     _register(h, tensor, tensor)
     return h
 
 
 def allreduce_batch_async_(named_tensors, average: bool = True,
-                           compressions=None) -> list:
+                           compressions=None,
+                           priority: Optional[str] = None) -> list:
     """Batched in-place allreduce: ONE engine call (``submit_n`` /
     ``hvd_engine_enqueue_n``) for a whole bucket of gradients — one GIL
     crossing, one snapshot pass over name-bound pool slabs, one engine
@@ -171,7 +179,8 @@ def allreduce_batch_async_(named_tensors, average: bool = True,
     comps = (list(compressions) if compressions is not None
              else [None] * len(items))
     reqs = [SubmitRequest(_auto_name("allreduce", name), _np_of(t),
-                          average=average, compression=c)
+                          average=average, compression=c,
+                          priority=priority)
             for (name, t), c in zip(items, comps)]
     handles = get_engine().submit_n("allreduce", reqs)
     for h, (_, t) in zip(handles, items):
@@ -234,10 +243,12 @@ def allreduce_(tensor: torch.Tensor, average: bool = True,
 
 def allgather_async(tensor: torch.Tensor, name: Optional[str] = None,
                     donate: bool = False,
-                    deadline_ms: Optional[float] = None) -> int:
+                    deadline_ms: Optional[float] = None,
+                    priority: Optional[str] = None) -> int:
     h = get_engine().allgather_async(_auto_name("allgather", name),
                                      _np_of(tensor), donate=donate,
-                                     deadline_ms=deadline_ms)
+                                     deadline_ms=deadline_ms,
+                                     priority=priority)
     _register(h, tensor, None)
     return h
 
@@ -270,11 +281,12 @@ def allgather(tensor: torch.Tensor, name: Optional[str] = None) -> torch.Tensor:
 def broadcast_async(tensor: torch.Tensor, root_rank: int,
                     name: Optional[str] = None,
                     donate: bool = False,
-                    deadline_ms: Optional[float] = None) -> int:
+                    deadline_ms: Optional[float] = None,
+                    priority: Optional[str] = None) -> int:
     out = torch.empty_like(tensor)
     h = get_engine().broadcast_async(
         _auto_name("broadcast", name), _np_of(tensor), root_rank,
-        donate=donate, deadline_ms=deadline_ms
+        donate=donate, deadline_ms=deadline_ms, priority=priority
     )
     _register(h, tensor, out)
     return h
@@ -283,12 +295,13 @@ def broadcast_async(tensor: torch.Tensor, root_rank: int,
 def broadcast_async_(tensor: torch.Tensor, root_rank: int,
                      name: Optional[str] = None,
                      donate: bool = False,
-                     deadline_ms: Optional[float] = None) -> int:
+                     deadline_ms: Optional[float] = None,
+                     priority: Optional[str] = None) -> int:
     # Same in-place donation contract as allreduce_async_: zero-copy
     # read by the engine, result written back at synchronize().
     h = get_engine().broadcast_async(
         _auto_name("broadcast", name), _np_of(tensor), root_rank,
-        donate=donate, deadline_ms=deadline_ms
+        donate=donate, deadline_ms=deadline_ms, priority=priority
     )
     _register(h, tensor, tensor)
     return h

@@ -59,6 +59,7 @@ _SAMPLE_RE = re.compile(
 _DOCTOR_KINDS = (
     "dead_peer",
     "draining",
+    "overload",
     "missing_submitter",
     "metadata_mismatch",
     "slow_executor",
@@ -191,11 +192,31 @@ def render_fleet(report: dict) -> str:
         lines.append("phase: " + "  ".join(
             f"{name} p50={_fmt_us(q.get('p50_us'))}us"
             for name, q in sorted(phases.items())))
+    classes = report.get("classes") or {}
+    if classes:
+        lines.append("class: " + "  ".join(
+            f"{cls} p50={_fmt_us(q.get('p50_us'))}us "
+            f"p99={_fmt_us(q.get('p99_us'))}us"
+            for cls, q in sorted(classes.items())))
     dl = report.get("deadline") or {}
     lines.append(
         f"deadline: exceeded={dl.get('exceeded', 0):g} "
         f"cancelled={dl.get('cancelled', 0):g} "
         f"ring_full={dl.get('ring_full', 0):g}")
+    adm = report.get("admission") or {}
+    if adm:
+        infl = adm.get("inflight") or {}
+        sat = adm.get("saturated_ranks") or {}
+        lines.append(
+            f"admission: rejected={adm.get('rejected', 0):g} "
+            f"shed={adm.get('shed', 0):g} inflight="
+            + "/".join(f"{infl.get(c, 0):g}"
+                       for c in ("high", "normal", "low"))
+            + (" SATURATED=" + ",".join(
+                f"rank{r}:{'+'.join(cls)}"
+                for r, cls in sorted(sat.items(),
+                                     key=lambda kv: int(kv[0])))
+               if sat else ""))
     ranks = report.get("ranks") or {}
     if ranks:
         lines.append(

@@ -196,6 +196,39 @@ def main():
         h = e.allreduce_async("after", np.ones((4,), np.float32), False)
         np.testing.assert_allclose(e.synchronize(h),
                                    np.full((4,), float(local_devices * nproc)))
+    elif scenario == "engine_priority":
+        # Serving-plane coherence across controllers: (a) a world that
+        # disagrees on a tensor's priority class fails fast BY NAME on
+        # every process (priority is part of the negotiation
+        # fingerprint — the HVD_COMPRESSION precedent), (b) the engine
+        # stays usable, and (c) an agreeing mixed-class workload
+        # completes with correct results (fused batches are composed
+        # priority-uniform by the shared _fuse_names key).
+        from horovod_tpu.core import engine as eng
+        from horovod_tpu.core.engine import EngineError
+
+        e = eng.get_engine()
+        h = e.allreduce_async("prio.skew", np.ones((4,), np.float32),
+                              False,
+                              priority="high" if pid == 0 else "low")
+        try:
+            e.synchronize(h)
+        except EngineError as err:
+            assert "priority classes" in str(err), str(err)
+            assert "prio.skew" in str(err), str(err)
+            print(f"proc {pid}: priority mismatch OK", flush=True)
+        else:
+            raise SystemExit("no error surfaced for mixed priorities")
+        expect = float(local_devices * sum(range(1, nproc + 1)))
+        handles = {}
+        for cls in ("low", "normal", "high"):
+            handles[cls] = e.allreduce_async(
+                f"prio.{cls}", np.full((8,), float(pid + 1), np.float32),
+                False, priority=cls)
+        for cls, h in handles.items():
+            np.testing.assert_allclose(e.synchronize(h),
+                                       np.full((8,), expect))
+        print(f"proc {pid}: agreed classes reduce OK", flush=True)
     elif scenario == "engine_stall":
         # Missing-rank stall attribution (reference: CheckForStalledTensors
         # names missing ranks, operations.cc:1535-1581): process 1 delays

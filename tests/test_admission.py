@@ -1,0 +1,533 @@
+"""Serving-plane admission control + priority classes (ISSUE 20),
+pinned for BOTH engines:
+
+- priority-class resolution (spellings, codes, HVD_PRIORITY default)
+  and the per-class budget env grammar;
+- admission rejection is SYNCHRONOUS and per class: a class at its
+  in-flight or bytes budget rejects new submits with a descriptive
+  AdmissionRejected while other classes keep flowing;
+- batched submits are all-or-nothing — admission never tears a batch;
+- the deadline-aware fast-fail sheds a submit whose remaining deadline
+  is under the observed p50 queue(+negotiate) latency, gated on
+  SHED_MIN_SAMPLES so a cold engine never sheds;
+- the cycle loop drains (priority, deadline-margin, name) ordered;
+- quiesce during saturation reports shed-vs-drained separately;
+- a cancel storm against a saturated queue leaves the ring/pool
+  counters flat (no leaked slots, no ring pressure);
+- /healthz grows the ``saturated`` arm + admission body, and the doctor
+  classifies a tripped budget as an ``overload`` verdict naming the
+  class and budget.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from horovod_tpu.core import engine as eng
+from horovod_tpu.core import telemetry as tele
+from horovod_tpu.core import timeline as tl
+from horovod_tpu.core.engine import AdmissionRejected
+from horovod_tpu.core.native_engine import NativeEngine
+
+
+class GatedExecutor:
+    """Local data plane whose allreduce can be held open — the wedge
+    that keeps the queue saturated while admission decisions land."""
+
+    measure_staging = False
+    last_stage_s = 0.0
+    pool = None
+    wire_policy = "none"
+    last_wire_bytes = 0
+    last_wire_compressed = 0
+
+    def __init__(self, world=8):
+        self.world = world
+        self.gate = threading.Event()
+        self.gate.set()
+        self.calls = []  # flat sizes, in executor-call order
+
+    def allreduce(self, flat, average):
+        self.calls.append(flat.size)
+        assert self.gate.wait(20.0), "executor gate never released"
+        return flat if average else flat * self.world
+
+    def allgather(self, t):
+        return np.tile(t, (self.world,) + (1,) * (t.ndim - 1))
+
+    def broadcast(self, t, root):
+        return t.copy()
+
+
+def _mk_py(executor=None, **kw):
+    kw.setdefault("cycle_time_s", 0.002)
+    kw.setdefault("stall_warning_s", 0.2)
+    kw.setdefault("timeline", tl.Timeline(None))
+    return eng.Engine(executor=executor or GatedExecutor(), **kw)
+
+
+def _mk_native(executor=None, **kw):
+    kw.setdefault("cycle_time_s", 0.002)
+    kw.setdefault("stall_warning_s", 0.2)
+    kw.setdefault("timeline_path", "")
+    return NativeEngine(executor=executor or GatedExecutor(), **kw)
+
+
+ENGINES = [("python", _mk_py), ("native", _mk_native)]
+
+
+def _wait(cond, timeout_s=10.0, msg="condition never held"):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if cond():
+            return
+        time.sleep(0.005)
+    raise AssertionError(msg)
+
+
+def _counter(e, name):
+    if hasattr(e, "_collect_stats"):
+        e._collect_stats()  # native: fold the C++ atomics in
+    return tele.REGISTRY.counter(name).value
+
+
+# ---------------------------------------------------------------------------
+# resolution + env grammar (pure)
+# ---------------------------------------------------------------------------
+
+
+def test_resolve_priority_spellings():
+    assert eng.resolve_priority(None) == eng.PRIORITY_CODES["normal"]
+    assert eng.resolve_priority("high") == 0
+    assert eng.resolve_priority("NORMAL") == 1
+    assert eng.resolve_priority("low") == 2
+    assert eng.resolve_priority(0) == 0
+    assert eng.resolve_priority(np.int64(2)) == 2
+    with pytest.raises(eng.EngineError, match="unknown priority class"):
+        eng.resolve_priority("urgent", name="t0")
+    with pytest.raises(eng.EngineError, match="t0"):
+        eng.resolve_priority(7, name="t0")
+
+
+def test_priority_from_env(monkeypatch):
+    monkeypatch.delenv("HVD_PRIORITY", raising=False)
+    monkeypatch.delenv("HOROVOD_PRIORITY", raising=False)
+    assert eng.priority_from_env() == eng.PRIORITY_CODES["normal"]
+    monkeypatch.setenv("HVD_PRIORITY", "high")
+    assert eng.priority_from_env() == 0
+    monkeypatch.delenv("HVD_PRIORITY")
+    monkeypatch.setenv("HOROVOD_PRIORITY", "low")
+    assert eng.priority_from_env() == 2
+
+
+def test_admission_from_env_per_class_overrides(monkeypatch):
+    for v in ("HVD_ADMISSION_MAX_INFLIGHT", "HVD_ADMISSION_MAX_BYTES"):
+        for c in ("", "_HIGH", "_NORMAL", "_LOW"):
+            monkeypatch.delenv(v + c, raising=False)
+    mi, mb = eng.admission_from_env()
+    assert mi == [0, 0, 0] and mb == [0, 0, 0]  # 0 = unbounded
+    monkeypatch.setenv("HVD_ADMISSION_MAX_INFLIGHT", "16")
+    monkeypatch.setenv("HVD_ADMISSION_MAX_INFLIGHT_LOW", "2")
+    monkeypatch.setenv("HVD_ADMISSION_MAX_BYTES_HIGH", "1048576")
+    mi, mb = eng.admission_from_env()
+    assert mi == [16, 16, 2]  # ordered like PRIORITY_CLASSES
+    assert mb == [1048576, 0, 0]
+
+
+# ---------------------------------------------------------------------------
+# per-class budgets (both engines)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("impl,mk", ENGINES)
+def test_env_default_priority_applies_to_submits(impl, mk, monkeypatch):
+    """HVD_PRIORITY classifies submits that pass priority=None; the
+    per-class in-flight accounting (admission_summary) proves which
+    class the entry landed in."""
+    monkeypatch.setenv("HVD_PRIORITY", "high")
+    ex = GatedExecutor()
+    e = mk(ex)
+    try:
+        ex.gate.clear()
+        h = e.allreduce_async("envdft", np.ones(4, np.float32), False)
+        _wait(lambda: e.admission_summary()
+              ["classes"]["high"]["inflight"] == 1,
+              msg="high-class in-flight never reached 1")
+        assert e.admission_summary()["classes"]["normal"]["inflight"] == 0
+        ex.gate.set()
+        e.synchronize(h)
+    finally:
+        ex.gate.set()
+        e.shutdown()
+
+
+@pytest.mark.parametrize("impl,mk", ENGINES)
+def test_inflight_budget_rejects_only_that_class(impl, mk, monkeypatch):
+    """A class at its in-flight budget rejects synchronously with the
+    class + budget named; other classes keep flowing; the counter and
+    the saturated/tripped summary tell the same story."""
+    monkeypatch.setenv("HVD_ADMISSION_MAX_INFLIGHT_LOW", "2")
+    ex = GatedExecutor()
+    e = mk(ex)
+    try:
+        before = _counter(e, "engine.admission.rejected")
+        ex.gate.clear()
+        hs = [e.allreduce_async(f"low.{k}", np.ones(4, np.float32),
+                                False, priority="low") for k in range(2)]
+        _wait(lambda: e.admission_summary()
+              ["classes"]["low"]["inflight"] == 2)
+        with pytest.raises(AdmissionRejected) as ei:
+            e.allreduce_async("low.over", np.ones(4, np.float32), False,
+                              priority="low")
+        msg = str(ei.value)
+        assert "'low'" in msg and "HVD_ADMISSION_MAX_INFLIGHT" in msg
+        assert _counter(e, "engine.admission.rejected") == before + 1
+        summary = e.admission_summary()
+        assert summary["saturated"] == ["low"]
+        assert summary["tripped"] == {"cls": "low",
+                                      "budget": "max_inflight"}
+        # High class is not governed by the low budget.
+        hh = e.allreduce_async("hi.ok", np.ones(4, np.float32), False,
+                               priority="high")
+        ex.gate.set()
+        for h in hs + [hh]:
+            e.synchronize(h)
+        # Budget slots free on completion: the class admits again.
+        _wait(lambda: e.admission_summary()
+              ["classes"]["low"]["inflight"] == 0)
+        h2 = e.allreduce_async("low.again", np.ones(4, np.float32),
+                               False, priority="low")
+        e.synchronize(h2)
+    finally:
+        ex.gate.set()
+        e.shutdown()
+
+
+@pytest.mark.parametrize("impl,mk", ENGINES)
+def test_bytes_budget_rejects(impl, mk, monkeypatch):
+    monkeypatch.setenv("HVD_ADMISSION_MAX_BYTES_NORMAL", "1024")
+    ex = GatedExecutor()
+    e = mk(ex)
+    try:
+        ex.gate.clear()
+        h = e.allreduce_async("nb.small", np.ones(64, np.float32), False)
+        _wait(lambda: e.admission_summary()
+              ["classes"]["normal"]["inflight"] == 1)
+        with pytest.raises(AdmissionRejected, match="bytes budget"):
+            e.allreduce_async("nb.big", np.ones(512, np.float32), False)
+        ex.gate.set()
+        e.synchronize(h)
+    finally:
+        ex.gate.set()
+        e.shutdown()
+
+
+@pytest.mark.parametrize("impl,mk", ENGINES)
+def test_batched_submit_is_all_or_nothing(impl, mk, monkeypatch):
+    """A batch that would push its class over budget rejects WHOLE at
+    the submit boundary: no member handle exists, nothing is admitted,
+    and a batch that fits afterwards goes through — admission never
+    tears a fused batch."""
+    monkeypatch.setenv("HVD_ADMISSION_MAX_INFLIGHT_LOW", "2")
+    ex = GatedExecutor()
+    e = mk(ex)
+    try:
+        ex.gate.clear()
+        reqs = [eng.SubmitRequest(f"batch.{k}", np.ones(4, np.float32),
+                                  average=False, priority="low")
+                for k in range(3)]
+        with pytest.raises(AdmissionRejected, match="never tears"):
+            e.submit_n("allreduce", reqs)
+        assert e.admission_summary()["classes"]["low"]["inflight"] == 0
+        ok = e.submit_n("allreduce", reqs[:2])
+        _wait(lambda: e.admission_summary()
+              ["classes"]["low"]["inflight"] == 2)
+        ex.gate.set()
+        for h in ok:
+            e.synchronize(h)
+    finally:
+        ex.gate.set()
+        e.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# deadline-aware shed
+# ---------------------------------------------------------------------------
+
+
+def test_shed_python_gated_on_min_samples(monkeypatch):
+    """The fast-fail sheds only once SHED_MIN_SAMPLES queue-phase
+    observations exist; then a submit whose deadline is under the p50
+    is shed synchronously, counted in engine.admission.shed."""
+    tele.REGISTRY.reset()  # own the process-global phase histograms
+    try:
+        assert eng.queue_latency_estimate() is None  # cold: never sheds
+        h = tele.REGISTRY.histogram("engine.phase.queue")
+        for _ in range(eng.SHED_MIN_SAMPLES):
+            h.observe(0.4)
+        est = eng.queue_latency_estimate()
+        assert est is not None and est > 0.05
+        ex = GatedExecutor()
+        e = _mk_py(ex)
+        try:
+            before = tele.REGISTRY.counter("engine.admission.shed").value
+            with pytest.raises(AdmissionRejected,
+                               match="engine.admission.shed"):
+                e.allreduce_async("shed.me", np.ones(4, np.float32),
+                                  False, deadline_ms=20)
+            assert tele.REGISTRY.counter(
+                "engine.admission.shed").value == before + 1
+            # A deadline with margin above the estimate is admitted.
+            h2 = e.allreduce_async("keep.me", np.ones(4, np.float32),
+                                   False, deadline_ms=30000)
+            e.synchronize(h2)
+            # No deadline = never shed, regardless of the estimate.
+            h3 = e.allreduce_async("nodl", np.ones(4, np.float32), False)
+            e.synchronize(h3)
+        finally:
+            e.shutdown()
+    finally:
+        tele.REGISTRY.reset()  # drop the synthetic 400 ms samples
+
+
+def test_shed_native_from_observed_queue_residency():
+    """The C++ engine sheds from ITS OWN phase histogram: after >=8
+    entries observed ~300 ms of queue residency, a 20 ms-deadline
+    submit is shed with the same message vocabulary."""
+    ex = GatedExecutor()
+    e = _mk_native(ex)
+    try:
+        ex.gate.clear()
+        hs = [e.allreduce_async(f"warm.{k}", np.ones(4, np.float32),
+                                False) for k in range(10)]
+        time.sleep(0.35)  # queue residency the histogram will observe
+        ex.gate.set()
+        for h in hs:
+            e.synchronize(h)
+        before = _counter(e, "engine.admission.shed")
+        with pytest.raises(AdmissionRejected,
+                           match="engine.admission.shed"):
+            e.allreduce_async("shed.me", np.ones(4, np.float32), False,
+                              deadline_ms=20)
+        assert _counter(e, "engine.admission.shed") == before + 1
+        h2 = e.allreduce_async("keep.me", np.ones(4, np.float32), False,
+                               deadline_ms=30000)
+        e.synchronize(h2)
+    finally:
+        ex.gate.set()
+        e.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# drain order
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("impl,mk", ENGINES)
+def test_drain_order_priority_margin_name(impl, mk):
+    """A saturated cycle drains (priority, deadline-margin, name)
+    ordered: high first, tighter deadlines first within a class, names
+    last for determinism. Distinct dtypes keep same-class entries out
+    of one fused batch so the executor call order is observable."""
+    ex = GatedExecutor()
+    e = mk(ex)
+    try:
+        ex.gate.clear()
+        h0 = e.allreduce_async("blk", np.ones(5, np.float32), False,
+                               priority="low")
+        _wait(lambda: len(ex.calls) == 1, msg="blocker never executed")
+        order = [
+            # (name, size, dtype, priority, deadline_ms)
+            ("z2.low", 9, np.float64, "low", None),
+            ("na.norm", 17, np.float32, "normal", 9000),
+            ("hi", 11, np.float32, "high", None),
+            ("nb.norm", 31, np.float64, "normal", 5000),
+            ("z1.low", 7, np.float32, "low", None),
+        ]
+        hs = [e.allreduce_async(n, np.ones(sz, dt), False, priority=p,
+                                deadline_ms=dl)
+              for n, sz, dt, p, dl in order]
+        ex.gate.set()
+        for h in hs:
+            e.synchronize(h)
+        e.synchronize(h0)
+        # call 0 = blocker; then: high(11), normal margin 5s (31),
+        # normal margin 9s (17), low by name z1(7) then z2(9).
+        assert ex.calls == [5, 11, 31, 17, 7, 9], ex.calls
+    finally:
+        ex.gate.set()
+        e.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# quiesce vs saturation: shed-vs-drained reported separately
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("impl,mk", ENGINES)
+def test_quiesce_saturated_reports_shed_separately(impl, mk):
+    """Quiesce against a saturated queue: work retired WITHOUT
+    completing inside the drain window (a cooperative cancel here) is
+    reported as ``shed``, separate from the names that actually
+    drained; completed waiters still deliver."""
+    ex = GatedExecutor()
+    e = mk(ex)
+    try:
+        ex.gate.clear()
+        hb = e.allreduce_async("q.blk", np.ones(4, np.float32), False,
+                               priority="high")
+        _wait(lambda: len(ex.calls) == 1, msg="blocker never executed")
+        hh = e.allreduce_async("q.hi", np.ones(4, np.float32), False,
+                               priority="high")
+        hl = e.allreduce_async("q.low", np.ones(4, np.float32), False,
+                               priority="low")
+        hc = e.allreduce_async("q.cancel", np.ones(4, np.float32),
+                               False, priority="low")
+
+        def mid_drain():
+            time.sleep(0.15)
+            e.cancel(hc)  # retired without completing -> shed
+            time.sleep(0.15)
+            ex.gate.set()
+
+        t = threading.Thread(target=mid_drain)
+        t.start()
+        report = e.quiesce(10.0, reason="saturated drain")
+        t.join()
+        assert report["still_pending"] == [], report
+        assert report["shed"] == 1, report
+        assert {"q.blk", "q.hi", "q.low"} <= set(report["drained"])
+        for h in (hb, hh, hl):
+            e.synchronize(h)
+        with pytest.raises(eng.CancelledError):
+            e.synchronize(hc)
+    finally:
+        ex.gate.set()
+        e.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# cancel storm against a saturated queue: counters stay flat
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("impl,mk", ENGINES)
+def test_cancel_storm_leaves_ring_pool_counters_flat(impl, mk,
+                                                     monkeypatch):
+    """Two identical storm rounds against a class at budget: every
+    admission slot frees, the submit-ring pressure counters do not
+    move, and pool residency reaches steady state after round one (a
+    leak would grow it every round)."""
+    monkeypatch.setenv("HVD_ADMISSION_MAX_INFLIGHT_LOW", "3")
+    ex = GatedExecutor()
+    e = mk(ex)
+
+    def storm_round(tag):
+        ex.gate.clear()
+        hb = e.allreduce_async(f"{tag}.blk", np.ones(64, np.float32),
+                               False, priority="high")
+        _wait(lambda: e.admission_summary()
+              ["classes"]["high"]["inflight"] == 1)
+        hs = [e.allreduce_async(f"{tag}.{k}", np.ones(32, np.float32),
+                                False, priority="low")
+              for k in range(3)]
+        _wait(lambda: e.admission_summary()
+              ["classes"]["low"]["inflight"] == 3)
+        with pytest.raises(AdmissionRejected):
+            e.allreduce_async(f"{tag}.over", np.ones(32, np.float32),
+                              False, priority="low")
+        for _ in range(3):  # the storm: repeated + bogus cancels
+            for h in hs:
+                e.cancel(h)
+        assert e.cancel(987654) is False
+        ex.gate.set()
+        for h in hs:
+            with pytest.raises(eng.CancelledError):
+                e.synchronize(h)
+        e.synchronize(hb)
+        _wait(lambda: all(
+            c["inflight"] == 0
+            for c in e.admission_summary()["classes"].values()),
+            msg="admission slots never freed after the storm")
+
+    try:
+        storm_round("s1")
+        ring0 = (_counter(e, "engine.ring.full"),
+                 _counter(e, "engine.ring.spins"))
+        resident1 = tele.REGISTRY.gauge(
+            "engine.pool.bytes_resident").value
+        storm_round("s2")
+        ring1 = (_counter(e, "engine.ring.full"),
+                 _counter(e, "engine.ring.spins"))
+        resident2 = tele.REGISTRY.gauge(
+            "engine.pool.bytes_resident").value
+        assert ring1 == ring0, (ring0, ring1)
+        assert resident2 == resident1, (resident1, resident2)
+        assert e.admission_summary()["queue_depth"] == 0
+    finally:
+        ex.gate.set()
+        e.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# /healthz + doctor surfaces
+# ---------------------------------------------------------------------------
+
+
+def test_healthz_saturated_arm_and_admission_body(monkeypatch):
+    """A tripped class flips /healthz to ``saturated`` (non-200 via
+    telemetry_http's not-ok/init rule) and the body carries the
+    admission state; it returns to ok once the budget frees."""
+    from horovod_tpu.core import sentinel
+
+    monkeypatch.setenv("HVD_ADMISSION_MAX_INFLIGHT_LOW", "1")
+    ex = GatedExecutor()
+    e = _mk_py(ex)
+    saved = eng._engine
+    eng._engine = e  # health reads the singleton
+    sentinel.note_draining(None)  # an earlier quiesce test leaves the marker
+    try:
+        ex.gate.clear()
+        h = e.allreduce_async("hz.low", np.ones(4, np.float32), False,
+                              priority="low")
+        _wait(lambda: e.admission_summary()
+              ["classes"]["low"]["inflight"] == 1)
+        body = sentinel.health()
+        assert body["status"] == "saturated"
+        assert body["admission"]["saturated"] == ["low"]
+        assert body["admission"]["queue_depth"] >= 1
+        assert body["admission"]["classes"]["low"]["max_inflight"] == 1
+        ex.gate.set()
+        e.synchronize(h)
+        _wait(lambda: e.admission_summary()
+              ["classes"]["low"]["inflight"] == 0)
+        assert sentinel.health()["status"] != "saturated"
+    finally:
+        ex.gate.set()
+        e.shutdown()
+        eng._engine = saved
+
+
+def test_doctor_overload_verdict_names_class_and_budget(monkeypatch):
+    """A snapshot whose admission state reports a tripped budget
+    classifies as an ``overload`` finding naming the class, budget and
+    rank."""
+    from horovod_tpu.core import doctor
+
+    snap = {
+        "v": 1, "rank": 2, "nproc": 4, "wall": time.time(),
+        "generation": 0, "epoch": 0, "kind": "stall", "reason": "x",
+        "entries": [], "draining": None, "kv_failovers": 0,
+        "exec_median_us": None,
+        "admission": eng.build_admission_summary(
+            7, [0, 1, 5], [0, 64, 4096], [0, 0, 5], [0, 0, 0]),
+    }
+    v = doctor.classify([snap], nproc=4)
+    over = [f for f in v["findings"] if f["kind"] == "overload"]
+    assert len(over) == 1, v
+    f = over[0]
+    assert f["ranks"] == [2]
+    assert "'low'" in f["detail"] and "max_inflight" in f["detail"]
+    assert "queue depth 7" in f["detail"]

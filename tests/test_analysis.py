@@ -122,8 +122,8 @@ def test_abi_catches_skewed_ctypes_field(tmp_path):
 
 def test_abi_catches_new_c_field_missing_from_mirror(tmp_path):
     root = _mini_root(tmp_path)
-    _edit(root, _CC, "long long pool_bound_hits;\n};",
-          "long long pool_bound_hits;\n  long long new_counter;\n};")
+    _edit(root, _CC, "long long admission_bytes_low;\n};",
+          "long long admission_bytes_low;\n  long long new_counter;\n};")
     findings = abi.check(root)
     assert any(f.rule == "abi-struct" and "new_counter" in f.message
                for f in findings), findings
@@ -325,6 +325,53 @@ def test_parity_doctor_catches_python_record_skew(tmp_path):
                in f.message for f in findings), findings
 
 
+def test_abi_catches_skewed_priority_field(tmp_path):
+    """The serving-plane priority class rides the C ABI
+    (hvd_request.priority); widening the ctypes mirror behind the C
+    struct's back must be named."""
+    root = _mini_root(tmp_path)
+    _edit(root, _BINDING, '("priority", ctypes.c_int),',
+          '("priority", ctypes.c_longlong),')
+    findings = abi.check(root)
+    assert any(f.rule == "abi-struct" and "priority" in f.message
+               for f in findings), findings
+
+
+def test_parity_catches_renamed_admission_counter_field(tmp_path):
+    """The admission counters (engine.admission.rejected/shed) join the
+    machine-diffed stats vocabulary: renaming the C++ field without the
+    stats sync following is named by both checkers."""
+    root = _mini_root(tmp_path)
+    _edit(root, _CC, "long long admission_rejected;",
+          "long long admission_refused;")
+    rules = {f.rule for f in parity.check(root)}
+    assert "parity-stats-fields" in rules
+    assert any(f.rule == "abi-struct" for f in abi.check(root))
+
+
+def test_parity_catches_renamed_admission_span_arg(tmp_path):
+    """Timeline span args carry the serving-plane class ("priority");
+    the C++ emitter drifting from the python vocabulary is a span-args
+    skew."""
+    root = _mini_root(tmp_path)
+    _edit(root, _CC, 'out += ", \\"priority\\": \\"";',
+          'out += ", \\"prio_class\\": \\"";')
+    findings = parity.check(root)
+    assert any(f.rule == "parity-span-args" for f in findings), findings
+
+
+def test_parity_doctor_catches_renamed_overload_verdict(tmp_path):
+    """The serving-plane 'overload' verdict renamed in the classifier
+    without the stats-CLI consumer table following — same contract as
+    the other doctor kinds."""
+    root = _mini_root(tmp_path)
+    _edit(root, os.path.join("horovod_tpu", "core", "doctor.py"),
+          '"overload"', '"overloaded"')
+    findings = parity.check(root)
+    assert any(f.rule == "parity-doctor" and "overloaded" in f.message
+               for f in findings), findings
+
+
 def test_parity_catches_renamed_latency_instrument(tmp_path):
     """A latency instrument renamed on the native fold side only — the
     vocabularies the two engines feed must stay identical."""
@@ -517,6 +564,7 @@ def _fault_root(tmp_path):
         "from horovod_tpu.core import faultline as flt\n\n\n"
         "def submit(name):\n"
         "    injected = flt.engine_submit(name)\n"
+        "    flt.engine_admit_burst()\n"
         "    flt.kv_get(name)\n"
         "    flt.kv_set(name, 'v')\n"
         "    flt.kv_try_get(name)\n"

@@ -62,6 +62,13 @@ _CASES = {
                                        "--num-warmup-batches", "1",
                                        "--num-batches-per-iter", "1",
                                        "--num-iters", "1"],
+    # Serving plane (ISSUE 20): sharded inference with a high-class
+    # deadline'd metric reduction, and the mixed-priority load harness
+    # with a deliberately tiny low-class budget so admission rejections
+    # actually fire in the smoke (exit is nonzero on digest failures).
+    "batched_inference.py": ["--batches", "3", "--background-mb", "0.5"],
+    "serving_load_harness.py": ["--requests", "30", "--wave", "8",
+                                "--max-inflight-low", "2"],
 }
 
 
